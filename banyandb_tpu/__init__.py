@@ -1,0 +1,26 @@
+"""banyandb_tpu — a TPU-native observability database framework.
+
+A brand-new implementation of the capabilities of Apache SkyWalking BanyanDB
+(reference: /root/reference, see SURVEY.md) designed JAX/XLA/Pallas-first:
+
+- Four data models: Measure (metrics), Stream (logs), Trace (spans),
+  Property (mutable documents)  -> `banyandb_tpu.models`
+- Columnar, snapshot-MVCC LSM storage substrate with time-segmented shards
+  -> `banyandb_tpu.storage`
+- The query execution plane (columnar scan, filter, group-by, aggregation,
+  top-N, percentile) runs as fused XLA/Pallas TPU kernels
+  -> `banyandb_tpu.ops`, `banyandb_tpu.query`
+- Distributed execution over `jax.sharding.Mesh` with psum/all_gather
+  collectives replacing the reference's proto partial-aggregate exchange
+  -> `banyandb_tpu.parallel`, `banyandb_tpu.cluster`
+
+Dtype policy (TPU-first):
+- int64 quantities (timestamps, series ids, versions) live on the host / on
+  disk as NumPy int64; the *device* hot path is explicitly 32-bit:
+  timestamps are int32 offsets from the segment/batch epoch, tag values are
+  int32 dictionary codes, float fields are float32. Kernels are
+  dtype-explicit, and global JAX config (x64) is never mutated — host-side
+  64-bit work stays in NumPy at the host/device boundary.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,48 @@
+"""Device-side decode kernels.
+
+The reference decodes int64 columns on the CPU with delta / delta-of-delta +
+zigzag varint (pkg/encoding/int_list.go:27) and dictionary-encodes low-
+cardinality byte columns (pkg/encoding/dictionary.go).  On TPU, variable-
+width varint decode is hostile to the VPU, so the on-disk format (see
+banyandb_tpu.utils.encoding) stores *fixed-width* deltas; the prefix-sum
+reconstruction and dictionary gather run on device where they fuse into the
+scan pipeline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def delta_decode(first, deltas):
+    """values[i] = first + sum(deltas[:i+1]); deltas[0] is vs `first`.
+
+    Mirrors encoding.EncodeTypeDelta (pkg/encoding/int_list.go:60) but as a
+    device cumsum instead of a sequential loop.
+    """
+    return first + jnp.cumsum(deltas, axis=-1, dtype=deltas.dtype)
+
+
+def dod_decode(first, first_delta, dods):
+    """Delta-of-delta decode (pkg/encoding/int_list.go:66 analog).
+
+    Reconstructs the FULL series of ``len(dods) + 1`` values from second
+    differences with two cumsums: out[0] == first,
+    out[1] == first + first_delta + dods[0] (encoders emit dods[0] = 0),
+    out[i] == out[i-1] + (first_delta + sum(dods[:i])).
+    """
+    first = jnp.asarray(first, dtype=dods.dtype)
+    deltas = first_delta + jnp.cumsum(dods, axis=-1, dtype=dods.dtype)
+    rest = first[..., None] + jnp.cumsum(deltas, axis=-1, dtype=deltas.dtype)
+    head = jnp.broadcast_to(first[..., None], rest.shape[:-1] + (1,))
+    return jnp.concatenate([head, rest], axis=-1)
+
+
+def dict_gather(dictionary, codes):
+    """Materialize dictionary-encoded values: out[i] = dictionary[codes[i]].
+
+    The scan pipeline usually *avoids* this by pushing predicates onto the
+    codes themselves (storage-and-format.md§7.3 dictionary-as-filter); this
+    exists for projections of numeric dictionary columns.
+    """
+    return jnp.take(dictionary, codes, axis=0)

@@ -1,0 +1,41 @@
+"""Version dedup inside a parallel scan.
+
+The reference dedups measure rows by keeping the max write-version per
+(seriesID, timestamp) during its sequential merge-sort scan
+(banyand/measure columnar read path).  A sequential scan does not map to
+the VPU, so here dedup is a multi-operand sort: order rows by
+(series, ts, -version) and invalidate every row that shares (series, ts)
+with its sorted predecessor — the survivor is exactly the max-version row.
+All operands stay int32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def latest_by_version(
+    series: jax.Array,
+    ts: jax.Array,
+    version: jax.Array,
+    valid: jax.Array,
+) -> jax.Array:
+    """-> refined bool validity mask keeping one max-version row per key."""
+    n = series.shape[-1]
+    # Invalid rows sort last (series=INT32_MAX) and stay invalid.
+    big = jnp.int32(2147483647)
+    s = jnp.where(valid, series, big)
+    t = jnp.where(valid, ts, big)
+    negv = jnp.where(valid, -version, big)
+    idx = jax.lax.broadcasted_iota(jnp.int32, (n,), 0)
+    s_s, t_s, _, idx_s = jax.lax.sort((s, t, negv, idx), num_keys=3)
+    first = jnp.concatenate(
+        [
+            jnp.ones((1,), dtype=bool),
+            (s_s[1:] != s_s[:-1]) | (t_s[1:] != t_s[:-1]),
+        ]
+    )
+    keep_sorted = first
+    keep = jnp.zeros((n,), dtype=bool).at[idx_s].set(keep_sorted)
+    return keep & valid

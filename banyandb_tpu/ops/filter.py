@@ -1,0 +1,61 @@
+"""Predicate-mask kernels.
+
+The reference evaluates tag predicates row-by-row in Go operators
+(pkg/query/vectorized/measure/*.go filter operators).  Here predicates are
+dense vector compares producing bool masks that XLA fuses with the
+downstream aggregation — a filtered scan is one kernel, not an operator
+chain.
+
+String predicates never see raw bytes on device: equality/IN lower to
+dictionary-code compares (the host resolves the literal to its code, or to
+an always-false mask when absent), mirroring the reference's
+dictionary-as-exact-filter trick (docs/concept/storage-and-format.md§7.3).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_OPS = {
+    "eq": lambda c, v: c == v,
+    "ne": lambda c, v: c != v,
+    "lt": lambda c, v: c < v,
+    "le": lambda c, v: c <= v,
+    "gt": lambda c, v: c > v,
+    "ge": lambda c, v: c >= v,
+}
+
+
+def cmp_mask(column, op: str, value):
+    """Elementwise compare mask. `op` in eq/ne/lt/le/gt/ge."""
+    return _OPS[op](column, value)
+
+
+def in_set_mask(column, values):
+    """mask[i] = column[i] in values. `values` is a small static-size array;
+    lowered to a broadcast compare + any-reduce (VPU-friendly)."""
+    vals = jnp.asarray(values)
+    return jnp.any(column[..., None] == vals, axis=-1)
+
+
+def time_range_mask(ts, lo, hi):
+    """Half-open [lo, hi) time-range mask over int32 ts offsets."""
+    return (ts >= lo) & (ts < hi)
+
+
+def mask_and(*masks):
+    out = masks[0]
+    for m in masks[1:]:
+        out = out & m
+    return out
+
+
+def mask_or(*masks):
+    out = masks[0]
+    for m in masks[1:]:
+        out = out | m
+    return out
+
+
+def mask_not(mask):
+    return ~mask

@@ -1,0 +1,127 @@
+"""Group-by + aggregation kernels.
+
+The reference aggregates with Go hash maps over decoded rows
+(pkg/query/aggregation, pkg/query/vectorized/measure/groupby_agg.go).  On
+TPU there is no hash table: tags are dictionary codes, so a group key is a
+*mixed-radix* int32 composed from the code columns, bounded by the product
+of dictionary sizes.  Aggregation is then a dense segment reduction:
+
+- ``scatter`` method: jax.ops.segment_sum/min/max (XLA scatter).
+- ``matmul`` method: one-hot(keys) @ values on the MXU — the TPU-native
+  path for sums/counts when the group count is modest (<= ~4096).
+
+Both produce identical results; `group_reduce` picks per shape unless told.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+
+def mixed_radix_key(
+    columns: Sequence[jax.Array], radices: Sequence[int]
+) -> tuple[jax.Array, int]:
+    """Compose dictionary-code columns into a single dense group key.
+
+    key = ((c0*r1 + c1)*r2 + c2)... ; group count = prod(radices).
+    Host code recovers per-tag codes with np.unravel_index(key, radices).
+    """
+    assert len(columns) == len(radices) and columns
+    total = 1
+    for r in radices:
+        total *= int(r)
+    if total >= 2**31:
+        # int32 keys would wrap on device and silently merge groups; callers
+        # must pre-reduce cardinality (hash-bucket tags) before grouping.
+        raise ValueError(
+            f"group cardinality {total} overflows int32 keys; "
+            "bucket the tag dictionaries first"
+        )
+    key = columns[0].astype(jnp.int32)
+    for c, r in zip(columns[1:], radices[1:]):
+        key = key * jnp.int32(r) + c.astype(jnp.int32)
+    return key, total
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GroupReduceResult:
+    """Per-group aggregates; arrays have leading dim num_groups."""
+
+    count: jax.Array  # f32 [G] — valid-row count per group
+    sums: Mapping[str, jax.Array]  # f32 [G] per field
+    mins: Mapping[str, jax.Array]  # f32 [G] per field (+inf when empty)
+    maxs: Mapping[str, jax.Array]  # f32 [G] per field (-inf when empty)
+
+    def mean(self, field: str) -> jax.Array:
+        return self.sums[field] / jnp.maximum(self.count, 1.0)
+
+    @property
+    def nonempty(self) -> jax.Array:
+        return self.count > 0
+
+
+def _pick_method(nrows: int, num_groups: int) -> str:
+    # One-hot matmul materializes an [N, G] operand through the MXU; worth it
+    # while G stays in the low thousands, after which scatter wins on bytes.
+    return "matmul" if num_groups <= 4096 else "scatter"
+
+
+def group_reduce(
+    key: jax.Array,
+    valid: jax.Array,
+    fields: Mapping[str, jax.Array],
+    num_groups: int,
+    *,
+    want_minmax: bool = True,
+    method: str = "auto",
+) -> GroupReduceResult:
+    """Segment-reduce rows into per-group count/sum/min/max.
+
+    Invalid rows are routed to a spill group (index num_groups) and dropped,
+    so padding never pollutes real groups.
+    """
+    if method == "auto":
+        method = _pick_method(key.shape[-1], num_groups)
+
+    validf = valid.astype(jnp.float32)
+    safe_key = jnp.where(valid, key, jnp.int32(num_groups))
+
+    if method == "matmul":
+        # [N, G+1] one-hot; MXU contraction gives counts and sums in one
+        # fused pass per field.  f32 accumulate keeps int-valued fields exact
+        # up to 2^24 per group partial (parts are merged in f64 on host).
+        groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups + 1,), 0)
+        onehot = (safe_key[:, None] == groups[None, :]).astype(jnp.float32)
+        count = (validf @ onehot)[:num_groups]
+        sums = {
+            name: ((col * validf) @ onehot)[:num_groups]
+            for name, col in fields.items()
+        }
+    else:
+        seg = jax.ops.segment_sum
+        count = seg(validf, safe_key, num_segments=num_groups + 1)[:num_groups]
+        sums = {
+            name: seg(col * validf, safe_key, num_segments=num_groups + 1)[
+                :num_groups
+            ]
+            for name, col in fields.items()
+        }
+
+    mins: dict[str, jax.Array] = {}
+    maxs: dict[str, jax.Array] = {}
+    if want_minmax:
+        # Invalid rows are already routed to the sliced-off spill segment by
+        # safe_key, so no value masking is needed here.
+        for name, col in fields.items():
+            mins[name] = jax.ops.segment_min(
+                col, safe_key, num_segments=num_groups + 1
+            )[:num_groups]
+            maxs[name] = jax.ops.segment_max(
+                col, safe_key, num_segments=num_groups + 1
+            )[:num_groups]
+
+    return GroupReduceResult(count=count, sums=sums, mins=mins, maxs=maxs)

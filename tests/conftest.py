@@ -1,0 +1,11 @@
+"""Test env: force CPU platform with 8 virtual devices so sharding/mesh
+tests run without TPU hardware (matches the driver's dryrun harness)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
