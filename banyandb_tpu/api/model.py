@@ -1,0 +1,111 @@
+"""Query & write request/response models.
+
+Parity with measure/v1 QueryRequest + model/v1 Criteria/Condition/TimeRange
+(api/proto/banyandb/measure/v1/query.proto, model/v1/query.proto), plus a
+first-class ``percentile`` aggregate (SURVEY.md §7 step 1 — the reference
+only post-processes percentiles client-side).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Union
+
+
+@dataclass(frozen=True)
+class TimeRange:
+    """Half-open [begin, end) in epoch millis (model/v1 TimeRange analog)."""
+
+    begin_millis: int
+    end_millis: int
+
+    def overlaps(self, lo: int, hi: int) -> bool:
+        return self.begin_millis < hi and lo < self.end_millis
+
+
+@dataclass(frozen=True)
+class Condition:
+    """model/v1 Condition: one tag predicate."""
+
+    name: str
+    op: str  # eq | ne | lt | le | gt | ge | in | not_in | having | match
+    value: object
+
+
+@dataclass(frozen=True)
+class LogicalExpression:
+    op: str  # and | or
+    left: "Criteria"
+    right: "Criteria"
+
+
+Criteria = Union[Condition, LogicalExpression]
+
+
+@dataclass(frozen=True)
+class GroupBy:
+    tag_names: tuple[str, ...]
+    field_name: str = ""
+
+
+@dataclass(frozen=True)
+class Aggregation:
+    function: str  # sum | count | min | max | mean | percentile
+    field_name: str
+    # percentile-only extras
+    quantiles: tuple[float, ...] = ()
+
+
+@dataclass(frozen=True)
+class Top:
+    number: int
+    field_name: str
+    field_value_sort: str = "desc"  # desc | asc
+
+
+@dataclass(frozen=True)
+class QueryRequest:
+    """measure/v1 QueryRequest analog."""
+
+    groups: tuple[str, ...]
+    name: str
+    time_range: TimeRange
+    criteria: Optional[Criteria] = None
+    tag_projection: tuple[str, ...] = ()
+    field_projection: tuple[str, ...] = ()
+    group_by: Optional[GroupBy] = None
+    agg: Optional[Aggregation] = None
+    top: Optional[Top] = None
+    limit: int = 100
+    offset: int = 0
+    order_by_ts: str = ""  # "" | asc | desc
+    trace: bool = False  # in-band query tracing
+    stages: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class DataPointValue:
+    """One ingested data point (measure/v1 DataPointValue analog)."""
+
+    ts_millis: int
+    tags: dict[str, object]
+    fields: dict[str, object]
+    version: int = 0
+
+
+@dataclass(frozen=True)
+class WriteRequest:
+    group: str
+    name: str
+    points: tuple[DataPointValue, ...]
+
+
+@dataclass
+class QueryResult:
+    """Aggregated response: either grouped aggregates or raw data points."""
+
+    # group tuples (tag values) aligned with per-agg value arrays
+    groups: list[tuple] = field(default_factory=list)
+    values: dict[str, list] = field(default_factory=dict)
+    data_points: list[dict] = field(default_factory=list)
+    trace: Optional[dict] = None

@@ -1,0 +1,324 @@
+"""Schema objects + registry.
+
+Vocabulary parity with the reference's database/v1 schema protos
+(api/proto/banyandb/database/v1/schema.proto: Measure, TagSpec, FieldSpec,
+Entity, IndexRule, TopNAggregation; common/v1/common.proto: Group,
+ResourceOpts, IntervalRule).  The registry is the analog of the
+property-backed schema server (banyand/metadata/schema/schemaserver/) in
+single-process form: in-memory maps with mod-revision semantics, persisted
+as JSON files under <root>/schema/ via atomic writes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from banyandb_tpu.utils import fs
+
+
+class Catalog(enum.Enum):
+    MEASURE = "measure"
+    STREAM = "stream"
+    TRACE = "trace"
+    PROPERTY = "property"
+
+
+class TagType(enum.Enum):
+    STRING = "string"
+    INT = "int"
+    STRING_ARRAY = "string_array"
+    INT_ARRAY = "int_array"
+    DATA_BINARY = "data_binary"
+    TIMESTAMP = "timestamp"
+
+
+class FieldType(enum.Enum):
+    STRING = "string"
+    INT = "int"
+    FLOAT = "float"
+    DATA_BINARY = "data_binary"
+
+
+@dataclass(frozen=True)
+class TagSpec:
+    name: str
+    type: TagType
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    name: str
+    type: FieldType
+    # encoding/compression method knobs from the reference are implied by
+    # type here: INT -> delta+zstd, FLOAT -> decimal-mantissa+delta+zstd.
+
+
+@dataclass(frozen=True)
+class Entity:
+    """Which tags form the series identity (database/v1 Entity)."""
+
+    tag_names: tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class IntervalRule:
+    """common/v1 IntervalRule: a duration expressed as <num><unit>."""
+
+    num: int
+    unit: str  # "hour" | "day"
+
+    @property
+    def millis(self) -> int:
+        return self.num * (3_600_000 if self.unit == "hour" else 86_400_000)
+
+
+@dataclass(frozen=True)
+class ResourceOpts:
+    """common/v1 ResourceOpts: sharding/replication/retention per group."""
+
+    shard_num: int = 1
+    replicas: int = 0
+    segment_interval: IntervalRule = IntervalRule(1, "day")
+    ttl: IntervalRule = IntervalRule(7, "day")
+    stages: tuple[str, ...] = ()  # hot/warm/cold tier names
+
+
+@dataclass(frozen=True)
+class Group:
+    name: str
+    catalog: Catalog
+    resource_opts: ResourceOpts = ResourceOpts()
+
+
+@dataclass(frozen=True)
+class Measure:
+    """database/v1 Measure: tag families + fields keyed by entity."""
+
+    group: str
+    name: str
+    tags: tuple[TagSpec, ...]
+    fields: tuple[FieldSpec, ...]
+    entity: Entity
+    interval: str = ""  # data-point interval hint (e.g. "1m")
+    index_mode: bool = False  # index-mode measures live in the series index
+
+    def tag(self, name: str) -> TagSpec:
+        for t in self.tags:
+            if t.name == name:
+                return t
+        raise KeyError(f"tag {name} not in measure {self.name}")
+
+    def field(self, name: str) -> FieldSpec:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(f"field {name} not in measure {self.name}")
+
+
+@dataclass(frozen=True)
+class IndexRule:
+    """database/v1 IndexRule: which tags get inverted/skipping/tree index."""
+
+    group: str
+    name: str
+    tags: tuple[str, ...]
+    type: str = "inverted"  # inverted | skipping | tree
+    analyzer: str = ""
+
+
+@dataclass(frozen=True)
+class TopNAggregation:
+    """database/v1 TopNAggregation: ingest-time streaming top-N source."""
+
+    group: str
+    name: str
+    source_measure: str
+    field_name: str
+    field_value_sort: str = "desc"  # desc | asc | all
+    group_by_tag_names: tuple[str, ...] = ()
+    counters_number: int = 1000
+    lru_size: int = 10
+
+
+_KINDS = {
+    "group": Group,
+    "measure": Measure,
+    "index_rule": IndexRule,
+    "topn": TopNAggregation,
+}
+
+
+def _to_jsonable(obj):
+    if dataclasses.is_dataclass(obj):
+        return {
+            f.name: _to_jsonable(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, tuple):
+        return [_to_jsonable(x) for x in obj]
+    return obj
+
+
+def _from_jsonable(cls, data):
+    if dataclasses.is_dataclass(cls):
+        kwargs = {}
+        for f in dataclasses.fields(cls):
+            if f.name in data:
+                kwargs[f.name] = _from_jsonable_field(f.type, data[f.name])
+        return cls(**kwargs)
+    return data
+
+
+_FIELD_TYPES = {
+    "Catalog": Catalog,
+    "TagType": TagType,
+    "FieldType": FieldType,
+    "tuple[TagSpec, ...]": (tuple, "TagSpec"),
+    "tuple[FieldSpec, ...]": (tuple, "FieldSpec"),
+    "tuple[str, ...]": (tuple, None),
+    "Entity": Entity,
+    "IntervalRule": IntervalRule,
+    "ResourceOpts": ResourceOpts,
+}
+_CLASSES = {
+    "TagSpec": TagSpec,
+    "FieldSpec": FieldSpec,
+}
+
+
+def _from_jsonable_field(type_str, value):
+    spec = _FIELD_TYPES.get(type_str)
+    if spec is None:
+        return value
+    if isinstance(spec, tuple):
+        _, inner = spec
+        if inner is None:
+            return tuple(value)
+        return tuple(_from_jsonable(_CLASSES[inner], v) for v in value)
+    if isinstance(spec, type) and issubclass(spec, enum.Enum):
+        return spec(value)
+    return _from_jsonable(spec, value)
+
+
+class SchemaRegistry:
+    """Mod-revisioned schema store with optional file persistence.
+
+    CRUD semantics mirror the reference's registry services
+    (banyand/liaison/grpc/registry.go): create/update bump a global
+    revision; deletes are recorded; watchers (engines) are notified
+    synchronously in-process.
+    """
+
+    def __init__(self, root: Optional[str | Path] = None):
+        self._lock = threading.RLock()
+        self._root = Path(root) / "schema" if root else None
+        self._revision = 0
+        self._store: dict[str, dict[str, object]] = {k: {} for k in _KINDS}
+        self._watchers: list = []
+        if self._root and self._root.exists():
+            self._load()
+
+    # -- internals ---------------------------------------------------------
+    def _key(self, obj) -> str:
+        group = getattr(obj, "group", None)
+        return f"{group}/{obj.name}" if group else obj.name
+
+    def _persist(self, kind: str) -> None:
+        if not self._root:
+            return
+        payload = {k: _to_jsonable(v) for k, v in self._store[kind].items()}
+        fs.atomic_write_json(
+            self._root / f"{kind}.json",
+            {"revision": self._revision, "items": payload},
+        )
+
+    def _load(self) -> None:
+        for kind, cls in _KINDS.items():
+            path = self._root / f"{kind}.json"
+            if not path.exists():
+                continue
+            data = fs.read_json(path)
+            self._revision = max(self._revision, data.get("revision", 0))
+            for key, item in data.get("items", {}).items():
+                self._store[kind][key] = _from_jsonable(cls, item)
+
+    def _put(self, kind: str, obj) -> int:
+        with self._lock:
+            self._revision += 1
+            self._store[kind][self._key(obj)] = obj
+            self._persist(kind)
+            for w in self._watchers:
+                w(kind, obj, self._revision)
+            return self._revision
+
+    def _get(self, kind: str, key: str):
+        with self._lock:
+            obj = self._store[kind].get(key)
+            if obj is None:
+                raise KeyError(f"{kind} {key} not found")
+            return obj
+
+    def _delete(self, kind: str, key: str) -> None:
+        with self._lock:
+            if key not in self._store[kind]:
+                raise KeyError(f"{kind} {key} not found")
+            self._revision += 1
+            del self._store[kind][key]
+            self._persist(kind)
+
+    # -- public CRUD (parity with the 9 registry services) -----------------
+    @property
+    def revision(self) -> int:
+        return self._revision
+
+    def watch(self, callback) -> None:
+        """callback(kind, obj, revision) on every create/update."""
+        self._watchers.append(callback)
+
+    def create_group(self, g: Group) -> int:
+        return self._put("group", g)
+
+    def get_group(self, name: str) -> Group:
+        return self._get("group", name)
+
+    def list_groups(self) -> list[Group]:
+        return list(self._store["group"].values())
+
+    def delete_group(self, name: str) -> None:
+        self._delete("group", name)
+
+    def create_measure(self, m: Measure) -> int:
+        self.get_group(m.group)  # must exist
+        return self._put("measure", m)
+
+    def get_measure(self, group: str, name: str) -> Measure:
+        return self._get("measure", f"{group}/{name}")
+
+    def list_measures(self, group: str) -> list[Measure]:
+        return [
+            m for m in self._store["measure"].values() if m.group == group
+        ]
+
+    def delete_measure(self, group: str, name: str) -> None:
+        self._delete("measure", f"{group}/{name}")
+
+    def create_index_rule(self, r: IndexRule) -> int:
+        return self._put("index_rule", r)
+
+    def list_index_rules(self, group: str) -> list[IndexRule]:
+        return [
+            r for r in self._store["index_rule"].values() if r.group == group
+        ]
+
+    def create_topn(self, t: TopNAggregation) -> int:
+        return self._put("topn", t)
+
+    def list_topn(self, group: str) -> list[TopNAggregation]:
+        return [t for t in self._store["topn"].values() if t.group == group]

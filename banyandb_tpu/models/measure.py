@@ -1,0 +1,235 @@
+"""Measure engine: metrics with tags + numeric fields per series.
+
+Analog of banyand/measure (measure.go:81, write path tstable.go:333,
+query path query.go:88) over the TPU-first substrate: writes land in
+per-shard memtables routed by entity hash; queries gather memtable +
+part columns and run the device executor (query/measure_exec.py).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from banyandb_tpu.api.model import (
+    QueryRequest,
+    QueryResult,
+    WriteRequest,
+)
+from banyandb_tpu.api.schema import (
+    FieldType,
+    Measure,
+    SchemaRegistry,
+    TagType,
+)
+from banyandb_tpu.query import measure_exec
+from banyandb_tpu.storage.memtable import MemTable
+from banyandb_tpu.storage.part import ColumnData
+from banyandb_tpu.storage.tsdb import TSDB
+from banyandb_tpu.utils import hashing
+
+
+class MeasureEngine:
+    """All measure resources of all groups, one TSDB per group."""
+
+    def __init__(self, registry: SchemaRegistry, root: str | Path):
+        self.registry = registry
+        self.root = Path(root) / "measure"
+        self._tsdbs: dict[str, TSDB] = {}
+
+    # -- plumbing ----------------------------------------------------------
+    def _tsdb(self, group: str) -> TSDB:
+        db = self._tsdbs.get(group)
+        if db is None:
+            g = self.registry.get_group(group)
+            # One memtable schema per group would be wrong — tag/field sets
+            # differ per measure — so shards key their memtables per measure.
+            db = TSDB(
+                self.root,
+                group,
+                g.resource_opts,
+                mem_factory=lambda: _MultiMeasureMemtable(),
+            )
+            self._tsdbs[group] = db
+        return db
+
+    # -- write path (write_standalone.go analog) ---------------------------
+    def write(self, req: WriteRequest) -> int:
+        m = self.registry.get_measure(req.group, req.name)
+        db = self._tsdb(req.group)
+        shard_num = self.registry.get_group(req.group).resource_opts.shard_num
+        n = 0
+        for p in req.points:
+            entity = [
+                hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
+            ]
+            sid = hashing.series_id(entity)
+            shard = hashing.shard_id(sid, shard_num)
+            seg = db.segment_for(p.ts_millis)
+            version = p.version or int(time.time() * 1000)
+            tag_bytes = {
+                t.name: _tag_to_bytes(p.tags.get(t.name), t.type)
+                for t in m.tags
+            }
+            field_vals = {
+                f.name: float(p.fields.get(f.name, 0)) for f in m.fields
+            }
+            seg.shards[shard].mem.append_measure(
+                m.name,
+                [t.name for t in m.tags],
+                [f.name for f in m.fields],
+                p.ts_millis,
+                sid,
+                version,
+                tag_bytes,
+                field_vals,
+            )
+            n += 1
+        return n
+
+    def flush(self, group: Optional[str] = None) -> list[str]:
+        out = []
+        for name, db in self._tsdbs.items():
+            if group is None or name == group:
+                out.extend(db.flush_all())
+        return out
+
+    # -- query path (query.go:88 analog) -----------------------------------
+    def query(self, req: QueryRequest) -> QueryResult:
+        group = req.groups[0]
+        m = self.registry.get_measure(group, req.name)
+        db = self._tsdb(group)
+        sources: list[ColumnData] = []
+        tag_names = [t.name for t in m.tags]
+        field_names = [f.name for f in m.fields]
+        for seg in db.select_segments(
+            req.time_range.begin_millis, req.time_range.end_millis
+        ):
+            for shard in seg.shards:
+                mem_cols = shard.mem.columns_for(m.name)
+                if mem_cols is not None and mem_cols.ts.size:
+                    sources.append(mem_cols)
+                for part in shard.parts:
+                    if part.meta.get("measure") != m.name:
+                        continue
+                    blocks = part.select_blocks(
+                        req.time_range.begin_millis, req.time_range.end_millis
+                    )
+                    if blocks:
+                        sources.append(
+                            part.read(blocks, tags=tag_names, fields=field_names)
+                        )
+        if req.agg or req.group_by or req.top:
+            return measure_exec.execute_aggregate(m, req, sources)
+        return _raw_rows(m, req, sources)
+
+
+def _tag_to_bytes(value, tag_type: TagType) -> bytes:
+    if value is None:
+        return b""
+    return hashing.entity_bytes(value)
+
+
+class _MultiMeasureMemtable:
+    """Shard memtable keyed by measure name (one MemTable each).
+
+    The reference keeps one tstable per (group, shard) with rows of all
+    measures distinguished by series; here hot rows stay per-measure so a
+    flush produces one part per measure with that measure's columns.
+    """
+
+    def __init__(self):
+        self._tables: dict[str, MemTable] = {}
+
+    def __len__(self) -> int:
+        return sum(len(t) for t in self._tables.values())
+
+    def append_measure(
+        self, measure, tag_names, field_names, ts, sid, version, tags, fields
+    ) -> None:
+        tbl = self._tables.get(measure)
+        if tbl is None:
+            tbl = self._tables[measure] = MemTable(tag_names, field_names)
+        tbl.append(ts, sid, version, tags, fields)
+
+    def drain(self) -> list:
+        return [
+            (name, tbl.snapshot_columns(), {"measure": name})
+            for name, tbl in self._tables.items()
+        ]
+
+    def columns_for(self, measure: str) -> Optional[ColumnData]:
+        tbl = self._tables.get(measure)
+        return tbl.snapshot_columns() if tbl else None
+
+    def per_measure(self) -> dict[str, MemTable]:
+        return dict(self._tables)
+
+
+def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> QueryResult:
+    """Projection/limit query without aggregation: host-side assembly.
+
+    The aggregate path is the TPU hot loop; raw row retrieval is IO-bound
+    and stays on host (the reference's row iterator, query.go:594).
+    """
+    res = QueryResult()
+    conds = measure_exec._collect_conditions(req.criteria)
+    rows: list[tuple] = []
+    for src in sources:
+        if src.ts.size == 0:
+            continue
+        mask = (src.ts >= req.time_range.begin_millis) & (
+            src.ts < req.time_range.end_millis
+        )
+        for c in conds:
+            col = src.tags.get(c.name)
+            if col is None:
+                continue
+            d = src.dicts.get(c.name, [])
+            lut = {v: i for i, v in enumerate(d)}
+            if c.op == "eq":
+                code = lut.get(measure_exec._tag_value_bytes(c.value), -1)
+                mask &= col == code
+            elif c.op == "ne":
+                code = lut.get(measure_exec._tag_value_bytes(c.value), -1)
+                mask &= col != code
+            elif c.op in ("in", "not_in"):
+                codes = {
+                    lut.get(measure_exec._tag_value_bytes(v), -1)
+                    for v in c.value
+                }
+                inmask = np.isin(col, list(codes))
+                mask &= inmask if c.op == "in" else ~inmask
+            else:
+                raise NotImplementedError(f"raw-path op {c.op}")
+        idx = np.nonzero(mask)[0]
+        for i in idx:
+            tags = {
+                t: _decode_tag_value(src.dicts[t][src.tags[t][i]], m.tag(t).type)
+                for t in src.tags
+            }
+            fields = {f: float(src.fields[f][i]) for f in src.fields}
+            rows.append((int(src.ts[i]), int(src.version[i]), tags, fields))
+
+    # Version dedup then ts ordering, newest-first by default.
+    best: dict[tuple, tuple] = {}
+    for row in rows:
+        key = (row[0], tuple(sorted(row[2].items())))
+        if key not in best or best[key][1] < row[1]:
+            best[key] = row
+    ordered = sorted(best.values(), key=lambda r: r[0], reverse=(req.order_by_ts != "asc"))
+    off = req.offset or 0
+    for ts, _ver, tags, fields in ordered[off : off + (req.limit or 100)]:
+        res.data_points.append({"timestamp": ts, "tags": tags, "fields": fields})
+    return res
+
+
+def _decode_tag_value(raw: bytes, tag_type: TagType):
+    if tag_type == TagType.INT:
+        return int.from_bytes(raw, "little", signed=True) if raw else 0
+    if tag_type == TagType.STRING:
+        return raw.decode(errors="replace")
+    return raw

@@ -92,6 +92,13 @@ class ColumnBatch:
         valid[:n] = True
         if version is None:
             version = np.zeros((n,), dtype=np.int32)
+        elif n:
+            # Dedup only needs relative version order; rebase epoch-style
+            # int64 versions to offsets so they survive the int32 cast.
+            version = np.asarray(version, dtype=np.int64)
+            version = version - int(version.min())
+            if int(version.max()) >= 2**31:
+                raise ValueError("version spread exceeds int32 offsets")
         return ColumnBatch(
             ts=pad(ts_millis - epoch_millis, np.int32),
             series=pad(series_ordinal, np.int32),

@@ -15,12 +15,18 @@ import jax.numpy as jnp
 
 
 def delta_decode(first, deltas):
-    """values[i] = first + sum(deltas[:i+1]); deltas[0] is vs `first`.
+    """Reconstruct the FULL series of ``len(deltas) + 1`` values:
+    out[0] == first, out[i] == first + sum(deltas[:i]).
 
-    Mirrors encoding.EncodeTypeDelta (pkg/encoding/int_list.go:60) but as a
-    device cumsum instead of a sequential loop.
+    Matches the on-disk encoder (utils/encoding.encode_int64: `first` stored
+    separately + np.diff payload) so a device caller can feed the decoded
+    delta payload directly.  Mirrors encoding.EncodeTypeDelta
+    (pkg/encoding/int_list.go:60) as a cumsum instead of a sequential loop.
     """
-    return first + jnp.cumsum(deltas, axis=-1, dtype=deltas.dtype)
+    first = jnp.asarray(first, dtype=deltas.dtype)
+    rest = first[..., None] + jnp.cumsum(deltas, axis=-1, dtype=deltas.dtype)
+    head = jnp.broadcast_to(first[..., None], rest.shape[:-1] + (1,))
+    return jnp.concatenate([head, rest], axis=-1)
 
 
 def dod_decode(first, first_delta, dods):

@@ -65,9 +65,15 @@ class GroupReduceResult:
 
 
 def _pick_method(nrows: int, num_groups: int) -> str:
-    # One-hot matmul materializes an [N, G] operand through the MXU; worth it
-    # while G stays in the low thousands, after which scatter wins on bytes.
-    return "matmul" if num_groups <= 4096 else "scatter"
+    # One-hot matmul materializes an [N, G+1] f32 operand through the MXU;
+    # worth it while G stays in the low thousands AND the operand stays
+    # well under VMEM-friendly tile working sets, after which scatter wins
+    # on bytes moved.
+    return (
+        "matmul"
+        if num_groups <= 4096 and nrows * (num_groups + 1) <= 2**25
+        else "scatter"
+    )
 
 
 def group_reduce(

@@ -30,6 +30,13 @@ def group_percentile_histogram(
 
     Values are clamped into [lo, hi]; empty groups return lo.
     """
+    if (num_groups + 1) * num_buckets >= 2**31:
+        # The combined (group, bucket) segment id must fit int32 or scatter
+        # indices silently wrap under jit (same guard as mixed_radix_key).
+        raise ValueError(
+            f"num_groups={num_groups} x num_buckets={num_buckets} "
+            "overflows int32 segment ids"
+        )
     q = jnp.asarray(quantiles, dtype=jnp.float32)
     width = (hi - lo) / num_buckets
     bucket = jnp.clip(

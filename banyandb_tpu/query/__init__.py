@@ -1,0 +1,7 @@
+"""Query engine: logical request -> device execution plan -> result.
+
+Replaces the reference's row planner + vectorized operator pipeline
+(pkg/query/logical, pkg/query/vectorized) with a single fused device
+computation per (plan signature, chunk shape), plus thin host glue for
+dictionary resolution and result assembly.
+"""

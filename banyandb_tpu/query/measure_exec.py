@@ -1,0 +1,516 @@
+"""Device executor for measure aggregation queries.
+
+Pipeline (SURVEY.md §3.3 data-node hot loop, rebuilt TPU-first):
+
+  host:   sources (memtable + part blocks) -> global tag dictionaries ->
+          code remap -> version dedup (lexsort) -> 8192-row chunks
+  device: one jitted kernel per plan signature: time/tag masks ->
+          mixed-radix group key -> segment reduce (count/sum/min/max) ->
+          [+ histogram for percentile] ... executed per chunk
+  host:   combine tiny per-chunk partials, invert histograms, top-N, limit
+
+The jit cache is keyed by a static PlanSpec, so repeated queries with the
+same shape (the dashboard pattern) skip compilation entirely — predicate
+*values* are traced arguments, not compile-time constants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from banyandb_tpu import ops
+from banyandb_tpu.api.model import (
+    Aggregation,
+    Condition,
+    Criteria,
+    LogicalExpression,
+    QueryRequest,
+    QueryResult,
+)
+from banyandb_tpu.api.schema import Measure, TagType
+from banyandb_tpu.ops.blocks import pad_rows_bucket
+from banyandb_tpu.storage.part import ColumnData
+
+CHUNK = 8192
+_NUM_HIST_BUCKETS = 512
+
+
+@dataclass(frozen=True)
+class _PredSpec:
+    """Static shape of one predicate; its value(s) arrive as traced args."""
+
+    kind: str  # "code" (dict-code compare) | "value" (numeric compare)
+    name: str  # tag name
+    op: str  # eq/ne/lt/le/gt/ge/in/not_in
+    nvals: int = 1  # for in/not_in: padded set size
+
+
+@dataclass(frozen=True)
+class PlanSpec:
+    """Static jit key: everything that shapes the compiled kernel."""
+
+    tags_code: tuple[str, ...]  # tag columns shipped as global codes
+    tags_value: tuple[str, ...]  # tag columns shipped as numeric values
+    fields: tuple[str, ...]
+    preds: tuple[_PredSpec, ...]
+    group_tags: tuple[str, ...]
+    num_groups: int
+    want_minmax: bool
+    hist_field: str = ""  # non-empty -> also emit histogram partials
+    nrows: int = CHUNK
+
+
+_KERNEL_CACHE: dict[PlanSpec, object] = {}
+
+
+def _build_kernel(spec: PlanSpec):
+    """Construct + jit the per-chunk partial computation for `spec`."""
+
+    def kernel(chunk: dict, pred_vals: dict, hist_lo, hist_span):
+        valid = chunk["valid"]
+        masks = [valid]
+        for i, p in enumerate(spec.preds):
+            col = (
+                chunk["tags_code"][p.name]
+                if p.kind == "code"
+                else chunk["tags_value"][p.name]
+            )
+            v = pred_vals[f"p{i}"]
+            if p.op in ("in", "not_in"):
+                m = ops.in_set_mask(col, v)
+                masks.append(~m if p.op == "not_in" else m)
+            else:
+                masks.append(ops.cmp_mask(col, p.op, v))
+        mask = ops.mask_and(*masks)
+
+        # Group key from global codes; radices are static per plan and live
+        # in the _RADICES side table (kept off the hashable spec).
+        key_cols = [chunk["tags_code"][t] for t in spec.group_tags]
+        if key_cols:
+            key, _ = ops.mixed_radix_key(key_cols, _RADICES[spec])
+        else:
+            key = jnp.zeros_like(chunk["series"])
+
+        res = ops.group_reduce(
+            key,
+            mask,
+            chunk["fields"],
+            spec.num_groups,
+            want_minmax=spec.want_minmax,
+        )
+        out = {
+            "count": res.count,
+            "sums": res.sums,
+            "mins": res.mins,
+            "maxs": res.maxs,
+        }
+        if spec.hist_field:
+            out["hist"] = _histogram_counts(
+                key,
+                mask,
+                chunk["fields"][spec.hist_field],
+                spec.num_groups,
+                hist_lo,
+                hist_span,
+            )
+        return out
+
+    return jax.jit(kernel)
+
+
+def _histogram_counts(key, mask, values, num_groups, lo, span):
+    """[G, B] float32 histogram partials with traced lo/span."""
+    assert (num_groups + 1) * _NUM_HIST_BUCKETS < 2**31, (
+        "histogram segment ids overflow int32"
+    )
+    width = span / _NUM_HIST_BUCKETS
+    bucket = jnp.clip(
+        ((values - lo) / width).astype(jnp.int32), 0, _NUM_HIST_BUCKETS - 1
+    )
+    safe_key = jnp.where(mask, key, jnp.int32(num_groups))
+    combined = safe_key * jnp.int32(_NUM_HIST_BUCKETS) + bucket
+    return jax.ops.segment_sum(
+        mask.astype(jnp.float32),
+        combined,
+        num_segments=(num_groups + 1) * _NUM_HIST_BUCKETS,
+    ).reshape(num_groups + 1, _NUM_HIST_BUCKETS)[:num_groups]
+
+
+# Radices can't live on the frozen dataclass (they'd bloat the hash) — they
+# are a parallel table keyed by the spec instance content.
+_RADICES: dict[PlanSpec, tuple[int, ...]] = {}
+
+
+class GlobalDicts:
+    """Union of per-source tag dictionaries -> stable global codes."""
+
+    def __init__(self, tag_names: Sequence[str]):
+        self.maps: dict[str, dict[bytes, int]] = {t: {} for t in tag_names}
+
+    def add_source(self, tag: str, d: list[bytes]) -> np.ndarray:
+        """-> LUT local_code -> global_code for one source."""
+        m = self.maps[tag]
+        lut = np.empty(len(d), dtype=np.int32)
+        for i, v in enumerate(d):
+            lut[i] = m.setdefault(v, len(m))
+        return lut
+
+    def size(self, tag: str) -> int:
+        return max(len(self.maps[tag]), 1)
+
+    def code_of(self, tag: str, value: bytes) -> int:
+        return self.maps[tag].get(value, -1)
+
+    def values(self, tag: str) -> list[bytes]:
+        m = self.maps[tag]
+        out = [b""] * len(m)
+        for v, c in m.items():
+            out[c] = v
+        return out
+
+
+def _tag_value_bytes(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode()
+    if isinstance(v, int):
+        return v.to_bytes(8, "little", signed=True)
+    raise TypeError(f"unsupported tag literal {type(v)}")
+
+
+def _collect_conditions(c: Optional[Criteria]) -> list[Condition]:
+    """Flatten an AND-tree; OR is handled by the logical planner later."""
+    if c is None:
+        return []
+    if isinstance(c, Condition):
+        return [c]
+    assert isinstance(c, LogicalExpression)
+    if c.op != "and":
+        raise NotImplementedError("OR criteria not yet supported on device")
+    return _collect_conditions(c.left) + _collect_conditions(c.right)
+
+
+def execute_aggregate(
+    measure: Measure,
+    request: QueryRequest,
+    sources: list[ColumnData],
+) -> QueryResult:
+    """Run a group-by/aggregate/top-N/percentile query over decoded sources."""
+    conds = _collect_conditions(request.criteria)
+    group_tags = tuple(request.group_by.tag_names) if request.group_by else ()
+    agg = request.agg
+
+    # --- which columns ride to the device, and in which representation ----
+    range_ops = {"lt", "le", "gt", "ge"}
+    tags_value: set[str] = set()
+    tags_code: set[str] = set(group_tags)
+    for c in conds:
+        if measure.tag(c.name).type == TagType.INT and c.op in range_ops:
+            tags_value.add(c.name)
+        else:
+            tags_code.add(c.name)
+    fields = set(request.field_projection)
+    if agg:
+        fields.add(agg.field_name)
+    if request.top:
+        fields.add(request.top.field_name)
+
+    # --- global dictionaries + remapped concatenated columns --------------
+    gd = GlobalDicts(sorted(tags_code))
+    chunks_np = _gather_rows(
+        sources,
+        sorted(tags_code),
+        sorted(tags_value),
+        sorted(fields),
+        gd,
+        request.time_range.begin_millis,
+        request.time_range.end_millis,
+    )
+    n = chunks_np["ts"].shape[0]
+
+    # --- plan signature ---------------------------------------------------
+    pred_specs = []
+    pred_vals: dict[str, jax.Array] = {}
+    for i, c in enumerate(conds):
+        if c.name in tags_value:
+            pred_specs.append(_PredSpec("value", c.name, c.op))
+            pred_vals[f"p{i}"] = jnp.int32(int(c.value))
+        else:
+            if c.op in ("in", "not_in"):
+                vals = [gd.code_of(c.name, _tag_value_bytes(v)) for v in c.value]
+                arr = np.asarray(vals or [-1], dtype=np.int32)
+                pred_specs.append(_PredSpec("code", c.name, c.op, nvals=len(arr)))
+                pred_vals[f"p{i}"] = jnp.asarray(arr)
+            else:
+                code = gd.code_of(c.name, _tag_value_bytes(c.value))
+                pred_specs.append(_PredSpec("code", c.name, c.op))
+                pred_vals[f"p{i}"] = jnp.int32(code)
+
+    radices = tuple(gd.size(t) for t in group_tags)
+    num_groups = 1
+    for r in radices:
+        num_groups *= r
+
+    want_percentile = bool(agg and agg.function == "percentile")
+    hist_field = agg.field_name if want_percentile else ""
+    want_minmax = not agg or agg.function in ("min", "max")
+
+    nrows = CHUNK if n > CHUNK else pad_rows_bucket(max(n, 1))
+    spec = PlanSpec(
+        tags_code=tuple(sorted(tags_code)),
+        tags_value=tuple(sorted(tags_value)),
+        fields=tuple(sorted(fields)),
+        preds=tuple(pred_specs),
+        group_tags=group_tags,
+        num_groups=max(num_groups, 1),
+        want_minmax=want_minmax,
+        hist_field=hist_field,
+        nrows=nrows,
+    )
+    _RADICES[spec] = radices
+    kernel = _KERNEL_CACHE.get(spec)
+    if kernel is None:
+        kernel = _KERNEL_CACHE[spec] = _build_kernel(spec)
+
+    # --- histogram range from host stats (two-pass percentile) ------------
+    if want_percentile and n:
+        fv = chunks_np["fields"][hist_field]
+        hist_lo = float(fv.min())
+        hist_span = max(float(fv.max()) - hist_lo, 1e-6)
+    else:
+        hist_lo, hist_span = 0.0, 1.0
+
+    # --- run chunks, combine partials ------------------------------------
+    G = spec.num_groups
+    count = np.zeros(G, dtype=np.float64)
+    sums = {f: np.zeros(G, dtype=np.float64) for f in spec.fields}
+    mins = {f: np.full(G, np.inf) for f in spec.fields}
+    maxs = {f: np.full(G, -np.inf) for f in spec.fields}
+    hist = np.zeros((G, _NUM_HIST_BUCKETS), dtype=np.float64) if want_percentile else None
+
+    epoch = int(chunks_np["ts"][0]) if n else 0
+    for start in range(0, max(n, 1), spec.nrows):
+        end = min(start + spec.nrows, n)
+        if end <= start:
+            break
+        chunk = _device_chunk(chunks_np, start, end, spec, epoch)
+        out = kernel(chunk, pred_vals, jnp.float32(hist_lo), jnp.float32(hist_span))
+        count += np.asarray(out["count"], dtype=np.float64)
+        for f in spec.fields:
+            sums[f] += np.asarray(out["sums"][f], dtype=np.float64)
+            if want_minmax:
+                mins[f] = np.minimum(mins[f], np.asarray(out["mins"][f]))
+                maxs[f] = np.maximum(maxs[f], np.asarray(out["maxs"][f]))
+        if hist is not None:
+            hist += np.asarray(out["hist"], dtype=np.float64)
+
+    return _finalize(
+        request, gd, group_tags, radices, count, sums, mins, maxs, hist,
+        hist_lo, hist_span,
+    )
+
+
+def _gather_rows(
+    sources: list[ColumnData],
+    tags_code: list[str],
+    tags_value: list[str],
+    fields: list[str],
+    gd: GlobalDicts,
+    begin_millis: int,
+    end_millis: int,
+) -> dict:
+    """Concatenate sources with row-exact time filtering, global-code remap
+    and version dedup (block pruning upstream is only block-granular)."""
+    ts_l, series_l, ver_l = [], [], []
+    tc_l: dict[str, list] = {t: [] for t in tags_code}
+    tv_l: dict[str, list] = {t: [] for t in tags_value}
+    f_l: dict[str, list] = {f: [] for f in fields}
+    for src in sources:
+        if src.ts.size == 0:
+            continue
+        rng = (src.ts >= begin_millis) & (src.ts < end_millis)
+        if not rng.any():
+            continue
+        ts_l.append(src.ts[rng])
+        series_l.append(src.series[rng])
+        ver_l.append(src.version[rng])
+        for t in tags_code:
+            lut = gd.add_source(t, list(src.dicts.get(t, [])))
+            codes = src.tags[t][rng]
+            tc_l[t].append(lut[codes] if lut.size else np.zeros(int(rng.sum()), np.int32))
+        for t in tags_value:
+            d = src.dicts.get(t, [])
+            vals = np.asarray(
+                [int.from_bytes(v, "little", signed=True) if v else 0 for v in d],
+                dtype=np.int64,
+            )
+            col = vals[src.tags[t][rng]] if len(d) else np.zeros(int(rng.sum()), np.int64)
+            tv_l[t].append(col.astype(np.int32))
+        for f in fields:
+            f_l[f].append(src.fields[f][rng])
+
+    if not ts_l:
+        empty = dict(
+            ts=np.zeros(0, np.int64),
+            series=np.zeros(0, np.int64),
+            tags_code={t: np.zeros(0, np.int32) for t in tags_code},
+            tags_value={t: np.zeros(0, np.int32) for t in tags_value},
+            fields={f: np.zeros(0, np.float64) for f in fields},
+        )
+        return empty
+
+    ts = np.concatenate(ts_l)
+    series = np.concatenate(series_l)
+    version = np.concatenate(ver_l)
+    # Global version dedup: keep the max-version row per (series, ts).
+    # lexsort is ascending; -version puts the winner first in its key run.
+    order = np.lexsort((-version, ts, series))
+    s_s, t_s = series[order], ts[order]
+    first = np.empty(len(order), dtype=bool)
+    first[0] = True
+    first[1:] = (s_s[1:] != s_s[:-1]) | (t_s[1:] != t_s[:-1])
+    keep = order[first]
+    keep.sort()
+
+    return dict(
+        ts=ts[keep],
+        series=series[keep],
+        tags_code={t: np.concatenate(tc_l[t])[keep] for t in tags_code},
+        tags_value={t: np.concatenate(tv_l[t])[keep] for t in tags_value},
+        fields={f: np.concatenate(f_l[f])[keep] for f in fields},
+    )
+
+
+def _device_chunk(cols: dict, start: int, end: int, spec: PlanSpec, epoch: int) -> dict:
+    """Pad one row range into the fixed chunk shape, ship to device."""
+    n = end - start
+    nb = spec.nrows
+
+    def pad(a: np.ndarray, dtype):
+        out = np.zeros((nb,), dtype=dtype)
+        out[:n] = a[start:end]
+        return jnp.asarray(out)
+
+    valid = np.zeros((nb,), dtype=bool)
+    valid[:n] = True
+    # ts offsets relative to the first row's epoch keep int32 exact; range
+    # masks are applied on absolute millis host-side during block pruning,
+    # so the residual in-chunk mask only needs relative comparisons.
+    ts_off = cols["ts"][start:end] - epoch
+    ts = np.zeros((nb,), dtype=np.int64)
+    ts[:n] = ts_off
+    return {
+        "ts": jnp.asarray(ts.astype(np.int32)),
+        "series": pad(cols["series"] % (2**31), np.int32),
+        "valid": jnp.asarray(valid),
+        "tags_code": {t: pad(cols["tags_code"][t], np.int32) for t in spec.tags_code},
+        "tags_value": {t: pad(cols["tags_value"][t], np.int32) for t in spec.tags_value},
+        "fields": {f: pad(cols["fields"][f], np.float32) for f in spec.fields},
+    }
+
+
+def _finalize(
+    request: QueryRequest,
+    gd: GlobalDicts,
+    group_tags: tuple[str, ...],
+    radices: tuple[int, ...],
+    count: np.ndarray,
+    sums: dict,
+    mins: dict,
+    maxs: dict,
+    hist: Optional[np.ndarray],
+    hist_lo: float,
+    hist_span: float,
+) -> QueryResult:
+    agg = request.agg
+    nonempty = count > 0
+    G = count.shape[0]
+
+    # Aggregate value per group for the requested function.
+    def agg_values(fn: str, field: str) -> np.ndarray:
+        if fn == "count":
+            return count
+        if fn == "sum":
+            return sums[field]
+        if fn == "mean":
+            return sums[field] / np.maximum(count, 1)
+        if fn == "min":
+            return mins[field]
+        if fn == "max":
+            return maxs[field]
+        raise ValueError(f"unknown aggregate {fn}")
+
+    result = QueryResult()
+    # Without group_by there is exactly one logical group: report it even
+    # when empty (a global count over no rows is 0, not "no result").
+    group_ids = (
+        np.asarray([0]) if not group_tags else np.nonzero(nonempty)[0]
+    )
+
+    # Top-N selection narrows the group id set.
+    if request.top and agg and agg.function != "percentile":
+        metric = agg_values(agg.function, agg.field_name)
+        metric = np.where(nonempty, metric, -np.inf if request.top.field_value_sort != "asc" else np.inf)
+        k = min(request.top.number, int(nonempty.sum()))
+        if request.top.field_value_sort == "asc":
+            sel = np.argsort(metric, kind="stable")[:k]
+        else:
+            sel = np.argsort(-metric, kind="stable")[:k]
+        group_ids = sel
+
+    group_ids = group_ids[: request.limit] if request.limit else group_ids
+
+    # Decode group tuples back to tag values.
+    if group_tags:
+        codes = np.unravel_index(group_ids, radices) if len(group_ids) else [np.zeros(0, int)] * len(radices)
+        tag_values = {t: gd.values(t) for t in group_tags}
+        for row in range(len(group_ids)):
+            result.groups.append(
+                tuple(
+                    tag_values[t][int(codes[i][row])].decode(errors="replace")
+                    for i, t in enumerate(group_tags)
+                )
+            )
+    else:
+        result.groups = [()] * len(group_ids)
+
+    if agg:
+        if agg.function == "percentile":
+            qs = list(agg.quantiles or (0.5,))
+            vals = _invert_histogram(hist, group_ids, qs, hist_lo, hist_span)
+            result.values[f"percentile({agg.field_name})"] = vals
+        else:
+            v = agg_values(agg.function, agg.field_name)[group_ids]
+            result.values[f"{agg.function}({agg.field_name})"] = v.tolist()
+    result.values["count"] = count[group_ids].tolist()
+    return result
+
+
+def _invert_histogram(
+    hist: np.ndarray, group_ids: np.ndarray, qs: list[float], lo: float, span: float
+) -> list[list[float]]:
+    width = span / _NUM_HIST_BUCKETS
+    out = []
+    for g in group_ids:
+        counts = hist[g]
+        cdf = np.cumsum(counts)
+        total = cdf[-1]
+        row = []
+        for q in qs:
+            if total <= 0:
+                row.append(lo)
+                continue
+            target = min(max(np.ceil(q * total), 1), total)
+            hit = int(np.argmax(cdf >= target))
+            prev = cdf[hit] - counts[hit]
+            frac = (target - prev) / max(counts[hit], 1.0)
+            row.append(lo + (hit + min(max(frac, 0.0), 1.0)) * width)
+        out.append(row)
+    return out

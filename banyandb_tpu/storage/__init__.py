@@ -1,0 +1,2 @@
+"""Storage substrate: columnar parts, memtables, time-segmented shards,
+snapshot MVCC (the reference's banyand/internal/storage analog)."""

@@ -1,0 +1,77 @@
+"""In-memory write buffer (the reference's memPart,
+banyand/measure/tstable.go mustAddDataPoints path).
+
+Accumulates rows column-wise with string-tag interning so a flush is a
+sort + encode, and a query over hot data can build a device batch without
+re-parsing rows.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping
+
+import numpy as np
+
+from banyandb_tpu.storage.part import ColumnData
+
+
+class MemTable:
+    def __init__(self, tag_names: list[str], field_names: list[str]):
+        self._lock = threading.Lock()
+        self.tag_names = list(tag_names)
+        self.field_names = list(field_names)
+        self._ts: list[int] = []
+        self._series: list[int] = []
+        self._version: list[int] = []
+        self._tag_codes: dict[str, list[int]] = {t: [] for t in tag_names}
+        self._dicts: dict[str, dict[bytes, int]] = {t: {} for t in tag_names}
+        self._fields: dict[str, list[float]] = {f: [] for f in field_names}
+
+    def __len__(self) -> int:
+        return len(self._ts)
+
+    def append(
+        self,
+        ts_millis: int,
+        series_id: int,
+        version: int,
+        tag_values: Mapping[str, bytes],
+        field_values: Mapping[str, float],
+    ) -> None:
+        with self._lock:
+            self._ts.append(ts_millis)
+            self._series.append(series_id)
+            self._version.append(version)
+            for t in self.tag_names:
+                d = self._dicts[t]
+                v = tag_values.get(t, b"")
+                code = d.setdefault(v, len(d))
+                self._tag_codes[t].append(code)
+            for f in self.field_names:
+                self._fields[f].append(float(field_values.get(f, 0.0)))
+
+    def drain(self) -> list[tuple[str, ColumnData, dict]]:
+        """Flush protocol: [(part-name-suffix, columns, extra metadata)]."""
+        return [("", self.snapshot_columns(), {})]
+
+    def snapshot_columns(self) -> ColumnData:
+        """Columnar view of the buffered rows (for hot-data queries/flush)."""
+        with self._lock:
+            return ColumnData(
+                ts=np.asarray(self._ts, dtype=np.int64),
+                series=np.asarray(self._series, dtype=np.int64),
+                version=np.asarray(self._version, dtype=np.int64),
+                tags={
+                    t: np.asarray(self._tag_codes[t], dtype=np.int32)
+                    for t in self.tag_names
+                },
+                fields={
+                    f: np.asarray(self._fields[f], dtype=np.float64)
+                    for f in self.field_names
+                },
+                dicts={
+                    t: [v for v, _ in sorted(self._dicts[t].items(), key=lambda kv: kv[1])]
+                    for t in self.tag_names
+                },
+            )

@@ -1,0 +1,242 @@
+"""On-disk columnar part format.
+
+Layout analog of the reference's measure part
+(banyand/measure/part.go:48-52 — meta.bin, primary.bin, timestamps.bin,
+fv.bin, per-family tag files, metadata.json) redesigned so decoded columns
+land directly in device-feedable dense arrays:
+
+    part-<id>/
+      metadata.json        # part-level stats + column inventory
+      primary.bin          # zstd(JSON block index: per-block column extents)
+      timestamps.bin       # per-block encoded int64 columns, concatenated
+      series.bin           # per-block encoded series ids
+      versions.bin         # per-block encoded write versions
+      tag_<name>.bin       # per-block encoded dictionary codes
+      tag_<name>.dict      # part-level dictionary (string table)
+      field_<name>.bin     # per-block encoded numeric values
+
+Rows are sorted by (series_id, ts); blocks cap at 8192 rows
+(ops.blocks.MAX_ROWS, mirroring banyand/measure/measure.go:46).  Every
+block records (offset, size) per column plus min/max ts + series for
+pruning, so a query reads only the byte ranges its time range needs.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from banyandb_tpu.ops.blocks import MAX_ROWS
+from banyandb_tpu.utils import compress as zst
+from banyandb_tpu.utils import encoding as enc
+from banyandb_tpu.utils import fs
+
+_TS = "timestamps"
+_SERIES = "series"
+_VERSIONS = "versions"
+
+
+@dataclass(frozen=True)
+class ColumnData:
+    """Decoded columns for a run of selected blocks (host numpy)."""
+
+    ts: np.ndarray  # int64 [n]
+    series: np.ndarray  # int64 [n]
+    version: np.ndarray  # int64 [n]
+    tags: Mapping[str, np.ndarray]  # int32 codes [n]
+    fields: Mapping[str, np.ndarray]  # float64 [n]
+    dicts: Mapping[str, list[bytes]]  # per-tag dictionary
+
+
+def _col_file(name: str) -> str:
+    if name in (_TS, _SERIES, _VERSIONS):
+        return f"{name}.bin"
+    return f"{name}.bin"
+
+
+class PartWriter:
+    """Builds one immutable part from sorted columnar data."""
+
+    @staticmethod
+    def write(
+        part_dir: str | Path,
+        *,
+        ts: np.ndarray,
+        series: np.ndarray,
+        version: np.ndarray,
+        tag_codes: Mapping[str, np.ndarray],
+        tag_dicts: Mapping[str, list[bytes]],
+        fields: Mapping[str, np.ndarray],
+        extra_meta: Optional[Mapping] = None,
+    ) -> None:
+        part_dir = Path(part_dir)
+        part_dir.mkdir(parents=True, exist_ok=False)
+        n = len(ts)
+        order = np.lexsort((ts, series))
+        ts, series, version = ts[order], series[order], version[order]
+        tag_codes = {k: v[order] for k, v in tag_codes.items()}
+        fields = {k: v[order] for k, v in fields.items()}
+
+        blocks = []
+        buffers: dict[str, bytearray] = {}
+
+        def append(col: str, blob: bytes) -> tuple[int, int]:
+            buf = buffers.setdefault(col, bytearray())
+            off = len(buf)
+            buf.extend(blob)
+            return off, len(blob)
+
+        for start in range(0, max(n, 1), MAX_ROWS):
+            end = min(start + MAX_ROWS, n)
+            if end <= start:
+                break
+            sl = slice(start, end)
+            extents = {
+                _TS: append(_TS, enc.encode_int64(ts[sl])),
+                _SERIES: append(_SERIES, enc.encode_int64(series[sl])),
+                _VERSIONS: append(_VERSIONS, enc.encode_int64(version[sl])),
+            }
+            for name, codes in tag_codes.items():
+                extents[f"tag_{name}"] = append(
+                    f"tag_{name}", enc.encode_dict_codes(codes[sl])
+                )
+            for name, vals in fields.items():
+                extents[f"field_{name}"] = append(
+                    f"field_{name}", enc.encode_float64(vals[sl])
+                )
+            blocks.append(
+                {
+                    "count": end - start,
+                    "min_ts": int(ts[sl].min()),
+                    "max_ts": int(ts[sl].max()),
+                    "min_series": int(series[sl].min()),
+                    "max_series": int(series[sl].max()),
+                    "extents": {k: list(v) for k, v in extents.items()},
+                }
+            )
+
+        for col, buf in buffers.items():
+            fs.atomic_write(part_dir / _col_file(col), bytes(buf))
+        for name, d in tag_dicts.items():
+            fs.atomic_write(part_dir / f"tag_{name}.dict", enc.encode_strings(d))
+        fs.atomic_write(part_dir / "primary.bin", zst.compress(json.dumps(blocks).encode()))
+        meta = {
+            "total_count": int(n),
+            "blocks": len(blocks),
+            "min_ts": int(ts.min()) if n else 0,
+            "max_ts": int(ts.max()) if n else 0,
+            "tags": sorted(tag_codes.keys()),
+            "fields": sorted(fields.keys()),
+        }
+        if extra_meta:
+            meta.update(extra_meta)
+        fs.atomic_write_json(part_dir / "metadata.json", meta)
+
+
+class Part:
+    """Immutable on-disk part: block pruning + selective column reads."""
+
+    def __init__(self, part_dir: str | Path):
+        self.dir = Path(part_dir)
+        self.meta = fs.read_json(self.dir / "metadata.json")
+        with open(self.dir / "primary.bin", "rb") as f:
+            self.blocks = json.loads(zst.decompress(f.read()))
+        self._dicts: dict[str, list[bytes]] = {}
+
+    @property
+    def name(self) -> str:
+        return self.dir.name
+
+    @property
+    def total_count(self) -> int:
+        return self.meta["total_count"]
+
+    @property
+    def min_ts(self) -> int:
+        return self.meta["min_ts"]
+
+    @property
+    def max_ts(self) -> int:
+        return self.meta["max_ts"]
+
+    def dict_for(self, tag: str) -> list[bytes]:
+        if tag not in self._dicts:
+            path = self.dir / f"tag_{tag}.dict"
+            if not path.exists():
+                self._dicts[tag] = []
+            else:
+                with open(path, "rb") as f:
+                    self._dicts[tag] = enc.decode_strings(f.read())
+        return self._dicts[tag]
+
+    def select_blocks(self, begin_ms: int, end_ms: int) -> list[int]:
+        """Block ids overlapping the half-open [begin, end) time range."""
+        return [
+            i
+            for i, b in enumerate(self.blocks)
+            if b["min_ts"] < end_ms and begin_ms <= b["max_ts"]
+        ]
+
+    def read(
+        self,
+        block_ids: Sequence[int],
+        *,
+        tags: Iterable[str] = (),
+        fields: Iterable[str] = (),
+    ) -> ColumnData:
+        """Decode the selected blocks' columns into host arrays."""
+        tags, fields = list(tags), list(fields)
+        cols: dict[str, list[np.ndarray]] = {}
+        handles: dict[str, object] = {}
+
+        def read_extent(col: str, block: dict) -> bytes:
+            off, size = block["extents"][col]
+            f = handles.get(col)
+            if f is None:
+                f = handles[col] = open(self.dir / _col_file(col), "rb")
+            f.seek(off)
+            return f.read(size)
+
+        try:
+            for bid in block_ids:
+                blk = self.blocks[bid]
+                cnt = blk["count"]
+                cols.setdefault(_TS, []).append(
+                    enc.decode_int64(read_extent(_TS, blk), cnt)
+                )
+                cols.setdefault(_SERIES, []).append(
+                    enc.decode_int64(read_extent(_SERIES, blk), cnt)
+                )
+                cols.setdefault(_VERSIONS, []).append(
+                    enc.decode_int64(read_extent(_VERSIONS, blk), cnt)
+                )
+                for t in tags:
+                    cols.setdefault(f"tag_{t}", []).append(
+                        enc.decode_dict_codes(read_extent(f"tag_{t}", blk), cnt)
+                    )
+                for fl in fields:
+                    cols.setdefault(f"field_{fl}", []).append(
+                        enc.decode_float64(read_extent(f"field_{fl}", blk), cnt)
+                    )
+        finally:
+            for f in handles.values():
+                f.close()
+
+        def cat(key: str, dtype) -> np.ndarray:
+            parts = cols.get(key, [])
+            if not parts:
+                return np.zeros(0, dtype=dtype)
+            return np.concatenate(parts).astype(dtype, copy=False)
+
+        return ColumnData(
+            ts=cat(_TS, np.int64),
+            series=cat(_SERIES, np.int64),
+            version=cat(_VERSIONS, np.int64),
+            tags={t: cat(f"tag_{t}", np.int32) for t in tags},
+            fields={fl: cat(f"field_{fl}", np.float64) for fl in fields},
+            dicts={t: self.dict_for(t) for t in tags},
+        )

@@ -1,0 +1,239 @@
+"""TSDB: group -> time segments -> shards -> (memtable + parts + snapshot).
+
+Analog of banyand/internal/storage (TSDBOpts tsdb.go:55, segment naming
+storage.go:46-50, snapshot MVCC snapshot.go, shard tree shard.go) rebuilt
+host-side:
+
+    <root>/<group>/
+      seg-<YYYYMMDD[HH]>/
+        shard-<i>/
+          part-<016x>/...
+          snapshot.snp        # JSON: {"epoch": N, "parts": [names]}
+
+Readers only see parts listed in the shard's current snapshot; writers
+flush memtables into new parts then atomically publish a new snapshot —
+the same MVCC contract as the reference's .snp manifests.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import threading
+from pathlib import Path
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from banyandb_tpu.api.schema import ResourceOpts
+from banyandb_tpu.storage.memtable import MemTable
+from banyandb_tpu.storage.part import ColumnData, Part, PartWriter
+from banyandb_tpu.utils import fs
+
+SNAPSHOT = "snapshot.snp"
+
+
+def segment_name(start_millis: int, interval_unit: str) -> str:
+    t = dt.datetime.fromtimestamp(start_millis / 1000, tz=dt.timezone.utc)
+    if interval_unit == "hour":
+        return f"seg-{t:%Y%m%d%H}"
+    return f"seg-{t:%Y%m%d}"
+
+
+def segment_start(ts_millis: int, interval_millis: int) -> int:
+    return ts_millis - (ts_millis % interval_millis)
+
+
+class Shard:
+    """One shard of one segment: a memtable + immutable parts + snapshot."""
+
+    def __init__(self, root: Path, mem_factory: Callable[[], MemTable]):
+        self.root = root
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._mem_factory = mem_factory
+        self.mem = mem_factory()
+        self._epoch = 0
+        self._parts: dict[str, Part] = {}
+        self._load_snapshot()
+
+    def _load_snapshot(self) -> None:
+        snp = self.root / SNAPSHOT
+        if not snp.exists():
+            return
+        data = fs.read_json(snp)
+        self._epoch = data["epoch"]
+        for name in data["parts"]:
+            pdir = self.root / name
+            if pdir.exists():
+                self._parts[name] = Part(pdir)
+
+    def _publish(self) -> None:
+        fs.atomic_write_json(
+            self.root / SNAPSHOT,
+            {"epoch": self._epoch, "parts": sorted(self._parts.keys())},
+        )
+
+    @property
+    def parts(self) -> list[Part]:
+        with self._lock:
+            return list(self._parts.values())
+
+    def flush(self) -> Optional[list[str]]:
+        """Memtable -> new part(s) + snapshot publish. Returns part names.
+
+        Multi-resource memtables (measure engines) drain to one part per
+        resource; the snapshot publish at the end is the single MVCC
+        commit point for all of them.
+        """
+        with self._lock:
+            if len(self.mem) == 0:
+                return None
+            drained = self.mem.drain()
+            self.mem = self._mem_factory()
+            names = []
+            for _suffix, cols, extra_meta in drained:
+                if cols.ts.size == 0:
+                    continue
+                self._epoch += 1
+                name = f"part-{self._epoch:016x}"
+                PartWriter.write(
+                    self.root / name,
+                    ts=cols.ts,
+                    series=cols.series,
+                    version=cols.version,
+                    tag_codes=dict(cols.tags),
+                    tag_dicts=dict(cols.dicts),
+                    fields=dict(cols.fields),
+                    extra_meta=extra_meta,
+                )
+                self._parts[name] = Part(self.root / name)
+                names.append(name)
+            self._publish()
+            return names
+
+    def replace_parts(
+        self, removed: list[str], added_dirs: list[Path]
+    ) -> None:
+        """Merge introduction: swap part sets atomically (introducer.go:114
+        mergedIntroduction analog)."""
+        with self._lock:
+            self._epoch += 1
+            for name in removed:
+                self._parts.pop(name, None)
+            for d in added_dirs:
+                self._parts[d.name] = Part(d)
+            self._publish()
+
+    def next_part_name(self) -> str:
+        with self._lock:
+            return f"part-{self._epoch + 1:016x}-m"
+
+
+class Segment:
+    """One time bucket: a shard list + [start, end) bounds."""
+
+    def __init__(
+        self,
+        root: Path,
+        start_millis: int,
+        interval_millis: int,
+        shard_num: int,
+        mem_factory: Callable[[], MemTable],
+    ):
+        self.root = root
+        self.start = start_millis
+        self.end = start_millis + interval_millis
+        self.shards = [
+            Shard(root / f"shard-{i}", mem_factory) for i in range(shard_num)
+        ]
+
+    def overlaps(self, begin: int, end: int) -> bool:
+        return self.start < end and begin < self.end
+
+
+class TSDB:
+    """Per-(group, engine) database: segment map + routing (tsdb.go:145)."""
+
+    def __init__(
+        self,
+        root: str | Path,
+        group: str,
+        opts: ResourceOpts,
+        mem_factory: Callable[[], MemTable],
+    ):
+        self.root = Path(root) / group
+        self.opts = opts
+        self.mem_factory = mem_factory
+        self._lock = threading.Lock()
+        self._segments: dict[int, Segment] = {}
+        self._reopen()
+
+    def _reopen(self) -> None:
+        """Rediscover existing segments from disk (restart path)."""
+        if not self.root.exists():
+            return
+        iv = self.opts.segment_interval
+        for seg_dir in sorted(self.root.glob("seg-*")):
+            stamp = seg_dir.name[4:]
+            if iv.unit == "hour":
+                t = dt.datetime.strptime(stamp, "%Y%m%d%H")
+            else:
+                t = dt.datetime.strptime(stamp, "%Y%m%d")
+            start = int(t.replace(tzinfo=dt.timezone.utc).timestamp() * 1000)
+            self._segments[start] = Segment(
+                seg_dir, start, iv.millis, self.opts.shard_num, self.mem_factory
+            )
+
+    def segment_for(self, ts_millis: int, create: bool = True) -> Optional[Segment]:
+        iv = self.opts.segment_interval
+        start = segment_start(ts_millis, iv.millis)
+        with self._lock:
+            seg = self._segments.get(start)
+            if seg is None and create:
+                seg = Segment(
+                    self.root / segment_name(start, iv.unit),
+                    start,
+                    iv.millis,
+                    self.opts.shard_num,
+                    self.mem_factory,
+                )
+                self._segments[start] = seg
+            return seg
+
+    def select_segments(self, begin: int, end: int) -> list[Segment]:
+        """Segments overlapping [begin, end) (storage.go:118 analog)."""
+        with self._lock:
+            return [
+                s
+                for _, s in sorted(self._segments.items())
+                if s.overlaps(begin, end)
+            ]
+
+    @property
+    def segments(self) -> list[Segment]:
+        with self._lock:
+            return [s for _, s in sorted(self._segments.items())]
+
+    def flush_all(self) -> list[str]:
+        flushed = []
+        for seg in self.segments:
+            for shard in seg.shards:
+                names = shard.flush()
+                for name in names or []:
+                    flushed.append(f"{seg.root.name}/{shard.root.name}/{name}")
+        return flushed
+
+    def retention_sweep(self, now_millis: int) -> list[str]:
+        """Delete segments past TTL (rotation.go retentionTask analog)."""
+        import shutil
+
+        cutoff = now_millis - self.opts.ttl.millis
+        removed = []
+        with self._lock:
+            for start in list(self._segments.keys()):
+                seg = self._segments[start]
+                if seg.end <= cutoff:
+                    shutil.rmtree(seg.root, ignore_errors=True)
+                    removed.append(seg.root.name)
+                    del self._segments[start]
+        return removed

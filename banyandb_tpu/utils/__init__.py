@@ -1,0 +1,2 @@
+"""Platform utilities (the reference's L0: pkg/fs, pkg/encoding,
+pkg/compress, pkg/timestamp, pkg/convert analogs)."""

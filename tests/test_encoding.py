@@ -1,0 +1,80 @@
+"""Round-trip tests for host codecs (pkg/encoding analog) + part format."""
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.utils import compress as zst
+from banyandb_tpu.utils import encoding as enc
+from banyandb_tpu.utils import hashing
+
+
+RNG = np.random.default_rng(11)
+
+
+def test_zstd_roundtrip():
+    data = bytes(RNG.integers(0, 255, 10_000, dtype=np.uint8)) * 3
+    frame = zst.compress(data)
+    assert zst.decompress(frame) == data
+    assert len(frame) < len(data)
+
+
+def test_int64_const():
+    v = np.full(500, 42, dtype=np.int64)
+    blob = enc.encode_int64(v)
+    assert len(blob) < 20
+    np.testing.assert_array_equal(enc.decode_int64(blob, 500), v)
+
+
+def test_int64_delta_regular():
+    v = np.arange(0, 100_000, 100, dtype=np.int64) + 1_700_000_000_000
+    blob = enc.encode_int64(v)
+    np.testing.assert_array_equal(enc.decode_int64(blob, len(v)), v)
+    # regular deltas downcast to i8/i16 -> strong compression
+    assert len(blob) < len(v)
+
+
+def test_int64_random():
+    v = RNG.integers(-(2**60), 2**60, 1000)
+    blob = enc.encode_int64(v)
+    np.testing.assert_array_equal(enc.decode_int64(blob, len(v)), v)
+
+
+def test_int64_empty_and_single():
+    np.testing.assert_array_equal(
+        enc.decode_int64(enc.encode_int64(np.zeros(0, np.int64)), 0), []
+    )
+    np.testing.assert_array_equal(
+        enc.decode_int64(enc.encode_int64(np.asarray([7], np.int64)), 1), [7]
+    )
+
+
+def test_float_decimal_mantissa():
+    v = np.round(RNG.uniform(0, 100, 1000), 2)  # 2 decimal places
+    blob = enc.encode_float64(v)
+    assert blob[0] == 4  # _MODE_FLOAT_INT
+    np.testing.assert_array_equal(enc.decode_float64(blob, len(v)), v)
+
+
+def test_float_raw_fallback():
+    v = RNG.standard_normal(100)
+    blob = enc.encode_float64(v)
+    np.testing.assert_array_equal(enc.decode_float64(blob, len(v)), v)
+
+
+def test_dict_codes_roundtrip():
+    codes = RNG.integers(0, 300, 5000)
+    blob = enc.encode_dict_codes(codes)
+    np.testing.assert_array_equal(enc.decode_dict_codes(blob, len(codes)), codes)
+
+
+def test_strings_roundtrip():
+    vals = [b"hello", b"", b"world" * 100, bytes(RNG.integers(0, 255, 33, dtype=np.uint8))]
+    assert enc.decode_strings(enc.encode_strings(vals)) == vals
+
+
+def test_series_hash_stable_and_sharded():
+    sid = hashing.series_id([b"svc-1", b"instance-9"])
+    assert sid == hashing.series_id([b"svc-1", b"instance-9"])
+    assert sid != hashing.series_id([b"svc-1", b"instance-8"])
+    assert 0 <= sid < 2**63
+    assert hashing.shard_id(sid, 4) == sid % 4

@@ -12,11 +12,11 @@ RNG = np.random.default_rng(7)
 
 
 def test_delta_decode_matches_numpy():
+    # Same layout the on-disk encoder produces: first + np.diff payload.
     vals = RNG.integers(-1000, 1000, size=257).cumsum().astype(np.int32)
-    first = vals[0]
-    deltas = np.diff(vals, prepend=first).astype(np.int32)
-    deltas[0] = vals[0] - first  # 0
-    out = ops.delta_decode(jnp.int32(first), jnp.asarray(deltas))
+    deltas = np.diff(vals).astype(np.int32)
+    out = ops.delta_decode(jnp.int32(vals[0]), jnp.asarray(deltas))
+    assert out.shape[-1] == len(vals)
     np.testing.assert_array_equal(np.asarray(out), vals)
 
 
