@@ -77,15 +77,17 @@ class MeasureEngine:
             field_vals = {
                 f.name: float(p.fields.get(f.name, 0)) for f in m.fields
             }
-            seg.shards[shard].mem.append_measure(
-                m.name,
-                [t.name for t in m.tags],
-                [f.name for f in m.fields],
-                p.ts_millis,
-                sid,
-                version,
-                tag_bytes,
-                field_vals,
+            seg.shards[shard].ingest(
+                lambda mem: mem.append_measure(
+                    m.name,
+                    [t.name for t in m.tags],
+                    [f.name for f in m.fields],
+                    p.ts_millis,
+                    sid,
+                    version,
+                    tag_bytes,
+                    field_vals,
+                )
             )
             n += 1
         return n
@@ -177,6 +179,9 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
     """
     res = QueryResult()
     conds = measure_exec._collect_conditions(req.criteria)
+    for c in conds:
+        m.tag(c.name)  # schema validation: typo'd tag -> KeyError, matching
+        # the aggregate path instead of silently returning unfiltered rows
     rows: list[tuple] = []
     for src in sources:
         if src.ts.size == 0:
@@ -187,7 +192,10 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
         for c in conds:
             col = src.tags.get(c.name)
             if col is None:
-                continue
+                # Source predates the tag: every row has the "absent" value,
+                # which matches nothing for eq/in and everything for ne.
+                # (-2 so it also misses the -1 "literal not in dict" code.)
+                col = np.full(src.ts.shape, -2, dtype=np.int32)
             d = src.dicts.get(c.name, [])
             lut = {v: i for i, v in enumerate(d)}
             if c.op == "eq":

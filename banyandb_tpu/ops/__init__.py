@@ -21,5 +21,8 @@ from banyandb_tpu.ops.groupby import (
     GroupReduceResult,
 )
 from banyandb_tpu.ops.topk import topk_groups
-from banyandb_tpu.ops.percentile import group_percentile_histogram
+from banyandb_tpu.ops.percentile import (
+    group_histogram,
+    group_percentile_histogram,
+)
 from banyandb_tpu.ops.dedup import latest_by_version

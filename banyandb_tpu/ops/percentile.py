@@ -15,6 +15,42 @@ import jax
 import jax.numpy as jnp
 
 
+def group_histogram(
+    key: jax.Array,
+    valid: jax.Array,
+    values: jax.Array,
+    num_groups: int,
+    lo,
+    span,
+    num_buckets: int = 512,
+) -> jax.Array:
+    """-> f32 [num_groups, num_buckets] per-group counts over [lo, lo+span].
+
+    `lo`/`span` may be traced scalars (two-pass percentile reuses one
+    compiled kernel across queries). The single shared histogram kernel —
+    percentile, the measure executor, and the distributed step all call
+    this.
+    """
+    if (num_groups + 1) * num_buckets >= 2**31:
+        # The combined (group, bucket) segment id must fit int32 or scatter
+        # indices silently wrap under jit (same guard as mixed_radix_key).
+        raise ValueError(
+            f"num_groups={num_groups} x num_buckets={num_buckets} "
+            "overflows int32 segment ids"
+        )
+    width = span / num_buckets
+    bucket = jnp.clip(
+        ((values - lo) / width).astype(jnp.int32), 0, num_buckets - 1
+    )
+    safe_key = jnp.where(valid, key, jnp.int32(num_groups))
+    combined = safe_key * jnp.int32(num_buckets) + bucket
+    return jax.ops.segment_sum(
+        valid.astype(jnp.float32),
+        combined,
+        num_segments=(num_groups + 1) * num_buckets,
+    ).reshape(num_groups + 1, num_buckets)[:num_groups]
+
+
 def group_percentile_histogram(
     key: jax.Array,
     valid: jax.Array,
@@ -30,25 +66,11 @@ def group_percentile_histogram(
 
     Values are clamped into [lo, hi]; empty groups return lo.
     """
-    if (num_groups + 1) * num_buckets >= 2**31:
-        # The combined (group, bucket) segment id must fit int32 or scatter
-        # indices silently wrap under jit (same guard as mixed_radix_key).
-        raise ValueError(
-            f"num_groups={num_groups} x num_buckets={num_buckets} "
-            "overflows int32 segment ids"
-        )
     q = jnp.asarray(quantiles, dtype=jnp.float32)
     width = (hi - lo) / num_buckets
-    bucket = jnp.clip(
-        ((values - lo) / width).astype(jnp.int32), 0, num_buckets - 1
+    counts = group_histogram(
+        key, valid, values, num_groups, lo, hi - lo, num_buckets
     )
-    safe_key = jnp.where(valid, key, jnp.int32(num_groups))
-    combined = safe_key * jnp.int32(num_buckets) + bucket
-    counts = jax.ops.segment_sum(
-        valid.astype(jnp.float32),
-        combined,
-        num_segments=(num_groups + 1) * num_buckets,
-    ).reshape(num_groups + 1, num_buckets)[:num_groups]
 
     cdf = jnp.cumsum(counts, axis=-1)  # [G, B]
     total = cdf[:, -1:]  # [G, 1]

@@ -1,0 +1,15 @@
+"""Distributed execution over jax.sharding meshes.
+
+The reference scales queries by scatter-gather over data nodes with proto
+partial-aggregate exchange (pkg/query/logical/measure/measure_plan_distributed.go:296,
+docs/concept/distributed-measure-aggregation.md).  Here the same map-reduce
+shape rides the device mesh: each device scans its shard's chunk and the
+partial combine is an XLA collective (psum over ICI), not a proto hop.
+"""
+
+from banyandb_tpu.parallel.mesh import make_mesh, shard_axis_size
+from banyandb_tpu.parallel.dist_exec import (
+    DistPlan,
+    distributed_aggregate,
+    stack_shard_chunks,
+)

@@ -1,0 +1,33 @@
+"""Mesh construction helpers.
+
+Axis vocabulary (DB analog of dp/tp/sp):
+- ``shard``: data parallelism over storage shards — each device scans the
+  rows its shard owns (the reference's per-data-node scan).
+- ``seg``: segment/time parallelism within a shard — blocks of the same
+  shard spread over a second axis (the reference scans segments
+  concurrently per node).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_shard: int, n_seg: int = 1, *, devices=None
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    need = n_shard * n_seg
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh {n_shard}x{n_seg} needs {need} devices, have {len(devices)}"
+        )
+    import numpy as np
+
+    arr = np.asarray(devices[:need]).reshape(n_shard, n_seg)
+    return Mesh(arr, ("shard", "seg"))
+
+
+def shard_axis_size(mesh: Mesh) -> int:
+    return mesh.shape["shard"]

@@ -43,12 +43,20 @@ _NUM_HIST_BUCKETS = 512
 
 @dataclass(frozen=True)
 class _PredSpec:
-    """Static shape of one predicate; its value(s) arrive as traced args."""
+    """Static shape of one predicate; its value(s) arrive as traced args.
 
-    kind: str  # "code" (dict-code compare) | "value" (numeric compare)
+    kinds:
+    - "code": compare against a global dictionary code (eq/ne) or a padded
+      code set (in/not_in).
+    - "lut":  a bool lookup table over global codes — how numeric range
+      predicates on INT tags evaluate without shipping 64-bit tag values
+      to the device (the host computes op(dict_value, literal) per code).
+    """
+
+    kind: str  # "code" | "lut"
     name: str  # tag name
-    op: str  # eq/ne/lt/le/gt/ge/in/not_in
-    nvals: int = 1  # for in/not_in: padded set size
+    op: str  # eq/ne/in/not_in (code) | lt/le/gt/ge (lut)
+    nvals: int = 1  # in/not_in set size or LUT length (static shape)
 
 
 @dataclass(frozen=True)
@@ -56,10 +64,10 @@ class PlanSpec:
     """Static jit key: everything that shapes the compiled kernel."""
 
     tags_code: tuple[str, ...]  # tag columns shipped as global codes
-    tags_value: tuple[str, ...]  # tag columns shipped as numeric values
     fields: tuple[str, ...]
     preds: tuple[_PredSpec, ...]
     group_tags: tuple[str, ...]
+    radices: tuple[int, ...]  # global dict size per group tag
     num_groups: int
     want_minmax: bool
     hist_field: str = ""  # non-empty -> also emit histogram partials
@@ -76,24 +84,20 @@ def _build_kernel(spec: PlanSpec):
         valid = chunk["valid"]
         masks = [valid]
         for i, p in enumerate(spec.preds):
-            col = (
-                chunk["tags_code"][p.name]
-                if p.kind == "code"
-                else chunk["tags_value"][p.name]
-            )
+            col = chunk["tags_code"][p.name]
             v = pred_vals[f"p{i}"]
-            if p.op in ("in", "not_in"):
+            if p.kind == "lut":
+                masks.append(jnp.take(v, col, mode="clip"))
+            elif p.op in ("in", "not_in"):
                 m = ops.in_set_mask(col, v)
                 masks.append(~m if p.op == "not_in" else m)
             else:
                 masks.append(ops.cmp_mask(col, p.op, v))
         mask = ops.mask_and(*masks)
 
-        # Group key from global codes; radices are static per plan and live
-        # in the _RADICES side table (kept off the hashable spec).
         key_cols = [chunk["tags_code"][t] for t in spec.group_tags]
         if key_cols:
-            key, _ = ops.mixed_radix_key(key_cols, _RADICES[spec])
+            key, _ = ops.mixed_radix_key(key_cols, spec.radices)
         else:
             key = jnp.zeros_like(chunk["series"])
 
@@ -111,40 +115,18 @@ def _build_kernel(spec: PlanSpec):
             "maxs": res.maxs,
         }
         if spec.hist_field:
-            out["hist"] = _histogram_counts(
+            out["hist"] = ops.group_histogram(
                 key,
                 mask,
                 chunk["fields"][spec.hist_field],
                 spec.num_groups,
                 hist_lo,
                 hist_span,
+                _NUM_HIST_BUCKETS,
             )
         return out
 
     return jax.jit(kernel)
-
-
-def _histogram_counts(key, mask, values, num_groups, lo, span):
-    """[G, B] float32 histogram partials with traced lo/span."""
-    assert (num_groups + 1) * _NUM_HIST_BUCKETS < 2**31, (
-        "histogram segment ids overflow int32"
-    )
-    width = span / _NUM_HIST_BUCKETS
-    bucket = jnp.clip(
-        ((values - lo) / width).astype(jnp.int32), 0, _NUM_HIST_BUCKETS - 1
-    )
-    safe_key = jnp.where(mask, key, jnp.int32(num_groups))
-    combined = safe_key * jnp.int32(_NUM_HIST_BUCKETS) + bucket
-    return jax.ops.segment_sum(
-        mask.astype(jnp.float32),
-        combined,
-        num_segments=(num_groups + 1) * _NUM_HIST_BUCKETS,
-    ).reshape(num_groups + 1, _NUM_HIST_BUCKETS)[:num_groups]
-
-
-# Radices can't live on the frozen dataclass (they'd bloat the hash) — they
-# are a parallel table keyed by the spec instance content.
-_RADICES: dict[PlanSpec, tuple[int, ...]] = {}
 
 
 class GlobalDicts:
@@ -207,15 +189,12 @@ def execute_aggregate(
     group_tags = tuple(request.group_by.tag_names) if request.group_by else ()
     agg = request.agg
 
-    # --- which columns ride to the device, and in which representation ----
+    # --- which columns ride to the device ---------------------------------
     range_ops = {"lt", "le", "gt", "ge"}
-    tags_value: set[str] = set()
     tags_code: set[str] = set(group_tags)
     for c in conds:
-        if measure.tag(c.name).type == TagType.INT and c.op in range_ops:
-            tags_value.add(c.name)
-        else:
-            tags_code.add(c.name)
+        measure.tag(c.name)  # validate against schema (KeyError on typo)
+        tags_code.add(c.name)
     fields = set(request.field_projection)
     if agg:
         fields.add(agg.field_name)
@@ -227,7 +206,6 @@ def execute_aggregate(
     chunks_np = _gather_rows(
         sources,
         sorted(tags_code),
-        sorted(tags_value),
         sorted(fields),
         gd,
         request.time_range.begin_millis,
@@ -239,19 +217,40 @@ def execute_aggregate(
     pred_specs = []
     pred_vals: dict[str, jax.Array] = {}
     for i, c in enumerate(conds):
-        if c.name in tags_value:
-            pred_specs.append(_PredSpec("value", c.name, c.op))
-            pred_vals[f"p{i}"] = jnp.int32(int(c.value))
-        else:
-            if c.op in ("in", "not_in"):
-                vals = [gd.code_of(c.name, _tag_value_bytes(v)) for v in c.value]
-                arr = np.asarray(vals or [-1], dtype=np.int32)
-                pred_specs.append(_PredSpec("code", c.name, c.op, nvals=len(arr)))
-                pred_vals[f"p{i}"] = jnp.asarray(arr)
+        if c.op in range_ops:
+            # Numeric range on an INT tag: evaluate op(dict_value, literal)
+            # host-side per global code -> bool LUT gathered on device.
+            # 64-bit tag values never leave the host (int32-safe kernel).
+            if measure.tag(c.name).type != TagType.INT:
+                raise TypeError(f"range op {c.op} on non-INT tag {c.name}")
+            dvals = np.asarray(
+                [
+                    int.from_bytes(v, "little", signed=True) if v else 0
+                    for v in gd.values(c.name)
+                ],
+                dtype=np.int64,
+            )
+            if dvals.size == 0:
+                dvals = np.zeros(1, dtype=np.int64)
+                lut = np.zeros(1, dtype=bool)
             else:
-                code = gd.code_of(c.name, _tag_value_bytes(c.value))
-                pred_specs.append(_PredSpec("code", c.name, c.op))
-                pred_vals[f"p{i}"] = jnp.int32(code)
+                lut = {
+                    "lt": dvals < int(c.value),
+                    "le": dvals <= int(c.value),
+                    "gt": dvals > int(c.value),
+                    "ge": dvals >= int(c.value),
+                }[c.op]
+            pred_specs.append(_PredSpec("lut", c.name, c.op, nvals=len(lut)))
+            pred_vals[f"p{i}"] = jnp.asarray(lut)
+        elif c.op in ("in", "not_in"):
+            vals = [gd.code_of(c.name, _tag_value_bytes(v)) for v in c.value]
+            arr = np.asarray(vals or [-1], dtype=np.int32)
+            pred_specs.append(_PredSpec("code", c.name, c.op, nvals=len(arr)))
+            pred_vals[f"p{i}"] = jnp.asarray(arr)
+        else:
+            code = gd.code_of(c.name, _tag_value_bytes(c.value))
+            pred_specs.append(_PredSpec("code", c.name, c.op))
+            pred_vals[f"p{i}"] = jnp.int32(code)
 
     radices = tuple(gd.size(t) for t in group_tags)
     num_groups = 1
@@ -265,16 +264,15 @@ def execute_aggregate(
     nrows = CHUNK if n > CHUNK else pad_rows_bucket(max(n, 1))
     spec = PlanSpec(
         tags_code=tuple(sorted(tags_code)),
-        tags_value=tuple(sorted(tags_value)),
         fields=tuple(sorted(fields)),
         preds=tuple(pred_specs),
         group_tags=group_tags,
+        radices=radices,
         num_groups=max(num_groups, 1),
         want_minmax=want_minmax,
         hist_field=hist_field,
         nrows=nrows,
     )
-    _RADICES[spec] = radices
     kernel = _KERNEL_CACHE.get(spec)
     if kernel is None:
         kernel = _KERNEL_CACHE[spec] = _build_kernel(spec)
@@ -320,7 +318,6 @@ def execute_aggregate(
 def _gather_rows(
     sources: list[ColumnData],
     tags_code: list[str],
-    tags_value: list[str],
     fields: list[str],
     gd: GlobalDicts,
     begin_millis: int,
@@ -330,7 +327,6 @@ def _gather_rows(
     and version dedup (block pruning upstream is only block-granular)."""
     ts_l, series_l, ver_l = [], [], []
     tc_l: dict[str, list] = {t: [] for t in tags_code}
-    tv_l: dict[str, list] = {t: [] for t in tags_value}
     f_l: dict[str, list] = {f: [] for f in fields}
     for src in sources:
         if src.ts.size == 0:
@@ -345,14 +341,6 @@ def _gather_rows(
             lut = gd.add_source(t, list(src.dicts.get(t, [])))
             codes = src.tags[t][rng]
             tc_l[t].append(lut[codes] if lut.size else np.zeros(int(rng.sum()), np.int32))
-        for t in tags_value:
-            d = src.dicts.get(t, [])
-            vals = np.asarray(
-                [int.from_bytes(v, "little", signed=True) if v else 0 for v in d],
-                dtype=np.int64,
-            )
-            col = vals[src.tags[t][rng]] if len(d) else np.zeros(int(rng.sum()), np.int64)
-            tv_l[t].append(col.astype(np.int32))
         for f in fields:
             f_l[f].append(src.fields[f][rng])
 
@@ -361,7 +349,6 @@ def _gather_rows(
             ts=np.zeros(0, np.int64),
             series=np.zeros(0, np.int64),
             tags_code={t: np.zeros(0, np.int32) for t in tags_code},
-            tags_value={t: np.zeros(0, np.int32) for t in tags_value},
             fields={f: np.zeros(0, np.float64) for f in fields},
         )
         return empty
@@ -383,7 +370,6 @@ def _gather_rows(
         ts=ts[keep],
         series=series[keep],
         tags_code={t: np.concatenate(tc_l[t])[keep] for t in tags_code},
-        tags_value={t: np.concatenate(tv_l[t])[keep] for t in tags_value},
         fields={f: np.concatenate(f_l[f])[keep] for f in fields},
     )
 
@@ -411,7 +397,6 @@ def _device_chunk(cols: dict, start: int, end: int, spec: PlanSpec, epoch: int) 
         "series": pad(cols["series"] % (2**31), np.int32),
         "valid": jnp.asarray(valid),
         "tags_code": {t: pad(cols["tags_code"][t], np.int32) for t in spec.tags_code},
-        "tags_value": {t: pad(cols["tags_value"][t], np.int32) for t in spec.tags_value},
         "fields": {f: pad(cols["fields"][f], np.float32) for f in spec.fields},
     }
 
@@ -454,9 +439,17 @@ def _finalize(
         np.asarray([0]) if not group_tags else np.nonzero(nonempty)[0]
     )
 
-    # Top-N selection narrows the group id set.
-    if request.top and agg and agg.function != "percentile":
-        metric = agg_values(agg.function, agg.field_name)
+    # Top-N selection narrows the group id set.  Ranking field is
+    # top.field_name; the ranking function is the request's aggregate when
+    # it composes (sum/count/min/max/mean), else mean (percentile ranks
+    # don't compose across groups — reference TopN is mean-of-field too).
+    if request.top:
+        fn = (
+            agg.function
+            if agg and agg.function != "percentile" and agg.field_name == request.top.field_name
+            else "mean"
+        )
+        metric = agg_values(fn, request.top.field_name)
         metric = np.where(nonempty, metric, -np.inf if request.top.field_value_sort != "asc" else np.inf)
         k = min(request.top.number, int(nonempty.sum()))
         if request.top.field_value_sort == "asc":

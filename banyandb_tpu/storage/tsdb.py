@@ -58,14 +58,24 @@ class Shard:
 
     def _load_snapshot(self) -> None:
         snp = self.root / SNAPSHOT
-        if not snp.exists():
-            return
-        data = fs.read_json(snp)
-        self._epoch = data["epoch"]
-        for name in data["parts"]:
-            pdir = self.root / name
-            if pdir.exists():
-                self._parts[name] = Part(pdir)
+        listed: set[str] = set()
+        if snp.exists():
+            data = fs.read_json(snp)
+            self._epoch = data["epoch"]
+            listed = set(data["parts"])
+            for name in data["parts"]:
+                pdir = self.root / name
+                if pdir.exists():
+                    self._parts[name] = Part(pdir)
+        # GC orphans: part dirs written but never published (crash between
+        # PartWriter.write and _publish), and dirs dropped by a merge whose
+        # deletion didn't complete.  Without this, a crash mid-flush would
+        # permanently collide on the next epoch's part name.
+        import shutil
+
+        for pdir in self.root.glob("part-*"):
+            if pdir.name not in listed:
+                shutil.rmtree(pdir, ignore_errors=True)
 
     def _publish(self) -> None:
         fs.atomic_write_json(
@@ -77,6 +87,16 @@ class Shard:
     def parts(self) -> list[Part]:
         with self._lock:
             return list(self._parts.values())
+
+    def ingest(self, fn) -> None:
+        """Run `fn(memtable)` under the shard lock.
+
+        All writers MUST go through this: it excludes flush()'s memtable
+        swap, which would otherwise strand a racing append in the drained
+        table (write lost silently).
+        """
+        with self._lock:
+            fn(self.mem)
 
     def flush(self) -> Optional[list[str]]:
         """Memtable -> new part(s) + snapshot publish. Returns part names.

@@ -1,0 +1,138 @@
+"""Round benchmark: fused measure scan+aggregate throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.json config #2/#3 analog): filter + group-by(service) +
+{count,sum,min,max,mean} + p50/p99 histogram + top-N over N_ROWS rows of a
+measure with 2 tag columns and 1 float field — the reference's data-node
+scan hot loop (banyand/measure/query.go:594, pkg/query/vectorized).
+
+vs_baseline: speedup over a single-core NumPy executor running the exact
+same query on the same host arrays. NumPy is a *favorable* stand-in for
+the reference's Go row/vec executor (contiguous SIMD loops, no proto or
+iterator overhead), so this ratio is a conservative proxy for "vs the Go
+executor" (BASELINE.md north star: >=8x on TopN/percentile).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+N_ROWS = 4 << 20  # 4Mi rows per device batch
+CHUNK = 8192
+N_SVC = 1024
+N_REGION = 8
+QS = (0.5, 0.99)
+HIST_BUCKETS = 512
+
+
+def _host_data(n):
+    rng = np.random.default_rng(3)
+    return {
+        "svc": rng.integers(0, N_SVC, n).astype(np.int32),
+        "region": rng.integers(0, N_REGION, n).astype(np.int32),
+        "latency": rng.gamma(2.0, 40.0, n).astype(np.float32),
+    }
+
+
+def numpy_executor(d, region_ne: int):
+    """Single-core oracle: same query, pure NumPy."""
+    mask = d["region"] != region_ne
+    svc = d["svc"][mask]
+    lat = d["latency"][mask]
+    count = np.bincount(svc, minlength=N_SVC).astype(np.float64)
+    sums = np.bincount(svc, weights=lat, minlength=N_SVC)
+    # min/max per group via sort-split
+    order = np.argsort(svc, kind="stable")
+    ssvc, slat = svc[order], lat[order]
+    bounds = np.searchsorted(ssvc, np.arange(N_SVC + 1))
+    mins = np.full(N_SVC, np.inf)
+    maxs = np.full(N_SVC, -np.inf)
+    hist = np.zeros((N_SVC, HIST_BUCKETS))
+    lo, hi = 0.0, 1000.0
+    width = (hi - lo) / HIST_BUCKETS
+    bucket = np.clip(((slat - lo) / width).astype(np.int64), 0, HIST_BUCKETS - 1)
+    for g in range(N_SVC):
+        a, b = bounds[g], bounds[g + 1]
+        if b > a:
+            seg = slat[a:b]
+            mins[g], maxs[g] = seg.min(), seg.max()
+            hist[g] = np.bincount(bucket[a:b], minlength=HIST_BUCKETS)
+    mean = sums / np.maximum(count, 1)
+    top = np.argsort(-np.where(count > 0, mean, -np.inf))[:10]
+    return count, sums, mins, maxs, hist, top
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from banyandb_tpu.query.measure_exec import (
+        PlanSpec,
+        _PredSpec,
+        _build_kernel,
+    )
+
+    d = _host_data(N_ROWS)
+
+    spec = PlanSpec(
+        tags_code=("region", "svc"),
+        fields=("latency",),
+        preds=(_PredSpec("code", "region", "ne"),),
+        group_tags=("svc",),
+        radices=(N_SVC,),
+        num_groups=N_SVC,
+        want_minmax=True,
+        hist_field="latency",
+        nrows=N_ROWS,  # one resident mega-chunk: scan is HBM-bound
+    )
+    kernel = _build_kernel(spec)
+
+    chunk = {
+        "valid": jnp.asarray(np.ones(N_ROWS, dtype=bool)),
+        "series": jnp.zeros(N_ROWS, jnp.int32),
+        "ts": jnp.zeros(N_ROWS, jnp.int32),
+        "tags_code": {
+            "svc": jnp.asarray(d["svc"]),
+            "region": jnp.asarray(d["region"]),
+        },
+        "fields": {"latency": jnp.asarray(d["latency"])},
+    }
+    pred_vals = {"p0": jnp.int32(3)}
+    args = (chunk, pred_vals, jnp.float32(0.0), jnp.float32(1000.0))
+
+    # compile + warm
+    out = kernel(*args)
+    jax.block_until_ready(out)
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = kernel(*args)
+    jax.block_until_ready(out)
+    device_s = (time.perf_counter() - t0) / iters
+    points_per_sec = N_ROWS / device_s
+
+    # single-core NumPy baseline on the same query (1 iter is plenty)
+    t0 = time.perf_counter()
+    numpy_executor(d, region_ne=3)
+    numpy_s = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "measure_scan_groupby_agg_p50p99_topk",
+                "value": round(points_per_sec / 1e6, 3),
+                "unit": "Mpoints/s/chip",
+                "vs_baseline": round(numpy_s / device_s, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Distributed aggregation over a virtual 8-device mesh vs NumPy oracle."""
+
+import numpy as np
+import jax
+import pytest
+
+from banyandb_tpu.parallel import (
+    DistPlan,
+    distributed_aggregate,
+    make_mesh,
+    stack_shard_chunks,
+)
+
+RNG = np.random.default_rng(21)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh(4, 2)
+
+
+def _mk_rows(n):
+    return {
+        "tags": {
+            "svc": RNG.integers(0, 6, n).astype(np.int32),
+            "region": RNG.integers(0, 3, n).astype(np.int32),
+        },
+        "fields": {"lat": RNG.gamma(2.0, 40.0, n).astype(np.float32)},
+    }
+
+
+def test_distributed_matches_oracle(mesh):
+    per_shard = [_mk_rows(400) for _ in range(8)]
+    plan = DistPlan(
+        tags_code=("region", "svc"),
+        fields=("lat",),
+        group_tags=("svc",),
+        radices=(6,),
+        num_groups=6,
+        eq_preds=("region",),
+        topn=3,
+        want_hist="lat",
+    )
+    chunks = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, 512)
+    out = distributed_aggregate(
+        mesh, plan, chunks, pred_codes={"region": 1}, hist_lo=0.0, hist_span=1000.0
+    )
+
+    # oracle over the union of all shards
+    svc = np.concatenate([r["tags"]["svc"] for r in per_shard])
+    region = np.concatenate([r["tags"]["region"] for r in per_shard])
+    lat = np.concatenate([r["fields"]["lat"] for r in per_shard])
+    sel = region == 1
+    for g in range(6):
+        m = sel & (svc == g)
+        assert float(out["count"][g]) == m.sum()
+        np.testing.assert_allclose(
+            float(out["sums"]["lat"][g]), lat[m].sum(), rtol=1e-4
+        )
+        if m.any():
+            np.testing.assert_allclose(float(out["mins"]["lat"][g]), lat[m].min())
+            np.testing.assert_allclose(float(out["maxs"]["lat"][g]), lat[m].max())
+
+    # top-3 by mean
+    means = np.array(
+        [lat[sel & (svc == g)].mean() if (sel & (svc == g)).any() else -np.inf for g in range(6)]
+    )
+    expect = np.argsort(-means)[:3]
+    np.testing.assert_array_equal(np.asarray(out["top_idx"]), expect)
+
+    # histogram totals match counts
+    np.testing.assert_allclose(
+        np.asarray(out["hist"]).sum(axis=1), np.asarray(out["count"]), rtol=1e-6
+    )
+
+
+def test_mesh_too_small():
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(100, 2)
