@@ -39,6 +39,22 @@ class MeasureEngine:
         self.registry = registry
         self.root = Path(root) / "measure"
         self._tsdbs: dict[str, TSDB] = {}
+        self._loops = None
+
+    def start_lifecycle(self, **kw) -> None:
+        """Start background flush/merge/retention (svc_standalone analog)."""
+        from banyandb_tpu.storage.loops import LifecycleLoops
+
+        if self._loops is None:
+            self._loops = LifecycleLoops(
+                lambda: list(self._tsdbs.values()), **kw
+            )
+            self._loops.start()
+
+    def stop_lifecycle(self) -> None:
+        if self._loops is not None:
+            self._loops.stop()
+            self._loops = None
 
     # -- plumbing ----------------------------------------------------------
     def _tsdb(self, group: str) -> TSDB:
@@ -104,6 +120,21 @@ class MeasureEngine:
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
+        # A concurrent merge can GC a part dir after we snapshot the part
+        # list; that read raises FileNotFoundError and we retry against the
+        # fresh snapshot (the reference's epoch-reference contract).
+        for attempt in range(3):
+            try:
+                sources = self._gather_sources(db, m, req)
+                break
+            except FileNotFoundError:
+                if attempt == 2:
+                    raise
+        if req.agg or req.group_by or req.top:
+            return measure_exec.execute_aggregate(m, req, sources)
+        return _raw_rows(m, req, sources)
+
+    def _gather_sources(self, db: TSDB, m: Measure, req: QueryRequest) -> list[ColumnData]:
         sources: list[ColumnData] = []
         tag_names = [t.name for t in m.tags]
         field_names = [f.name for f in m.fields]
@@ -122,11 +153,13 @@ class MeasureEngine:
                     )
                     if blocks:
                         sources.append(
-                            part.read(blocks, tags=tag_names, fields=field_names)
+                            part.read(
+                                blocks,
+                                tags=[t for t in tag_names if t in part.meta["tags"]],
+                                fields=[f for f in field_names if f in part.meta["fields"]],
+                            )
                         )
-        if req.agg or req.group_by or req.top:
-            return measure_exec.execute_aggregate(m, req, sources)
-        return _raw_rows(m, req, sources)
+        return sources
 
 
 def _tag_to_bytes(value, tag_type: TagType) -> bytes:

@@ -36,6 +36,7 @@ from banyandb_tpu.api.model import (
 from banyandb_tpu.api.schema import Measure, TagType
 from banyandb_tpu.ops.blocks import pad_rows_bucket
 from banyandb_tpu.storage.part import ColumnData
+from banyandb_tpu.utils import hostops
 
 CHUNK = 8192
 _NUM_HIST_BUCKETS = 512
@@ -148,6 +149,12 @@ class GlobalDicts:
 
     def code_of(self, tag: str, value: bytes) -> int:
         return self.maps[tag].get(value, -1)
+
+    def absent_code(self, tag: str) -> int:
+        """Global code for the empty value (rows from sources that predate
+        the tag)."""
+        m = self.maps[tag]
+        return m.setdefault(b"", len(m))
 
     def values(self, tag: str) -> list[bytes]:
         m = self.maps[tag]
@@ -334,15 +341,30 @@ def _gather_rows(
         rng = (src.ts >= begin_millis) & (src.ts < end_millis)
         if not rng.any():
             continue
+        nsel = int(rng.sum())
         ts_l.append(src.ts[rng])
         series_l.append(src.series[rng])
         ver_l.append(src.version[rng])
         for t in tags_code:
-            lut = gd.add_source(t, list(src.dicts.get(t, [])))
-            codes = src.tags[t][rng]
-            tc_l[t].append(lut[codes] if lut.size else np.zeros(int(rng.sum()), np.int32))
+            col = src.tags.get(t)
+            if col is None:
+                # Source predates this tag (schema evolution): its rows all
+                # carry the empty value, same convention as merge/raw paths.
+                tc_l[t].append(
+                    np.full(nsel, gd.absent_code(t), dtype=np.int32)
+                )
+            else:
+                lut = gd.add_source(t, list(src.dicts.get(t, [])))
+                codes = col[rng]
+                tc_l[t].append(
+                    lut[codes] if lut.size else np.zeros(nsel, np.int32)
+                )
         for f in fields:
-            f_l[f].append(src.fields[f][rng])
+            col = src.fields.get(f)
+            if col is None:
+                f_l[f].append(np.zeros(nsel, dtype=np.float64))
+            else:
+                f_l[f].append(col[rng])
 
     if not ts_l:
         empty = dict(
@@ -357,14 +379,7 @@ def _gather_rows(
     series = np.concatenate(series_l)
     version = np.concatenate(ver_l)
     # Global version dedup: keep the max-version row per (series, ts).
-    # lexsort is ascending; -version puts the winner first in its key run.
-    order = np.lexsort((-version, ts, series))
-    s_s, t_s = series[order], ts[order]
-    first = np.empty(len(order), dtype=bool)
-    first[0] = True
-    first[1:] = (s_s[1:] != s_s[:-1]) | (t_s[1:] != t_s[:-1])
-    keep = order[first]
-    keep.sort()
+    keep = hostops.dedup_max_version(series, ts, version)
 
     return dict(
         ts=ts[keep],

@@ -1,0 +1,88 @@
+"""Background lifecycle loops.
+
+The reference runs four channel-connected goroutine loops per shard
+(introducer/flusher/merger/syncer, banyand/measure/tstable.go:250).  The
+introducer's role (snapshot epoch ownership) is folded into the shard lock
+here; this module provides the periodic driver for the remaining three:
+
+  flush tick   -> memtable -> parts       (flusher.go:28)
+  merge tick   -> size-tiered compaction  (merger.go:39)
+  retention    -> drop expired segments   (rotation.go retentionTask)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from banyandb_tpu.storage.tsdb import TSDB
+
+
+class LifecycleLoops:
+    """One daemon thread driving flush/merge/retention for a set of TSDBs."""
+
+    def __init__(
+        self,
+        tsdbs: Callable[[], list[TSDB]],
+        *,
+        flush_interval_s: float = 1.0,
+        flush_min_rows: int = 1,
+        retention_interval_s: float = 60.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._tsdbs = tsdbs
+        self.flush_interval_s = flush_interval_s
+        self.flush_min_rows = flush_min_rows
+        self.retention_interval_s = retention_interval_s
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_retention = 0.0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()  # allow stop() -> start() restart
+        self._thread = threading.Thread(
+            target=self._run, name="bydb-lifecycle", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def tick(self) -> dict:
+        """One round of flush+merge(+retention). Exposed for tests/manual."""
+        stats = {"flushed": 0, "merged": 0, "retired": 0}
+        now = self._clock()
+        for db in self._tsdbs():
+            for seg in db.segments:
+                for shard in seg.shards:
+                    if len(shard.mem) >= self.flush_min_rows:
+                        names = shard.flush()
+                        stats["flushed"] += len(names or [])
+                    while True:
+                        merged = shard.merge()
+                        if not merged:
+                            break
+                        stats["merged"] += 1
+            if now - self._last_retention >= self.retention_interval_s:
+                stats["retired"] += len(
+                    db.retention_sweep(int(now * 1000))
+                )
+        if now - self._last_retention >= self.retention_interval_s:
+            self._last_retention = now
+        return stats
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_interval_s):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - keep the loop alive
+                import logging
+
+                logging.getLogger(__name__).exception("lifecycle tick failed")

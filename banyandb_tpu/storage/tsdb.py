@@ -18,6 +18,7 @@ the same MVCC contract as the reference's .snp manifests.
 from __future__ import annotations
 
 import datetime as dt
+import os
 import threading
 from pathlib import Path
 from typing import Callable, Iterator, Optional
@@ -76,6 +77,8 @@ class Shard:
         for pdir in self.root.glob("part-*"):
             if pdir.name not in listed:
                 shutil.rmtree(pdir, ignore_errors=True)
+        for pdir in self.root.glob(".tmp-merge-*"):
+            shutil.rmtree(pdir, ignore_errors=True)
 
     def _publish(self) -> None:
         fs.atomic_write_json(
@@ -131,22 +134,51 @@ class Shard:
             self._publish()
             return names
 
-    def replace_parts(
-        self, removed: list[str], added_dirs: list[Path]
-    ) -> None:
-        """Merge introduction: swap part sets atomically (introducer.go:114
-        mergedIntroduction analog)."""
-        with self._lock:
-            self._epoch += 1
-            for name in removed:
-                self._parts.pop(name, None)
-            for d in added_dirs:
-                self._parts[d.name] = Part(d)
-            self._publish()
+    def merge(self) -> Optional[str]:
+        """One merge round (merger.go:39 analog). Returns new part name.
 
-    def next_part_name(self) -> str:
+        Column reads AND the merged-part encode/write happen outside the
+        lock (victim parts are immutable; the merged part lands in a temp
+        dir).  Under the lock only: re-check victims, rename temp dir to
+        its epoch name, swap the part set, publish — the atomic commit
+        (introducer.go:114 mergedIntroduction analog).  Old dirs are
+        removed after publish — an in-flight reader that snapshotted the
+        old part list can hit a vanished dir, a retryable snapshot miss
+        (same contract as the reference's epoch-based part GC).
+        """
+        import shutil
+
+        from banyandb_tpu.storage import merge as merge_mod
+
+        victims = merge_mod.pick_merge_victims(self.parts)
+        if not victims:
+            return None
+        cols, extra_meta = merge_mod.merge_columns(victims)
+        tmp_dir = self.root / f".tmp-merge-{os.getpid()}-{id(cols):x}"
+        PartWriter.write(
+            tmp_dir,
+            ts=cols.ts,
+            series=cols.series,
+            version=cols.version,
+            tag_codes=dict(cols.tags),
+            tag_dicts=dict(cols.dicts),
+            fields=dict(cols.fields),
+            extra_meta=extra_meta,
+        )
         with self._lock:
-            return f"part-{self._epoch + 1:016x}-m"
+            if any(v.name not in self._parts for v in victims):
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+                return None  # lost a race with another merge round
+            self._epoch += 1
+            name = f"part-{self._epoch:016x}"
+            os.rename(tmp_dir, self.root / name)
+            for v in victims:
+                del self._parts[v.name]
+            self._parts[name] = Part(self.root / name)
+            self._publish()
+        for v in victims:
+            shutil.rmtree(v.dir, ignore_errors=True)
+        return name
 
 
 class Segment:

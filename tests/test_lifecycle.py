@@ -1,0 +1,203 @@
+"""LSM lifecycle: merge compaction, background loops, retention, crash
+recovery across merges (SURVEY.md §7 step 3)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api import (
+    Aggregation,
+    Catalog,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    IntervalRule,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.models.measure import MeasureEngine
+from banyandb_tpu.storage.loops import LifecycleLoops
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(
+        Group(
+            "g",
+            Catalog.MEASURE,
+            ResourceOpts(shard_num=1, ttl=IntervalRule(2, "day")),
+        )
+    )
+    reg.create_measure(
+        Measure(
+            group="g",
+            name="m",
+            tags=(TagSpec("svc", TagType.STRING),),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+    return MeasureEngine(reg, tmp_path / "data")
+
+
+def _write(engine, i, val, ts=None, version=1):
+    engine.write(
+        WriteRequest(
+            "g",
+            "m",
+            (
+                DataPointValue(
+                    ts if ts is not None else T0 + i,
+                    {"svc": f"s{i % 5}"},
+                    {"v": val},
+                    version=version,
+                ),
+            ),
+        )
+    )
+
+
+def _count(engine, lo=T0, hi=T0 + 86_400_000):
+    r = engine.query(
+        QueryRequest(("g",), "m", TimeRange(lo, hi), agg=Aggregation("count", "v"))
+    )
+    return r.values["count"][0]
+
+
+def _shard(engine):
+    db = engine._tsdb("g")
+    return db.segments[0].shards[0]
+
+
+def test_merge_compacts_parts_and_preserves_data(engine):
+    # 10 flushes -> 10 parts -> merges triggered
+    for i in range(10):
+        _write(engine, i, float(i))
+        engine.flush()
+    shard = _shard(engine)
+    assert len(shard.parts) == 10
+    merged = shard.merge()
+    assert merged is not None
+    assert len(shard.parts) < 10
+    # keep merging to steady state
+    while shard.merge():
+        pass
+    assert _count(engine) == 10
+    # on-disk dirs match the snapshot (victims GC'd)
+    dirs = {p.name for p in shard.root.glob("part-*")}
+    assert dirs == {p.name for p in shard.parts}
+
+
+def test_merge_dedups_versions(engine):
+    for v in (1, 2, 3):
+        _write(engine, 0, float(v * 10), ts=T0, version=v)
+        engine.flush()
+    shard = _shard(engine)
+    # force a merge of the three single-row parts
+    from banyandb_tpu.storage import merge as mm
+
+    cols, meta = mm.merge_columns(shard.parts)
+    assert cols.ts.size == 1
+    assert cols.version[0] == 3
+    assert cols.fields["v"][0] == 30.0
+
+
+def test_lifecycle_loop_tick(engine):
+    for i in range(20):
+        _write(engine, i, 1.0)
+        engine.flush()
+    loops = LifecycleLoops(
+        lambda: list(engine._tsdbs.values()),
+        clock=lambda: (T0 + 1000) / 1000,  # test data lives "now"
+    )
+    stats = loops.tick()
+    assert stats["merged"] >= 1
+    assert _count(engine) == 20
+
+
+def test_background_thread_flushes(engine):
+    engine.start_lifecycle(
+        flush_interval_s=0.05, clock=lambda: (T0 + 1000) / 1000
+    )
+    try:
+        for i in range(50):
+            _write(engine, i, 1.0)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if _shard(engine).parts and len(_shard(engine).mem) == 0:
+                break
+            time.sleep(0.05)
+        assert _count(engine) == 50
+        assert len(_shard(engine).mem) == 0  # everything flushed
+    finally:
+        engine.stop_lifecycle()
+
+
+def test_retention_drops_expired_segments(engine):
+    old = T0 - 10 * 86_400_000
+    _write(engine, 0, 1.0, ts=old)
+    _write(engine, 1, 1.0)
+    engine.flush()
+    db = engine._tsdb("g")
+    assert len(db.segments) == 2
+    removed = db.retention_sweep(T0 + 1)
+    assert len(removed) == 1
+    assert len(db.segments) == 1
+    assert _count(engine) == 1
+
+
+def test_schema_evolution_aggregate_over_old_parts(engine, tmp_path):
+    """Parts written before a tag/field was added must aggregate cleanly:
+    old rows carry the empty tag value and 0.0 field."""
+    _write(engine, 0, 5.0)
+    engine.flush()
+    reg = engine.registry
+    reg.create_measure(
+        Measure(
+            group="g",
+            name="m",
+            tags=(TagSpec("svc", TagType.STRING), TagSpec("region", TagType.STRING)),
+            fields=(FieldSpec("v", FieldType.FLOAT), FieldSpec("w", FieldType.FLOAT)),
+            entity=Entity(("svc",)),
+        )
+    )
+    engine.write(
+        WriteRequest(
+            "g", "m",
+            (DataPointValue(T0 + 50, {"svc": "s9", "region": "r1"}, {"v": 7.0, "w": 2.0}, version=1),),
+        )
+    )
+    from banyandb_tpu.api import GroupBy
+
+    r = engine.query(
+        QueryRequest(
+            ("g",), "m", TimeRange(T0, T0 + 100),
+            group_by=GroupBy(("region",)),
+            agg=Aggregation("sum", "w"),
+        )
+    )
+    got = dict(zip([g[0] for g in r.groups], r.values["sum(w)"]))
+    assert got == {"": 0.0, "r1": 2.0}
+
+
+def test_reopen_after_merge(engine, tmp_path):
+    for i in range(10):
+        _write(engine, i, float(i))
+        engine.flush()
+    while _shard(engine).merge():
+        pass
+    reg2 = SchemaRegistry(tmp_path)
+    eng2 = MeasureEngine(reg2, tmp_path / "data")
+    assert _count(eng2) == 10
