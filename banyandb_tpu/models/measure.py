@@ -79,11 +79,12 @@ class MeasureEngine:
         shard_num = self.registry.get_group(req.group).resource_opts.shard_num
         n = 0
         for p in req.points:
-            entity = [
+            # Series identity is (measure, entity values) — two measures
+            # sharing an entity tuple must not collide in the series index.
+            entity = [req.name.encode()] + [
                 hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
             ]
             sid = hashing.series_id(entity)
-            shard = hashing.shard_id(sid, shard_num)
             seg = db.segment_for(p.ts_millis)
             version = p.version or int(time.time() * 1000)
             tag_bytes = {
@@ -93,6 +94,19 @@ class MeasureEngine:
             field_vals = {
                 f.name: float(p.fields.get(f.name, 0)) for f in m.fields
             }
+            if m.index_mode:
+                # Index-mode measures live entirely in the series index —
+                # one doc per data point (handleIndexMode,
+                # banyand/measure/write_standalone.go:348).
+                _index_mode_write(
+                    seg, m, sid, p.ts_millis, version, tag_bytes, field_vals
+                )
+                n += 1
+                continue
+            shard = hashing.shard_id(sid, shard_num)
+            entity_tags = {t: tag_bytes[t] for t in m.entity.tag_names}
+            entity_tags["@measure"] = req.name.encode()
+            seg.series_index.insert_series(sid, entity_tags)
             seg.shards[shard].ingest(
                 lambda mem: mem.append_measure(
                     m.name,
@@ -120,6 +134,13 @@ class MeasureEngine:
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
+        if m.index_mode:
+            # Short-circuit: whole measure lives in the series index
+            # (SearchWithoutSeries, measure/query.go:506,559).
+            sources = _index_mode_sources(db, m, req)
+            if req.agg or req.group_by or req.top:
+                return measure_exec.execute_aggregate(m, req, sources)
+            return _raw_rows(m, req, sources)
         # A concurrent merge can GC a part dir after we snapshot the part
         # list; that read raises FileNotFoundError and we retry against the
         # fresh snapshot (the reference's epoch-reference contract).
@@ -138,9 +159,27 @@ class MeasureEngine:
         sources: list[ColumnData] = []
         tag_names = [t.name for t in m.tags]
         field_names = [f.name for f in m.fields]
+        entity_conds = _entity_eq_conditions(m, req)
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
         ):
+            # Series pruning: entity-tag equality conditions resolve to a
+            # candidate seriesID set via the segment's series index
+            # (searchSeriesList, measure/query.go:314); part blocks outside
+            # the candidate series range are skipped.
+            series_ids = None
+            if entity_conds and len(seg.series_index):
+                # An empty index means "no information" (legacy parts, lost
+                # sidx file) — skip pruning rather than prune everything.
+                from banyandb_tpu.index.inverted import And, Or, TermQuery
+
+                clauses = [TermQuery("@measure", m.name.encode())]
+                for name, values in entity_conds:
+                    terms = tuple(TermQuery(name, v) for v in values)
+                    clauses.append(terms[0] if len(terms) == 1 else Or(terms))
+                series_ids = np.sort(
+                    seg.series_index.search(And(tuple(clauses)))
+                )
             for shard in seg.shards:
                 mem_cols = shard.mem.columns_for(m.name)
                 if mem_cols is not None and mem_cols.ts.size:
@@ -149,7 +188,9 @@ class MeasureEngine:
                     if part.meta.get("measure") != m.name:
                         continue
                     blocks = part.select_blocks(
-                        req.time_range.begin_millis, req.time_range.end_millis
+                        req.time_range.begin_millis,
+                        req.time_range.end_millis,
+                        series_ids=series_ids,
                     )
                     if blocks:
                         sources.append(
@@ -274,3 +315,119 @@ def _decode_tag_value(raw: bytes, tag_type: TagType):
     if tag_type == TagType.STRING:
         return raw.decode(errors="replace")
     return raw
+
+
+# -- series pruning helpers -------------------------------------------------
+
+
+def _entity_eq_conditions(m: Measure, req: QueryRequest):
+    """[(entity_tag, [candidate byte values])] from AND'ed eq/in conditions."""
+    try:
+        conds = measure_exec._collect_conditions(req.criteria)
+    except NotImplementedError:
+        return []
+    entity = set(m.entity.tag_names)
+    out = []
+    for c in conds:
+        if c.name not in entity:
+            continue
+        if c.op == "eq":
+            out.append((c.name, [measure_exec._tag_value_bytes(c.value)]))
+        elif c.op == "in":
+            out.append(
+                (c.name, [measure_exec._tag_value_bytes(v) for v in c.value])
+            )
+    return out
+
+
+# -- index-mode measures (doc-per-point in the series index) ---------------
+
+
+def _point_doc_id(measure: str, sid: int, ts_millis: int) -> int:
+    import hashlib
+
+    h = hashlib.blake2b(
+        measure.encode()
+        + b"\x00"
+        + sid.to_bytes(8, "little")
+        + ts_millis.to_bytes(8, "little", signed=True),
+        digest_size=8,
+    ).digest()
+    return int.from_bytes(h, "little") & 0x7FFF_FFFF_FFFF_FFFF
+
+
+def _index_mode_write(seg, m: Measure, sid, ts_millis, version, tag_bytes, field_vals):
+    from banyandb_tpu.index.inverted import Doc
+
+    idx = seg.series_index._idx
+    payload = np.asarray(
+        [field_vals.get(f.name, 0.0) for f in m.fields], dtype=np.float64
+    ).tobytes()
+    keywords = dict(tag_bytes)
+    keywords["@measure"] = m.name.encode()
+    # check-and-insert under the index lock (dedup-by-version contract)
+    idx.insert_if_newer(
+        Doc(
+            doc_id=_point_doc_id(m.name, sid, ts_millis),
+            keywords=keywords,
+            numerics={"@ts": ts_millis, "@version": version, "@series": sid},
+            payload=payload,
+        )
+    )
+
+
+def _index_mode_sources(db: TSDB, m: Measure, req: QueryRequest) -> list[ColumnData]:
+    """Build scan sources straight from index docs (SearchWithoutSeries) —
+    the same device executor then runs over them unchanged."""
+    from banyandb_tpu.index.inverted import And, RangeQuery, TermQuery
+
+    sources = []
+    for seg in db.select_segments(
+        req.time_range.begin_millis, req.time_range.end_millis
+    ):
+        idx = seg.series_index._idx
+        ids = idx.search(
+            And(
+                (
+                    TermQuery("@measure", m.name.encode()),
+                    RangeQuery(
+                        "@ts",
+                        req.time_range.begin_millis,
+                        req.time_range.end_millis - 1,
+                    ),
+                )
+            )
+        )
+        docs = idx.get_many(ids.tolist())
+        if not docs:
+            continue
+        n = len(docs)
+        ts = np.asarray([d.numerics["@ts"] for d in docs], dtype=np.int64)
+        series = np.asarray([d.numerics["@series"] for d in docs], dtype=np.int64)
+        version = np.asarray(
+            [d.numerics.get("@version", 0) for d in docs], dtype=np.int64
+        )
+        tags: dict[str, np.ndarray] = {}
+        dicts: dict[str, list[bytes]] = {}
+        for t in m.tags:
+            vocab: dict[bytes, int] = {}
+            codes = np.empty(n, dtype=np.int32)
+            for i, d in enumerate(docs):
+                v = d.keywords.get(t.name, b"")
+                codes[i] = vocab.setdefault(v, len(vocab))
+            tags[t.name] = codes
+            dicts[t.name] = [
+                v for v, _ in sorted(vocab.items(), key=lambda kv: kv[1])
+            ]
+        fields: dict[str, np.ndarray] = {}
+        raw = np.frombuffer(b"".join(d.payload for d in docs), dtype=np.float64)
+        raw = raw.reshape(n, len(m.fields)) if len(m.fields) else raw.reshape(n, 0)
+        for j, f in enumerate(m.fields):
+            fields[f.name] = raw[:, j].copy()
+        sources.append(
+            ColumnData(
+                ts=ts, series=series, version=version,
+                tags=tags, fields=fields, dicts=dicts,
+            )
+        )
+    return sources

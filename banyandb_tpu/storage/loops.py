@@ -70,6 +70,9 @@ class LifecycleLoops:
                         if not merged:
                             break
                         stats["merged"] += 1
+                # Series/index-mode docs must survive restarts too — the
+                # sidx file is the only store for index-mode measures.
+                seg.persist_index()
             if now - self._last_retention >= self.retention_interval_s:
                 stats["retired"] += len(
                     db.retention_sweep(int(now * 1000))

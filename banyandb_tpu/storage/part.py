@@ -173,13 +173,28 @@ class Part:
                     self._dicts[tag] = enc.decode_strings(f.read())
         return self._dicts[tag]
 
-    def select_blocks(self, begin_ms: int, end_ms: int) -> list[int]:
-        """Block ids overlapping the half-open [begin, end) time range."""
-        return [
-            i
-            for i, b in enumerate(self.blocks)
-            if b["min_ts"] < end_ms and begin_ms <= b["max_ts"]
-        ]
+    def select_blocks(
+        self,
+        begin_ms: int,
+        end_ms: int,
+        series_ids: Optional[np.ndarray] = None,
+    ) -> list[int]:
+        """Block ids overlapping the half-open [begin, end) time range.
+
+        `series_ids` (sorted int64 candidates from the series index) prunes
+        further: rows are part-sorted by series, so a block whose
+        [min_series, max_series] contains no candidate cannot match.
+        """
+        out = []
+        for i, b in enumerate(self.blocks):
+            if not (b["min_ts"] < end_ms and begin_ms <= b["max_ts"]):
+                continue
+            if series_ids is not None:
+                j = int(np.searchsorted(series_ids, b["min_series"]))
+                if j >= len(series_ids) or series_ids[j] > b["max_series"]:
+                    continue
+            out.append(i)
+        return out
 
     def read(
         self,

@@ -182,7 +182,8 @@ class Shard:
 
 
 class Segment:
-    """One time bucket: a shard list + [start, end) bounds."""
+    """One time bucket: a shard list + [start, end) bounds + series index
+    (the per-segment sidx of the reference, segment.go:540)."""
 
     def __init__(
         self,
@@ -198,6 +199,22 @@ class Segment:
         self.shards = [
             Shard(root / f"shard-{i}", mem_factory) for i in range(shard_num)
         ]
+        self._sidx = None
+        self._sidx_lock = threading.Lock()
+
+    @property
+    def series_index(self):
+        if self._sidx is None:
+            with self._sidx_lock:
+                if self._sidx is None:
+                    from banyandb_tpu.index.series import SeriesIndex
+
+                    self._sidx = SeriesIndex(self.root / "sidx.idx")
+        return self._sidx
+
+    def persist_index(self) -> None:
+        if self._sidx is not None:
+            self._sidx.persist()
 
     def overlaps(self, begin: int, end: int) -> bool:
         return self.start < end and begin < self.end
@@ -273,6 +290,7 @@ class TSDB:
                 names = shard.flush()
                 for name in names or []:
                     flushed.append(f"{seg.root.name}/{shard.root.name}/{name}")
+            seg.persist_index()
         return flushed
 
     def retention_sweep(self, now_millis: int) -> list[str]:

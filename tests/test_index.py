@@ -1,0 +1,254 @@
+"""Inverted index, series index, series pruning, index-mode measures
+(SURVEY.md §7 step 4)."""
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.index import (
+    And,
+    Doc,
+    InvertedIndex,
+    Not,
+    Or,
+    RangeQuery,
+    SeriesIndex,
+    TermQuery,
+)
+from banyandb_tpu.api import (
+    Aggregation,
+    Catalog,
+    Condition,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    GroupBy,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.models.measure import MeasureEngine
+
+T0 = 1_700_000_000_000
+
+
+def _docs():
+    return [
+        Doc(1, {"svc": b"a", "region": b"r1"}, {"lat": 10}),
+        Doc(2, {"svc": b"a", "region": b"r2"}, {"lat": 20}),
+        Doc(3, {"svc": b"b", "region": b"r1"}, {"lat": 30}),
+        Doc(4, {"svc": b"c"}, {"lat": 40}),
+    ]
+
+
+def test_term_and_bool_queries():
+    idx = InvertedIndex()
+    idx.insert(_docs())
+    np.testing.assert_array_equal(idx.search(TermQuery("svc", b"a")), [1, 2])
+    np.testing.assert_array_equal(
+        idx.search(And((TermQuery("svc", b"a"), TermQuery("region", b"r1")))), [1]
+    )
+    np.testing.assert_array_equal(
+        idx.search(Or((TermQuery("svc", b"b"), TermQuery("svc", b"c")))), [3, 4]
+    )
+    np.testing.assert_array_equal(
+        idx.search(Not(TermQuery("svc", b"a"))), [3, 4]
+    )
+    np.testing.assert_array_equal(idx.search(None), [1, 2, 3, 4])
+    np.testing.assert_array_equal(idx.search(TermQuery("svc", b"zz")), [])
+
+
+def test_range_queries():
+    idx = InvertedIndex()
+    idx.insert(_docs())
+    np.testing.assert_array_equal(idx.search(RangeQuery("lat", 15, 35)), [2, 3])
+    np.testing.assert_array_equal(idx.search(RangeQuery("lat", None, 10)), [1])
+    np.testing.assert_array_equal(idx.search(RangeQuery("lat", 35, None)), [4])
+    np.testing.assert_array_equal(idx.search(RangeQuery("nope", 0, 9)), [])
+
+
+def test_update_and_delete():
+    idx = InvertedIndex()
+    idx.insert(_docs())
+    idx.insert([Doc(1, {"svc": b"z"}, {"lat": 99})])  # overwrite
+    np.testing.assert_array_equal(idx.search(TermQuery("svc", b"a")), [2])
+    np.testing.assert_array_equal(idx.search(TermQuery("svc", b"z")), [1])
+    idx.delete([2, 3])
+    np.testing.assert_array_equal(idx.search(None), [1, 4])
+
+
+def test_persistence_roundtrip(tmp_path):
+    path = tmp_path / "idx.bin"
+    idx = InvertedIndex(path)
+    idx.insert(_docs())
+    idx.insert([Doc(9, {"svc": b"a"}, {"lat": 5}, payload=b"\x01\x02")])
+    idx.persist()
+
+    idx2 = InvertedIndex(path)
+    assert len(idx2) == 5
+    np.testing.assert_array_equal(idx2.search(TermQuery("svc", b"a")), [1, 2, 9])
+    np.testing.assert_array_equal(idx2.search(RangeQuery("lat", None, 5)), [9])
+    assert idx2.get(9).payload == b"\x01\x02"
+
+
+def test_series_index(tmp_path):
+    s = SeriesIndex(tmp_path / "sidx.idx")
+    s.insert_series(100, {"svc": b"a", "inst": b"i1"})
+    s.insert_series(200, {"svc": b"a", "inst": b"i2"})
+    s.insert_series(300, {"svc": b"b", "inst": b"i1"})
+    s.insert_series(100, {"svc": b"IGNORED", "inst": b"x"})  # idempotent
+    np.testing.assert_array_equal(s.search(TermQuery("svc", b"a")), [100, 200])
+    np.testing.assert_array_equal(
+        s.search_entity({"svc": b"a", "inst": b"i2"}), [200]
+    )
+    assert s.tags_of(100) == {"svc": b"a", "inst": b"i1"}
+    s.persist()
+    s2 = SeriesIndex(tmp_path / "sidx.idx")
+    assert len(s2) == 3
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+    reg.create_measure(
+        Measure(
+            group="g", name="m",
+            tags=(TagSpec("svc", TagType.STRING), TagSpec("region", TagType.STRING)),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+    return MeasureEngine(reg, tmp_path / "data")
+
+
+def test_series_pruning_correctness(engine):
+    rng = np.random.default_rng(5)
+    pts = tuple(
+        DataPointValue(
+            T0 + i,
+            {"svc": f"svc-{i % 40}", "region": f"r{i % 3}"},
+            {"v": float(i)},
+            version=1,
+        )
+        for i in range(2000)
+    )
+    engine.write(WriteRequest("g", "m", pts))
+    engine.flush()
+    # entity eq predicate -> pruned path must equal the oracle
+    r = engine.query(
+        QueryRequest(
+            ("g",), "m", TimeRange(T0, T0 + 10_000),
+            criteria=Condition("svc", "eq", "svc-7"),
+            agg=Aggregation("sum", "v"),
+        )
+    )
+    expect = sum(float(i) for i in range(2000) if i % 40 == 7)
+    assert r.values["sum(v)"][0] == pytest.approx(expect, rel=1e-6)
+    # series index persisted with flush
+    db = engine._tsdb("g")
+    assert (db.segments[0].root / "sidx.idx").exists()
+
+
+def test_two_index_mode_measures_do_not_mix(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    for name, nfields in (("a", 2), ("b", 1)):
+        reg.create_measure(
+            Measure(
+                group="g", name=name,
+                tags=(TagSpec("svc", TagType.STRING),),
+                fields=tuple(FieldSpec(f"f{i}", FieldType.FLOAT) for i in range(nfields)),
+                entity=Entity(("svc",)),
+                index_mode=True,
+            )
+        )
+    eng = MeasureEngine(reg, tmp_path / "data")
+    # same entity value + same timestamp in both measures
+    eng.write(WriteRequest("g", "a", (
+        DataPointValue(T0, {"svc": "x"}, {"f0": 1.0, "f1": 2.0}, version=1),)))
+    eng.write(WriteRequest("g", "b", (
+        DataPointValue(T0, {"svc": "x"}, {"f0": 9.0}, version=1),)))
+    ra = eng.query(QueryRequest(("g",), "a", TimeRange(T0, T0 + 1), limit=10))
+    rb = eng.query(QueryRequest(("g",), "b", TimeRange(T0, T0 + 1), limit=10))
+    assert len(ra.data_points) == 1 and ra.data_points[0]["fields"]["f1"] == 2.0
+    assert len(rb.data_points) == 1 and rb.data_points[0]["fields"]["f0"] == 9.0
+
+
+def test_index_mode_survives_lifecycle_restart(tmp_path):
+    import time as _time
+
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(
+        Measure(
+            group="g", name="attrs",
+            tags=(TagSpec("svc", TagType.STRING),),
+            fields=(FieldSpec("cnt", FieldType.INT),),
+            entity=Entity(("svc",)), index_mode=True,
+        )
+    )
+    eng = MeasureEngine(reg, tmp_path / "data")
+    eng.write(WriteRequest("g", "attrs", (
+        DataPointValue(T0, {"svc": "x"}, {"cnt": 5}, version=1),)))
+    from banyandb_tpu.storage.loops import LifecycleLoops
+
+    LifecycleLoops(
+        lambda: list(eng._tsdbs.values()), clock=lambda: (T0 + 1000) / 1000
+    ).tick()  # the daemon path must persist the index
+
+    eng2 = MeasureEngine(SchemaRegistry(tmp_path), tmp_path / "data")
+    r = eng2.query(QueryRequest(("g",), "attrs", TimeRange(T0, T0 + 1), limit=10))
+    assert len(r.data_points) == 1
+
+
+def test_index_mode_measure(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(
+        Measure(
+            group="g", name="attrs",
+            tags=(TagSpec("svc", TagType.STRING), TagSpec("ver", TagType.STRING)),
+            fields=(FieldSpec("cnt", FieldType.INT),),
+            entity=Entity(("svc",)),
+            index_mode=True,
+        )
+    )
+    eng = MeasureEngine(reg, tmp_path / "data")
+    pts = tuple(
+        DataPointValue(T0 + i, {"svc": f"s{i % 4}", "ver": f"v{i % 2}"}, {"cnt": i}, version=1)
+        for i in range(100)
+    )
+    eng.write(WriteRequest("g", "attrs", pts))
+
+    # raw retrieval
+    r = eng.query(
+        QueryRequest(("g",), "attrs", TimeRange(T0, T0 + 1000),
+                     criteria=Condition("svc", "eq", "s1"), limit=100)
+    )
+    assert len(r.data_points) == 25
+    assert all(dp["tags"]["svc"] == "s1" for dp in r.data_points)
+
+    # aggregate over index docs through the same device executor
+    r = eng.query(
+        QueryRequest(("g",), "attrs", TimeRange(T0, T0 + 1000),
+                     group_by=GroupBy(("ver",)), agg=Aggregation("count", "cnt"))
+    )
+    got = dict(zip([g[0] for g in r.groups], r.values["count"]))
+    assert got == {"v0": 50.0, "v1": 50.0}
+
+    # dedup: overwrite (series, ts) with higher version
+    eng.write(WriteRequest("g", "attrs", (
+        DataPointValue(T0, {"svc": "s0", "ver": "v9"}, {"cnt": 123}, version=9),)))
+    r = eng.query(
+        QueryRequest(("g",), "attrs", TimeRange(T0, T0 + 1),
+                     field_projection=("cnt",), limit=10)
+    )
+    assert len(r.data_points) == 1
+    assert r.data_points[0]["fields"]["cnt"] == 123.0
