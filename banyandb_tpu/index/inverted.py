@@ -225,6 +225,46 @@ class InvertedIndex:
             return base
         raise TypeError(f"unknown query {type(q)}")
 
+    def range_ordered(
+        self,
+        field: str,
+        lo: Optional[int] = None,
+        hi: Optional[int] = None,
+        *,
+        asc: bool = True,
+        limit: Optional[int] = None,
+    ) -> np.ndarray:
+        """doc_ids with lo <= numeric field <= hi, ORDERED by field value.
+
+        The sidx analog (banyand/internal/sidx: key-ordered retrieval,
+        e.g. traces by duration).  Pending docs are merged in at query
+        time (small linear pass) instead of forcing a full rebuild.
+        """
+        with self._lock:
+            self._ensure()
+            pair = self._numeric.get(field, (np.zeros(0, np.int64), np.zeros(0, np.int64)))
+            vals, ids = pair
+            a = np.searchsorted(vals, lo, "left") if lo is not None else 0
+            b = np.searchsorted(vals, hi, "right") if hi is not None else len(vals)
+            vals, ids = vals[a:b], ids[a:b]
+            if self._pending:
+                extra = [
+                    (d.numerics[field], d.doc_id)
+                    for d in self._pending.values()
+                    if field in d.numerics
+                    and (lo is None or d.numerics[field] >= lo)
+                    and (hi is None or d.numerics[field] <= hi)
+                ]
+                if extra:
+                    pv = np.asarray([e[0] for e in extra], dtype=np.int64)
+                    pi = np.asarray([e[1] for e in extra], dtype=np.int64)
+                    vals = np.concatenate([vals, pv])
+                    ids = np.concatenate([ids, pi])
+                    order = np.argsort(vals, kind="stable")
+                    ids = ids[order]
+            out = ids if asc else ids[::-1]
+            return out[:limit] if limit is not None else out
+
     def get(self, doc_id: int) -> Optional[Doc]:
         with self._lock:
             return self._docs.get(doc_id)

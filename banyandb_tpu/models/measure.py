@@ -25,6 +25,7 @@ from banyandb_tpu.api.schema import (
     SchemaRegistry,
     TagType,
 )
+from banyandb_tpu.query import filter as qfilter
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.storage.memtable import MemTable
 from banyandb_tpu.storage.part import ColumnData
@@ -36,10 +37,13 @@ class MeasureEngine:
     """All measure resources of all groups, one TSDB per group."""
 
     def __init__(self, registry: SchemaRegistry, root: str | Path):
+        from banyandb_tpu.models.topn import TopNProcessorManager
+
         self.registry = registry
         self.root = Path(root) / "measure"
         self._tsdbs: dict[str, TSDB] = {}
         self._loops = None
+        self.topn = TopNProcessorManager(self)
 
     def start_lifecycle(self, **kw) -> None:
         """Start background flush/merge/retention (svc_standalone analog)."""
@@ -73,7 +77,7 @@ class MeasureEngine:
         return db
 
     # -- write path (write_standalone.go analog) ---------------------------
-    def write(self, req: WriteRequest) -> int:
+    def write(self, req: WriteRequest, _internal: bool = False) -> int:
         m = self.registry.get_measure(req.group, req.name)
         db = self._tsdb(req.group)
         shard_num = self.registry.get_group(req.group).resource_opts.shard_num
@@ -120,7 +124,18 @@ class MeasureEngine:
                 )
             )
             n += 1
+            if not _internal:
+                self.topn.observe(m, p)
         return n
+
+    def ensure_result_measure(self, group: str) -> None:
+        """Auto-register the shared _top_n_result measure for a group."""
+        from banyandb_tpu.models.topn import RESULT_MEASURE, result_measure_schema
+
+        try:
+            self.registry.get_measure(group, RESULT_MEASURE)
+        except KeyError:
+            self.registry.create_measure(result_measure_schema(group))
 
     def flush(self, group: Optional[str] = None) -> list[str]:
         out = []
@@ -260,37 +275,14 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
     for src in sources:
         if src.ts.size == 0:
             continue
-        mask = (src.ts >= req.time_range.begin_millis) & (
-            src.ts < req.time_range.end_millis
+        mask = qfilter.row_mask(
+            src, conds, req.time_range.begin_millis, req.time_range.end_millis
         )
-        for c in conds:
-            col = src.tags.get(c.name)
-            if col is None:
-                # Source predates the tag: every row has the "absent" value,
-                # which matches nothing for eq/in and everything for ne.
-                # (-2 so it also misses the -1 "literal not in dict" code.)
-                col = np.full(src.ts.shape, -2, dtype=np.int32)
-            d = src.dicts.get(c.name, [])
-            lut = {v: i for i, v in enumerate(d)}
-            if c.op == "eq":
-                code = lut.get(measure_exec._tag_value_bytes(c.value), -1)
-                mask &= col == code
-            elif c.op == "ne":
-                code = lut.get(measure_exec._tag_value_bytes(c.value), -1)
-                mask &= col != code
-            elif c.op in ("in", "not_in"):
-                codes = {
-                    lut.get(measure_exec._tag_value_bytes(v), -1)
-                    for v in c.value
-                }
-                inmask = np.isin(col, list(codes))
-                mask &= inmask if c.op == "in" else ~inmask
-            else:
-                raise NotImplementedError(f"raw-path op {c.op}")
-        idx = np.nonzero(mask)[0]
-        for i in idx:
+        for i in np.nonzero(mask)[0]:
             tags = {
-                t: _decode_tag_value(src.dicts[t][src.tags[t][i]], m.tag(t).type)
+                t: qfilter.decode_tag_value(
+                    src.dicts[t][src.tags[t][i]], m.tag(t).type
+                )
                 for t in src.tags
             }
             fields = {f: float(src.fields[f][i]) for f in src.fields}
@@ -307,14 +299,6 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
     for ts, _ver, tags, fields in ordered[off : off + (req.limit or 100)]:
         res.data_points.append({"timestamp": ts, "tags": tags, "fields": fields})
     return res
-
-
-def _decode_tag_value(raw: bytes, tag_type: TagType):
-    if tag_type == TagType.INT:
-        return int.from_bytes(raw, "little", signed=True) if raw else 0
-    if tag_type == TagType.STRING:
-        return raw.decode(errors="replace")
-    return raw
 
 
 # -- series pruning helpers -------------------------------------------------

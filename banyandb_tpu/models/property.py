@@ -1,0 +1,170 @@
+"""Property engine: mutable documents with ModRevision semantics.
+
+Analog of banyand/property (db/shard.go doc fields _source/_id/_timestamp,
+etcd-style ModRevision, update = overwrite + tombstone semantics at merge).
+Backed by one InvertedIndex per (group, shard) — the same backing choice
+as the reference's per-(group,shard) Bluge store — and, like the
+reference, this is also the store the schema registry rides on.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.index.inverted import And, Doc, InvertedIndex, Query, TermQuery
+from banyandb_tpu.utils import hashing
+
+
+@dataclass(frozen=True)
+class Property:
+    """property/v1 Property analog."""
+
+    group: str
+    name: str
+    id: str
+    tags: dict  # tag name -> str value
+    mod_revision: int = 0
+    create_revision: int = 0
+
+
+class PropertyEngine:
+    def __init__(self, registry: SchemaRegistry, root: str | Path):
+        self.registry = registry
+        self.root = Path(root) / "property"
+        self._lock = threading.Lock()
+        self._shards: dict[tuple[str, int], InvertedIndex] = {}
+        self._revision = int(time.time() * 1000)
+
+    def _shard_for(self, group: str, name: str, pid: str) -> InvertedIndex:
+        g = self.registry.get_group(group)
+        shard_num = g.resource_opts.shard_num
+        sid = hashing.series_id([name.encode(), pid.encode()])
+        shard = hashing.shard_id(sid, shard_num)
+        key = (group, shard)
+        idx = self._shards.get(key)
+        if idx is None:
+            idx = InvertedIndex(self.root / group / f"shard-{shard}.idx")
+            self._shards[key] = idx
+        return idx
+
+    def _all_shards(self, group: str) -> list[InvertedIndex]:
+        g = self.registry.get_group(group)
+        out = []
+        for shard in range(g.resource_opts.shard_num):
+            key = (group, shard)
+            idx = self._shards.get(key)
+            if idx is None:
+                idx = InvertedIndex(self.root / group / f"shard-{shard}.idx")
+                self._shards[key] = idx
+            out.append(idx)
+        return out
+
+    @staticmethod
+    def _doc_id(name: str, pid: str) -> int:
+        return hashing.series_id([name.encode(), pid.encode()])
+
+    # -- apply/get/delete (liaison/grpc/property.go surface) ---------------
+    def apply(self, p: Property, strategy: str = "merge") -> Property:
+        """Create or update; returns the stored property with revisions.
+
+        strategy="merge" merges tags into an existing doc (the reference's
+        default apply strategy); "replace" overwrites the tag set.
+        """
+        idx = self._shard_for(p.group, p.name, p.id)
+        with self._lock:
+            self._revision += 1
+            rev = self._revision
+        doc_id = self._doc_id(p.name, p.id)
+        old = idx.get(doc_id)
+        tags = dict(p.tags)
+        create_rev = rev
+        if old is not None:
+            old_src = json.loads(old.payload)
+            create_rev = old.numerics.get("@create", rev)
+            if strategy == "merge":
+                merged = dict(old_src["tags"])
+                merged.update(tags)
+                tags = merged
+        stored = Property(
+            group=p.group, name=p.name, id=p.id, tags=tags,
+            mod_revision=rev, create_revision=create_rev,
+        )
+        keywords = {"@name": p.name.encode(), "@id": p.id.encode()}
+        for k, v in tags.items():
+            keywords[k] = str(v).encode()
+        idx.insert(
+            [
+                Doc(
+                    doc_id=doc_id,
+                    keywords=keywords,
+                    numerics={"@mod": rev, "@create": create_rev},
+                    payload=json.dumps(
+                        {"id": p.id, "name": p.name, "tags": tags}
+                    ).encode(),
+                )
+            ]
+        )
+        return stored
+
+    def get(self, group: str, name: str, pid: str) -> Optional[Property]:
+        idx = self._shard_for(group, name, pid)
+        doc = idx.get(self._doc_id(name, pid))
+        if doc is None:
+            return None
+        src = json.loads(doc.payload)
+        return Property(
+            group=group, name=name, id=pid, tags=src["tags"],
+            mod_revision=doc.numerics.get("@mod", 0),
+            create_revision=doc.numerics.get("@create", 0),
+        )
+
+    def delete(self, group: str, name: str, pid: str) -> bool:
+        idx = self._shard_for(group, name, pid)
+        doc_id = self._doc_id(name, pid)
+        if idx.get(doc_id) is None:
+            return False
+        idx.delete([doc_id])
+        return True
+
+    def query(
+        self,
+        group: str,
+        name: str,
+        *,
+        tag_filters: Optional[dict] = None,
+        ids: Optional[list[str]] = None,
+        limit: int = 100,
+    ) -> list[Property]:
+        """Scatter across shards, filter by name + tags (+ id set)."""
+        clauses: list = [TermQuery("@name", name.encode())]
+        for k, v in (tag_filters or {}).items():
+            clauses.append(TermQuery(k, str(v).encode()))
+        q: Query = And(tuple(clauses))
+        out: list[Property] = []
+        idset = set(ids) if ids else None
+        for idx in self._all_shards(group):
+            for doc_id in idx.search(q).tolist():
+                doc = idx.get(doc_id)
+                src = json.loads(doc.payload)
+                if idset is not None and src["id"] not in idset:
+                    continue
+                out.append(
+                    Property(
+                        group=group, name=name, id=src["id"], tags=src["tags"],
+                        mod_revision=doc.numerics.get("@mod", 0),
+                        create_revision=doc.numerics.get("@create", 0),
+                    )
+                )
+                if len(out) >= limit:
+                    return out
+        return out
+
+    def persist(self) -> None:
+        for idx in self._shards.values():
+            idx.persist()

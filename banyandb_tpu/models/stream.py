@@ -1,0 +1,199 @@
+"""Stream engine: append-only elements (logs).
+
+Analog of banyand/stream (stream.go:40-43): elements have tags but no
+fields; each element carries an opaque element-id (+ optional binary
+body) stored in the part payload column (the reference keeps element ids
+in timestamps.bin).  No version dedup — appends are immutable; dedup by
+(series, ts, element_id) is not a stream contract.
+
+Queries are retrieval-shaped (filter + time range + order + limit) and
+IO-bound, so they run host-side; tag predicates are still evaluated on
+dictionary codes.  Aggregations over streams go through the measure
+model (the reference does the same).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from banyandb_tpu.api.model import QueryRequest, QueryResult
+from banyandb_tpu.api.schema import SchemaRegistry, TagType
+from banyandb_tpu.query import filter as qfilter
+from banyandb_tpu.query import measure_exec
+from banyandb_tpu.storage.memtable import PayloadMemtable
+from banyandb_tpu.storage.part import ColumnData
+from banyandb_tpu.storage.tsdb import TSDB
+from banyandb_tpu.utils import hashing
+
+
+@dataclass(frozen=True)
+class Stream:
+    """database/v1 Stream schema analog."""
+
+    group: str
+    name: str
+    tags: tuple  # TagSpec tuple
+    entity: tuple  # entity tag names
+
+    def tag(self, name: str):
+        for t in self.tags:
+            if t.name == name:
+                return t
+        raise KeyError(f"tag {name} not in stream {self.name}")
+
+
+@dataclass(frozen=True)
+class ElementValue:
+    """measure/v1 ElementValue analog: one log element."""
+
+    element_id: str
+    ts_millis: int
+    tags: dict
+    body: bytes = b""
+
+
+class StreamEngine:
+    def __init__(self, registry: SchemaRegistry, root: str | Path):
+        self.registry = registry
+        self.root = Path(root) / "stream"
+        self._tsdbs: dict[str, TSDB] = {}
+        self._schemas: dict[tuple[str, str], Stream] = {}
+
+    # Streams aren't in the core SchemaRegistry kinds yet; keep a local
+    # registry surface with the same create/get verbs.
+    def create_stream(self, s: Stream) -> None:
+        self.registry.get_group(s.group)
+        self._schemas[(s.group, s.name)] = s
+
+    def get_stream(self, group: str, name: str) -> Stream:
+        s = self._schemas.get((group, name))
+        if s is None:
+            raise KeyError(f"stream {group}/{name} not found")
+        return s
+
+    def _tsdb(self, group: str) -> TSDB:
+        db = self._tsdbs.get(group)
+        if db is None:
+            g = self.registry.get_group(group)
+            db = TSDB(
+                self.root,
+                group,
+                g.resource_opts,
+                mem_factory=lambda: PayloadMemtable("stream"),
+            )
+            self._tsdbs[group] = db
+        return db
+
+    def write(self, group: str, name: str, elements: list[ElementValue]) -> int:
+        s = self.get_stream(group, name)
+        db = self._tsdb(group)
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        tag_names = [t.name for t in s.tags]
+        n = 0
+        for e in elements:
+            entity = [name.encode()] + [
+                hashing.entity_bytes(e.tags[t]) for t in s.entity
+            ]
+            sid = hashing.series_id(entity)
+            shard = hashing.shard_id(sid, shard_num)
+            seg = db.segment_for(e.ts_millis)
+            tag_bytes = {
+                t.name: hashing.entity_bytes(e.tags[t.name])
+                if e.tags.get(t.name) is not None
+                else b""
+                for t in s.tags
+            }
+            payload = e.element_id.encode() + b"\x00" + e.body
+            seg.shards[shard].ingest(
+                lambda mem: mem.append(
+                    name, tag_names, e.ts_millis, sid, tag_bytes, payload
+                )
+            )
+            n += 1
+        return n
+
+    def flush(self, group: Optional[str] = None) -> list[str]:
+        out = []
+        for gname, db in self._tsdbs.items():
+            if group is None or gname == group:
+                out.extend(db.flush_all())
+        return out
+
+    def query(self, req: QueryRequest) -> QueryResult:
+        group = req.groups[0]
+        s = self.get_stream(group, req.name)
+        db = self._tsdb(group)
+        conds = measure_exec._collect_conditions(req.criteria)
+        for c in conds:
+            s.tag(c.name)
+        res = QueryResult()
+        rows: list[tuple] = []
+        for attempt in range(3):
+            try:
+                rows = self._scan(db, s, req, conds)
+                break
+            except FileNotFoundError:
+                if attempt == 2:
+                    raise
+        rows.sort(key=lambda r: r[0], reverse=(req.order_by_ts != "asc"))
+        off = req.offset or 0
+        for ts, elem_id, body, tags in rows[off : off + (req.limit or 100)]:
+            res.data_points.append(
+                {
+                    "element_id": elem_id,
+                    "timestamp": ts,
+                    "tags": tags,
+                    "body": body,
+                }
+            )
+        return res
+
+    def _scan(self, db: TSDB, s: Stream, req: QueryRequest, conds) -> list[tuple]:
+        rows: list[tuple] = []
+        tag_names = [t.name for t in s.tags]
+        for seg in db.select_segments(
+            req.time_range.begin_millis, req.time_range.end_millis
+        ):
+            for shard in seg.shards:
+                mem_cols = shard.mem.columns_for(s.name)
+                sources = [mem_cols] if mem_cols is not None and mem_cols.ts.size else []
+                for part in shard.parts:
+                    if part.meta.get("stream") != s.name:
+                        continue
+                    blocks = part.select_blocks(
+                        req.time_range.begin_millis, req.time_range.end_millis
+                    )
+                    if blocks:
+                        sources.append(
+                            part.read(
+                                blocks,
+                                tags=[t for t in tag_names if t in part.meta["tags"]],
+                                want_payload=True,
+                            )
+                        )
+                for src in sources:
+                    rows.extend(self._filter_source(s, src, req, conds))
+        return rows
+
+    def _filter_source(self, s: Stream, src: ColumnData, req: QueryRequest, conds):
+        mask = qfilter.row_mask(
+            src, conds, req.time_range.begin_millis, req.time_range.end_millis
+        )
+        out = []
+        for i in np.nonzero(mask)[0]:
+            payload = src.payloads[i] if src.payloads else b"\x00"
+            elem_id, _, body = payload.partition(b"\x00")
+            tags = {
+                t: qfilter.decode_tag_value(
+                    src.dicts[t][src.tags[t][i]], s.tag(t).type
+                )
+                for t in src.tags
+            }
+            out.append((int(src.ts[i]), elem_id.decode(), body, tags))
+        return out
+
+

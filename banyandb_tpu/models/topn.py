@@ -1,0 +1,211 @@
+"""TopN pre-aggregation: ingest-time streaming top/bottom-N.
+
+Analog of banyand/measure/topn.go (topNProcessorManager :94, streaming
+processor :340): measure writes flow through per-rule tumbling time
+windows; on window close the per-group aggregates are ranked and the
+winners land as data points in the shared ``_top_n_result`` measure,
+which the normal (TPU) query path then serves; TopN queries re-rank
+across windows (topn_post_processor.go analog).
+
+Windows are tiny (counters_number bounded), so window accumulation is a
+dict of float sums host-side; the heavy path — querying the result
+measure — rides the standard device executor.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Optional
+
+from banyandb_tpu.api.model import (
+    DataPointValue,
+    QueryRequest,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.api.schema import (
+    Entity,
+    FieldSpec,
+    FieldType,
+    Measure,
+    TagSpec,
+    TagType,
+    TopNAggregation,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from banyandb_tpu.models.measure import MeasureEngine
+
+RESULT_MEASURE = "_top_n_result"
+_SEP = "\x01"
+
+
+def result_measure_schema(group: str) -> Measure:
+    """The shared result measure (storage-and-format.md §3.5 analog)."""
+    return Measure(
+        group=group,
+        name=RESULT_MEASURE,
+        tags=(
+            TagSpec("topn_name", TagType.STRING),
+            TagSpec("sort", TagType.STRING),
+            TagSpec("entity", TagType.STRING),
+        ),
+        fields=(FieldSpec("value", FieldType.FLOAT),),
+        entity=Entity(("topn_name", "sort", "entity")),
+    )
+
+
+@dataclass
+class _Window:
+    start: int
+    sums: dict  # entity tuple -> [sum, count]
+
+
+class TopNProcessorManager:
+    """Per-engine manager: routes measure writes into rule windows."""
+
+    def __init__(
+        self,
+        engine: "MeasureEngine",
+        *,
+        window_millis: int = 60_000,
+        lateness_millis: int = 0,
+    ):
+        self.engine = engine
+        self.window_millis = window_millis
+        self.lateness_millis = lateness_millis
+        # (group, rule name) -> {window_start -> _Window}
+        self._windows: dict[tuple, dict[int, _Window]] = defaultdict(dict)
+        self._watermark: dict[tuple, int] = {}
+        self._closed_until: dict[tuple, int] = {}  # drop-late boundary
+        self._emit_seq = 0
+
+    def observe(self, m: Measure, p: DataPointValue) -> None:
+        """Feed one written point through all TopN rules of its measure."""
+        for rule in self.engine.registry.list_topn(m.group):
+            if rule.source_measure != m.name:
+                continue
+            key = (m.group, rule.name)
+            start = p.ts_millis - (p.ts_millis % self.window_millis)
+            if start < self._closed_until.get(key, 0):
+                # Tumbling-window contract: data later than the watermark's
+                # closed boundary is dropped (re-opening a closed window
+                # would emit a duplicate (series, ts) result row that
+                # dedup resolves arbitrarily).
+                continue
+            win = self._windows[key].get(start)
+            if win is None:
+                win = self._windows[key][start] = _Window(start, {})
+            ent = tuple(
+                str(p.tags.get(t, "")) for t in rule.group_by_tag_names
+            ) or (str(p.tags.get(m.entity.tag_names[0], "")),)
+            acc = win.sums.get(ent)
+            if acc is None:
+                if len(win.sums) >= rule.counters_number:
+                    continue  # bounded counters (heap-capacity analog)
+                acc = win.sums[ent] = [0.0, 0]
+            acc[0] += float(p.fields.get(rule.field_name, 0))
+            acc[1] += 1
+            wm = self._watermark.get(key, 0)
+            if p.ts_millis > wm:
+                self._watermark[key] = p.ts_millis
+            self._flush_closed(key, rule)
+
+    def _flush_closed(self, key: tuple, rule: TopNAggregation) -> None:
+        wm = self._watermark.get(key, 0)
+        closed = [
+            s
+            for s in self._windows[key]
+            if s + self.window_millis + self.lateness_millis <= wm
+        ]
+        for start in closed:
+            self._closed_until[key] = max(
+                self._closed_until.get(key, 0), start + self.window_millis
+            )
+            self._emit(key[0], rule, self._windows[key].pop(start))
+
+    def flush_all_windows(self) -> None:
+        """Close every open window (shutdown / test hook)."""
+        for (group, rname), wins in list(self._windows.items()):
+            rule = next(
+                (r for r in self.engine.registry.list_topn(group) if r.name == rname),
+                None,
+            )
+            if rule is None:
+                continue
+            for start in list(wins):
+                self._emit(group, rule, wins.pop(start))
+
+    def _emit(self, group: str, rule: TopNAggregation, win: _Window) -> None:
+        if not win.sums:
+            return
+        self.engine.ensure_result_measure(group)
+        directions = (
+            ("desc", "asc")
+            if rule.field_value_sort == "all"
+            else (rule.field_value_sort,)
+        )
+        points = []
+        ranked = sorted(win.sums.items(), key=lambda kv: kv[1][0])
+        for direction in directions:
+            chosen = (
+                ranked[-rule.counters_number :][::-1]
+                if direction == "desc"
+                else ranked[: rule.counters_number]
+            )
+            # store up to counters_number; final N is applied at query
+            self._emit_seq += 1
+            for ent, (total, _cnt) in chosen:
+                points.append(
+                    DataPointValue(
+                        ts_millis=win.start,
+                        tags={
+                            "topn_name": rule.name,
+                            "sort": direction,
+                            "entity": _SEP.join(ent),
+                        },
+                        fields={"value": total},
+                        version=self._emit_seq,
+                    )
+                )
+        self.engine.write(
+            WriteRequest(group, RESULT_MEASURE, tuple(points)),
+            _internal=True,
+        )
+
+
+def query_topn(
+    engine: "MeasureEngine",
+    group: str,
+    rule_name: str,
+    time_range: TimeRange,
+    *,
+    n: int = 10,
+    direction: str = "desc",
+    agg: str = "sum",
+) -> list[tuple[tuple, float]]:
+    """Re-rank across windows (topn_post_processor.go analog)."""
+    from banyandb_tpu.api.model import Aggregation, Condition, GroupBy, LogicalExpression
+
+    req = QueryRequest(
+        groups=(group,),
+        name=RESULT_MEASURE,
+        time_range=time_range,
+        criteria=LogicalExpression(
+            "and",
+            Condition("topn_name", "eq", rule_name),
+            Condition("sort", "eq", direction),
+        ),
+        group_by=GroupBy(("entity",)),
+        agg=Aggregation(agg, "value"),
+        limit=0,
+    )
+    res = engine.query(req)
+    key = f"{agg}(value)"
+    pairs = [
+        (tuple(g[0].split(_SEP)), v)
+        for g, v in zip(res.groups, res.values[key])
+    ]
+    pairs.sort(key=lambda kv: kv[1], reverse=(direction == "desc"))
+    return pairs[:n]

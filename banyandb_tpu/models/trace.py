@@ -1,0 +1,279 @@
+"""Trace engine: spans grouped by trace ID.
+
+Analog of banyand/trace (trace.go:43-46): spans are opaque payloads
+(spans.bin) with flat tag columns; routing is by trace-id hash
+(partition.TraceShardID, pkg/partition/route.go:40); each part carries a
+trace-id bloom filter (traceID.filter) consulted before block reads; and
+ordered retrieval (e.g. traces by duration) goes through a per-segment
+ordered secondary index (the reference's sidx, banyand/internal/sidx).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from banyandb_tpu.api.model import QueryRequest, QueryResult, TimeRange
+from banyandb_tpu.api.schema import SchemaRegistry, TagType
+from banyandb_tpu.index.inverted import Doc, InvertedIndex
+from banyandb_tpu.query import measure_exec
+from banyandb_tpu.storage.memtable import PayloadMemtable
+from banyandb_tpu.storage.part import ColumnData
+from banyandb_tpu.storage.tsdb import TSDB
+from banyandb_tpu.utils import hashing
+from banyandb_tpu.utils.bloom import Bloom
+
+BLOOM_FILE = "traceid.filter"
+
+
+@dataclass(frozen=True)
+class Trace:
+    """database/v1 Trace schema analog."""
+
+    group: str
+    name: str
+    tags: tuple  # TraceTagSpec analog (TagSpec tuple)
+    trace_id_tag: str
+    timestamp_tag: str = ""
+
+    def tag(self, name: str):
+        for t in self.tags:
+            if t.name == name:
+                return t
+        raise KeyError(f"tag {name} not in trace {self.name}")
+
+
+@dataclass(frozen=True)
+class SpanValue:
+    ts_millis: int
+    tags: dict
+    span: bytes  # opaque span payload
+
+
+def trace_shard_id(trace_id: str, shard_num: int) -> int:
+    """partition.TraceShardID analog: hash the trace id directly."""
+    h = hashlib.blake2b(trace_id.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "little") % shard_num
+
+
+class TraceEngine:
+    def __init__(self, registry: SchemaRegistry, root: str | Path):
+        import os
+
+        self.registry = registry
+        self.root = Path(root) / "trace"
+        self._tsdbs: dict[str, TSDB] = {}
+        self._schemas: dict[tuple[str, str], Trace] = {}
+        # ordered-index instances per (group, segment-start, rule-tag)
+        self._sidx: dict[tuple, InvertedIndex] = {}
+        # doc-id uniqueness across spans sharing (trace, ts): monotonic seq
+        # salted per engine instance so restarts don't re-mint old ids
+        self._doc_salt = os.urandom(8)
+        self._doc_seq = 0
+
+    def create_trace(self, t: Trace) -> None:
+        self.registry.get_group(t.group)
+        self._schemas[(t.group, t.name)] = t
+
+    def get_trace(self, group: str, name: str) -> Trace:
+        t = self._schemas.get((group, name))
+        if t is None:
+            raise KeyError(f"trace {group}/{name} not found")
+        return t
+
+    def _tsdb(self, group: str) -> TSDB:
+        db = self._tsdbs.get(group)
+        if db is None:
+            g = self.registry.get_group(group)
+            db = TSDB(
+                self.root, group, g.resource_opts,
+                mem_factory=lambda: PayloadMemtable("trace"),
+            )
+            self._tsdbs[group] = db
+        return db
+
+    def _ordered_index(self, group: str, seg, rule_tag: str) -> InvertedIndex:
+        key = (group, seg.start, rule_tag)
+        idx = self._sidx.get(key)
+        if idx is None:
+            idx = InvertedIndex(seg.root / f"sidx-{rule_tag}.idx")
+            self._sidx[key] = idx
+        return idx
+
+    # -- write (svc write path analog) -------------------------------------
+    def write(
+        self,
+        group: str,
+        name: str,
+        spans: list[SpanValue],
+        *,
+        ordered_tags: tuple[str, ...] = (),
+    ) -> int:
+        """Ingest spans; `ordered_tags` are INT tags maintained in the
+        ordered secondary index (the TYPE_TREE rule analog, e.g. duration).
+        """
+        t = self.get_trace(group, name)
+        db = self._tsdb(group)
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        tag_names = [x.name for x in t.tags]
+        n = 0
+        for sp in spans:
+            trace_id = str(sp.tags[t.trace_id_tag])
+            sid = hashing.series_id([name.encode(), trace_id.encode()])
+            shard = trace_shard_id(trace_id, shard_num)
+            seg = db.segment_for(sp.ts_millis)
+            tag_bytes = {
+                x.name: hashing.entity_bytes(sp.tags[x.name])
+                if sp.tags.get(x.name) is not None
+                else b""
+                for x in t.tags
+            }
+            seg.shards[shard].ingest(
+                lambda mem: mem.append(
+                    name, tag_names, sp.ts_millis, sid, tag_bytes, sp.span
+                )
+            )
+            for rt in ordered_tags:
+                v = sp.tags.get(rt)
+                if v is None:
+                    continue
+                idx = self._ordered_index(group, seg, rt)
+                self._doc_seq += 1
+                doc_id = hashing.series_id(
+                    [
+                        name.encode(),
+                        trace_id.encode(),
+                        sp.ts_millis.to_bytes(8, "little"),
+                        self._doc_salt + self._doc_seq.to_bytes(8, "little"),
+                    ]
+                )
+                idx.insert(
+                    [
+                        Doc(
+                            doc_id=doc_id,
+                            keywords={"@trace": trace_id.encode()},
+                            numerics={rt: int(v), "@ts": sp.ts_millis},
+                        )
+                    ]
+                )
+            n += 1
+        return n
+
+    def flush(self, group: Optional[str] = None) -> list[str]:
+        out = []
+        for gname, db in self._tsdbs.items():
+            if group is None or gname == group:
+                out.extend(db.flush_all())
+                self._write_blooms(db, gname)
+        for idx in self._sidx.values():
+            idx.persist()
+        return out
+
+    def _write_blooms(self, db: TSDB, group: str) -> None:
+        """Attach a trace-id bloom file to parts that lack one."""
+        for seg in db.segments:
+            for shard in seg.shards:
+                for part in shard.parts:
+                    name = part.meta.get("trace")
+                    if not name or (part.dir / BLOOM_FILE).exists():
+                        continue
+                    t = self._schemas.get((group, name))
+                    if t is None or t.trace_id_tag not in part.meta["tags"]:
+                        continue
+                    ids = part.dict_for(t.trace_id_tag)
+                    bloom = Bloom(max(len(ids), 1))
+                    for v in ids:
+                        bloom.add(v)
+                    from banyandb_tpu.utils import fs
+
+                    fs.atomic_write(part.dir / BLOOM_FILE, bloom.to_bytes())
+
+    # -- queries -----------------------------------------------------------
+    def query_by_trace_id(self, group: str, name: str, trace_id: str) -> list[dict]:
+        """All spans of one trace (the trace span-store lookup)."""
+        t = self.get_trace(group, name)
+        db = self._tsdb(group)
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        shard_idx = trace_shard_id(trace_id, shard_num)
+        tid = trace_id.encode()
+        out: list[dict] = []
+        for seg in db.segments:
+            shard = seg.shards[shard_idx]
+            mem_cols = shard.mem.columns_for(name)
+            sources = [mem_cols] if mem_cols is not None and mem_cols.ts.size else []
+            for part in shard.parts:
+                if part.meta.get("trace") != name:
+                    continue
+                bloom_path = part.dir / BLOOM_FILE
+                if bloom_path.exists():
+                    bloom = Bloom.from_bytes(bloom_path.read_bytes())
+                    if tid not in bloom:
+                        continue
+                sources.append(
+                    part.read(
+                        range(len(part.blocks)),
+                        tags=part.meta["tags"],
+                        want_payload=True,
+                    )
+                )
+            for src in sources:
+                d = src.dicts.get(t.trace_id_tag, [])
+                lut = {v: i for i, v in enumerate(d)}
+                code = lut.get(tid, -1)
+                if code < 0:
+                    continue
+                sel = np.nonzero(src.tags[t.trace_id_tag] == code)[0]
+                for i in sel:
+                    out.append(self._row_to_span(t, src, int(i)))
+        out.sort(key=lambda s: s["timestamp"])
+        return out
+
+    def query_ordered(
+        self,
+        group: str,
+        name: str,
+        order_tag: str,
+        time_range: TimeRange,
+        *,
+        lo: Optional[int] = None,
+        hi: Optional[int] = None,
+        asc: bool = False,
+        limit: int = 20,
+    ) -> list[str]:
+        """Trace ids ordered by an indexed numeric tag (sidx TYPE_TREE
+        retrieval: e.g. slowest traces in a window)."""
+        db = self._tsdb(group)
+        seen: list[str] = []
+        for seg in db.select_segments(time_range.begin_millis, time_range.end_millis):
+            idx = self._ordered_index(group, seg, order_tag)
+            ids = idx.range_ordered(order_tag, lo, hi, asc=asc)
+            for doc_id in ids.tolist():
+                d = idx.get(doc_id)
+                if d is None:
+                    continue
+                ts = d.numerics.get("@ts", 0)
+                if not (time_range.begin_millis <= ts < time_range.end_millis):
+                    continue
+                tid = d.keywords["@trace"].decode()
+                if tid not in seen:
+                    seen.append(tid)
+                if len(seen) >= limit:
+                    return seen
+        return seen
+
+    def _row_to_span(self, t: Trace, src: ColumnData, i: int) -> dict:
+        from banyandb_tpu.query import filter as qfilter
+
+        tags = {
+            tn: qfilter.decode_tag_value(src.dicts[tn][col[i]], t.tag(tn).type)
+            for tn, col in src.tags.items()
+        }
+        return {
+            "timestamp": int(src.ts[i]),
+            "tags": tags,
+            "span": src.payloads[i] if src.payloads else b"",
+        }

@@ -1,0 +1,63 @@
+"""Shared host-side predicate evaluation over dictionary-coded sources.
+
+One implementation of the tag-predicate semantics used by every raw
+(row-retrieval) path — measure._raw_rows, stream scans — so the code
+conventions (-1 = literal not in dictionary, -2 = column absent from the
+source) cannot drift between engines.  The device aggregate path encodes
+the same semantics in measure_exec's kernel lowering.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from banyandb_tpu.api.model import Condition
+from banyandb_tpu.api.schema import TagType
+from banyandb_tpu.storage.part import ColumnData
+
+
+def tag_value_bytes(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode()
+    if isinstance(v, int):
+        return v.to_bytes(8, "little", signed=True)
+    raise TypeError(f"unsupported tag literal {type(v)}")
+
+
+def decode_tag_value(raw: bytes, tag_type: TagType):
+    if tag_type == TagType.INT:
+        return int.from_bytes(raw, "little", signed=True) if raw else 0
+    if tag_type == TagType.STRING:
+        return raw.decode(errors="replace")
+    return raw
+
+
+def row_mask(
+    src: ColumnData,
+    conds: list[Condition],
+    begin_millis: int,
+    end_millis: int,
+) -> np.ndarray:
+    """bool[n] time-range + tag-predicate mask over one source."""
+    mask = (src.ts >= begin_millis) & (src.ts < end_millis)
+    for c in conds:
+        col = src.tags.get(c.name)
+        if col is None:
+            # Source predates the tag: the "absent" sentinel (-2) misses
+            # both real codes and the -1 "literal unknown" code.
+            col = np.full(src.ts.shape, -2, dtype=np.int32)
+        d = src.dicts.get(c.name, [])
+        lut = {v: i for i, v in enumerate(d)}
+        if c.op == "eq":
+            mask &= col == lut.get(tag_value_bytes(c.value), -1)
+        elif c.op == "ne":
+            mask &= col != lut.get(tag_value_bytes(c.value), -1)
+        elif c.op in ("in", "not_in"):
+            codes = {lut.get(tag_value_bytes(v), -1) for v in c.value}
+            inmask = np.isin(col, list(codes))
+            mask &= inmask if c.op == "in" else ~inmask
+        else:
+            raise NotImplementedError(f"raw-path op {c.op}")
+    return mask

@@ -16,8 +16,46 @@ import numpy as np
 from banyandb_tpu.storage.part import ColumnData
 
 
+class PayloadMemtable:
+    """Shard memtable keyed by resource name, for payload-bearing engines
+    (stream elements / trace spans).  `meta_key` names the resource kind
+    recorded in flushed part metadata ("stream" / "trace")."""
+
+    def __init__(self, meta_key: str):
+        self.meta_key = meta_key
+        self._lock = threading.Lock()
+        self._tables: dict[str, "MemTable"] = {}
+
+    def __len__(self) -> int:
+        return sum(len(t) for t in self._tables.values())
+
+    def append(self, resource, tag_names, ts, sid, tags, payload) -> None:
+        with self._lock:
+            tbl = self._tables.get(resource)
+            if tbl is None:
+                tbl = self._tables[resource] = MemTable(
+                    tag_names, [], with_payload=True
+                )
+        tbl.append(ts, sid, 0, tags, {}, payload=payload)
+
+    def columns_for(self, resource: str):
+        tbl = self._tables.get(resource)
+        return tbl.snapshot_columns() if tbl else None
+
+    def drain(self) -> list:
+        return [
+            (name, tbl.snapshot_columns(), {self.meta_key: name})
+            for name, tbl in self._tables.items()
+        ]
+
+
 class MemTable:
-    def __init__(self, tag_names: list[str], field_names: list[str]):
+    def __init__(
+        self,
+        tag_names: list[str],
+        field_names: list[str],
+        with_payload: bool = False,
+    ):
         self._lock = threading.Lock()
         self.tag_names = list(tag_names)
         self.field_names = list(field_names)
@@ -27,6 +65,7 @@ class MemTable:
         self._tag_codes: dict[str, list[int]] = {t: [] for t in tag_names}
         self._dicts: dict[str, dict[bytes, int]] = {t: {} for t in tag_names}
         self._fields: dict[str, list[float]] = {f: [] for f in field_names}
+        self._payloads: list[bytes] | None = [] if with_payload else None
 
     def __len__(self) -> int:
         return len(self._ts)
@@ -38,6 +77,7 @@ class MemTable:
         version: int,
         tag_values: Mapping[str, bytes],
         field_values: Mapping[str, float],
+        payload: bytes | None = None,
     ) -> None:
         with self._lock:
             self._ts.append(ts_millis)
@@ -50,6 +90,8 @@ class MemTable:
                 self._tag_codes[t].append(code)
             for f in self.field_names:
                 self._fields[f].append(float(field_values.get(f, 0.0)))
+            if self._payloads is not None:
+                self._payloads.append(payload or b"")
 
     def drain(self) -> list[tuple[str, ColumnData, dict]]:
         """Flush protocol: [(part-name-suffix, columns, extra metadata)]."""
@@ -74,4 +116,5 @@ class MemTable:
                     t: [v for v, _ in sorted(self._dicts[t].items(), key=lambda kv: kv[1])]
                     for t in self.tag_names
                 },
+                payloads=list(self._payloads) if self._payloads is not None else None,
             )

@@ -22,18 +22,32 @@ DEFAULT_MIN_MERGE_PARTS = 4
 DEFAULT_MAX_PARTS = 8
 
 
+_RESOURCE_KINDS = ("measure", "stream", "trace")
+
+
+def resource_key(p: Part) -> tuple[str, str]:
+    """(kind, name) identity of a part — parts of different resources (or
+    different kinds sharing a name) must never cross-merge."""
+    for kind in _RESOURCE_KINDS:
+        name = p.meta.get(kind)
+        if name:
+            return (kind, name)
+    return ("", "")
+
+
 def pick_merge_victims(
     parts: Sequence[Part],
     *,
     min_merge: int = DEFAULT_MIN_MERGE_PARTS,
     max_parts: int = DEFAULT_MAX_PARTS,
 ) -> list[Part]:
-    """Size-tiered selection: when a measure's part count passes max_parts,
-    merge its min_merge smallest parts (merger_policy.go analog)."""
-    by_measure: dict[str, list[Part]] = {}
+    """Size-tiered selection: when a resource's part count passes
+    max_parts, merge its min_merge smallest parts (merger_policy.go
+    analog)."""
+    by_resource: dict[tuple[str, str], list[Part]] = {}
     for p in parts:
-        by_measure.setdefault(p.meta.get("measure", ""), []).append(p)
-    for group in by_measure.values():
+        by_resource.setdefault(resource_key(p), []).append(p)
+    for group in by_resource.values():
         if len(group) >= max_parts:
             group.sort(key=lambda p: p.total_count)
             return group[:min_merge]
@@ -54,13 +68,18 @@ def merge_columns(parts: Sequence[Part]) -> tuple[ColumnData, dict]:
     fields_l: dict[str, list[np.ndarray]] = {f: [] for f in all_fields}
     merged_dicts: dict[str, dict[bytes, int]] = {t: {} for t in all_tags}
 
+    want_payload = any(p.meta.get("has_payload") for p in parts)
+    payloads_l: list[bytes] = []
     for p in parts:
         cols = p.read(
             range(len(p.blocks)),
             tags=[t for t in all_tags if t in p.meta["tags"]],
             fields=[f for f in all_fields if f in p.meta["fields"]],
+            want_payload=want_payload,
         )
         n = cols.ts.size
+        if want_payload:
+            payloads_l.extend(cols.payloads or [b""] * n)
         ts_l.append(cols.ts)
         series_l.append(cols.series)
         ver_l.append(cols.version)
@@ -85,7 +104,13 @@ def merge_columns(parts: Sequence[Part]) -> tuple[ColumnData, dict]:
     ts = np.concatenate(ts_l)
     series = np.concatenate(series_l)
     version = np.concatenate(ver_l)
-    keep = hostops.dedup_max_version(series, ts, version)
+    if want_payload:
+        # Stream/trace rows are immutable appends with no version
+        # semantics; (series, ts) is NOT unique (spans of one trace in the
+        # same millisecond) — dedup here would destroy data.
+        keep = np.arange(len(ts))
+    else:
+        keep = hostops.dedup_max_version(series, ts, version)
 
     dicts = {
         t: [v for v, _ in sorted(md.items(), key=lambda kv: kv[1])]
@@ -98,9 +123,8 @@ def merge_columns(parts: Sequence[Part]) -> tuple[ColumnData, dict]:
         tags={t: np.concatenate(codes_l[t])[keep] for t in all_tags},
         fields={f: np.concatenate(fields_l[f])[keep] for f in all_fields},
         dicts=dicts,
+        payloads=[payloads_l[i] for i in keep] if want_payload else None,
     )
-    extra_meta = {}
-    for p in parts:
-        if "measure" in p.meta:
-            extra_meta["measure"] = p.meta["measure"]
+    kind, name = resource_key(parts[0])
+    extra_meta = {kind: name} if kind else {}
     return out, extra_meta

@@ -50,6 +50,9 @@ class ColumnData:
     tags: Mapping[str, np.ndarray]  # int32 codes [n]
     fields: Mapping[str, np.ndarray]  # float64 [n]
     dicts: Mapping[str, list[bytes]]  # per-tag dictionary
+    # opaque per-row payloads (stream element ids / trace span bytes,
+    # spans.bin analog); None for measure parts
+    payloads: "Optional[list[bytes]]" = None
 
 
 def _col_file(name: str) -> str:
@@ -72,6 +75,7 @@ class PartWriter:
         tag_dicts: Mapping[str, list[bytes]],
         fields: Mapping[str, np.ndarray],
         extra_meta: Optional[Mapping] = None,
+        payloads: Optional[Sequence[bytes]] = None,
     ) -> None:
         part_dir = Path(part_dir)
         part_dir.mkdir(parents=True, exist_ok=False)
@@ -80,6 +84,8 @@ class PartWriter:
         ts, series, version = ts[order], series[order], version[order]
         tag_codes = {k: v[order] for k, v in tag_codes.items()}
         fields = {k: v[order] for k, v in fields.items()}
+        if payloads is not None:
+            payloads = [payloads[i] for i in order]
 
         blocks = []
         buffers: dict[str, bytearray] = {}
@@ -108,6 +114,10 @@ class PartWriter:
                 extents[f"field_{name}"] = append(
                     f"field_{name}", enc.encode_float64(vals[sl])
                 )
+            if payloads is not None:
+                extents["payload"] = append(
+                    "payload", enc.encode_strings(payloads[start:end])
+                )
             blocks.append(
                 {
                     "count": end - start,
@@ -131,6 +141,7 @@ class PartWriter:
             "max_ts": int(ts.max()) if n else 0,
             "tags": sorted(tag_codes.keys()),
             "fields": sorted(fields.keys()),
+            "has_payload": payloads is not None,
         }
         if extra_meta:
             meta.update(extra_meta)
@@ -202,9 +213,13 @@ class Part:
         *,
         tags: Iterable[str] = (),
         fields: Iterable[str] = (),
+        want_payload: bool = False,
     ) -> ColumnData:
         """Decode the selected blocks' columns into host arrays."""
         tags, fields = list(tags), list(fields)
+        payloads: Optional[list[bytes]] = (
+            [] if (want_payload and self.meta.get("has_payload")) else None
+        )
         cols: dict[str, list[np.ndarray]] = {}
         handles: dict[str, object] = {}
 
@@ -237,6 +252,10 @@ class Part:
                     cols.setdefault(f"field_{fl}", []).append(
                         enc.decode_float64(read_extent(f"field_{fl}", blk), cnt)
                     )
+                if payloads is not None:
+                    payloads.extend(
+                        enc.decode_strings(read_extent("payload", blk))
+                    )
         finally:
             for f in handles.values():
                 f.close()
@@ -254,4 +273,5 @@ class Part:
             tags={t: cat(f"tag_{t}", np.int32) for t in tags},
             fields={fl: cat(f"field_{fl}", np.float64) for fl in fields},
             dicts={t: self.dict_for(t) for t in tags},
+            payloads=payloads,
         )

@@ -128,6 +128,7 @@ class Shard:
                     tag_dicts=dict(cols.dicts),
                     fields=dict(cols.fields),
                     extra_meta=extra_meta,
+                    payloads=cols.payloads,
                 )
                 self._parts[name] = Part(self.root / name)
                 names.append(name)
@@ -164,6 +165,7 @@ class Shard:
             tag_dicts=dict(cols.dicts),
             fields=dict(cols.fields),
             extra_meta=extra_meta,
+            payloads=cols.payloads,
         )
         with self._lock:
             if any(v.name not in self._parts for v in victims):

@@ -1,0 +1,308 @@
+"""Stream, Trace, Property engines + TopN pre-aggregation
+(SURVEY.md §7 step 5)."""
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api import (
+    Catalog,
+    Condition,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    TopNAggregation,
+    WriteRequest,
+)
+from banyandb_tpu.models.measure import MeasureEngine
+from banyandb_tpu.models.property import Property, PropertyEngine
+from banyandb_tpu.models.stream import ElementValue, Stream, StreamEngine
+from banyandb_tpu.models.trace import SpanValue, Trace, TraceEngine
+from banyandb_tpu.models import topn as topn_mod
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+    return reg
+
+
+# ---------------- Stream ----------------
+
+
+def _stream_engine(registry, tmp_path):
+    eng = StreamEngine(registry, tmp_path / "data")
+    eng.create_stream(
+        Stream(
+            group="g",
+            name="sw_log",
+            tags=(
+                TagSpec("service_id", TagType.STRING),
+                TagSpec("level", TagType.STRING),
+            ),
+            entity=("service_id",),
+        )
+    )
+    return eng
+
+
+def test_stream_write_query_roundtrip(registry, tmp_path):
+    eng = _stream_engine(registry, tmp_path)
+    elements = [
+        ElementValue(
+            element_id=f"e{i}",
+            ts_millis=T0 + i,
+            tags={"service_id": f"svc-{i % 3}", "level": "ERROR" if i % 5 == 0 else "INFO"},
+            body=f"log line {i}".encode(),
+        )
+        for i in range(200)
+    ]
+    assert eng.write("g", "sw_log", elements) == 200
+    eng.flush()
+
+    r = eng.query(
+        QueryRequest(
+            ("g",), "sw_log", TimeRange(T0, T0 + 1000),
+            criteria=Condition("level", "eq", "ERROR"),
+            limit=100,
+        )
+    )
+    assert len(r.data_points) == 40
+    assert all(dp["tags"]["level"] == "ERROR" for dp in r.data_points)
+    assert r.data_points[0]["timestamp"] >= r.data_points[-1]["timestamp"]
+    # element id + body round-trip
+    dp = min(r.data_points, key=lambda d: d["timestamp"])
+    assert dp["element_id"] == "e0" and dp["body"] == b"log line 0"
+
+
+def test_stream_hot_plus_flushed(registry, tmp_path):
+    eng = _stream_engine(registry, tmp_path)
+    eng.write("g", "sw_log", [
+        ElementValue("a", T0 + 1, {"service_id": "s", "level": "INFO"})])
+    eng.flush()
+    eng.write("g", "sw_log", [
+        ElementValue("b", T0 + 2, {"service_id": "s", "level": "INFO"})])
+    r = eng.query(QueryRequest(("g",), "sw_log", TimeRange(T0, T0 + 10), limit=10))
+    assert [dp["element_id"] for dp in r.data_points] == ["b", "a"]
+
+
+def test_stream_ordering_asc_and_offset(registry, tmp_path):
+    eng = _stream_engine(registry, tmp_path)
+    eng.write("g", "sw_log", [
+        ElementValue(f"e{i}", T0 + i, {"service_id": "s", "level": "INFO"})
+        for i in range(10)
+    ])
+    r = eng.query(QueryRequest(("g",), "sw_log", TimeRange(T0, T0 + 100),
+                               order_by_ts="asc", limit=3, offset=2))
+    assert [dp["element_id"] for dp in r.data_points] == ["e2", "e3", "e4"]
+
+
+# ---------------- Trace ----------------
+
+
+def _trace_engine(registry, tmp_path):
+    eng = TraceEngine(registry, tmp_path / "data")
+    eng.create_trace(
+        Trace(
+            group="g",
+            name="sw_trace",
+            tags=(
+                TagSpec("trace_id", TagType.STRING),
+                TagSpec("service_id", TagType.STRING),
+                TagSpec("duration", TagType.INT),
+            ),
+            trace_id_tag="trace_id",
+        )
+    )
+    return eng
+
+
+def test_trace_roundtrip_by_id(registry, tmp_path):
+    eng = _trace_engine(registry, tmp_path)
+    spans = []
+    for t in range(20):
+        for s in range(5):
+            spans.append(
+                SpanValue(
+                    ts_millis=T0 + t * 10 + s,
+                    tags={"trace_id": f"trace-{t}", "service_id": f"svc-{s}", "duration": 100 * s + t},
+                    span=f"span-{t}-{s}".encode(),
+                )
+            )
+    eng.write("g", "sw_trace", spans, ordered_tags=("duration",))
+    eng.flush()
+
+    got = eng.query_by_trace_id("g", "sw_trace", "trace-7")
+    assert len(got) == 5
+    assert [s["span"] for s in got] == [f"span-7-{i}".encode() for i in range(5)]
+    assert got[0]["tags"]["trace_id"] == "trace-7"
+    assert eng.query_by_trace_id("g", "sw_trace", "nope") == []
+
+
+def test_trace_bloom_files_written(registry, tmp_path):
+    eng = _trace_engine(registry, tmp_path)
+    eng.write("g", "sw_trace", [
+        SpanValue(T0, {"trace_id": "t1", "service_id": "s", "duration": 5}, b"x")])
+    eng.flush()
+    db = eng._tsdb("g")
+    parts = [p for seg in db.segments for sh in seg.shards for p in sh.parts]
+    assert parts and all((p.dir / "traceid.filter").exists() for p in parts)
+
+
+def test_trace_ordered_query(registry, tmp_path):
+    eng = _trace_engine(registry, tmp_path)
+    spans = [
+        SpanValue(T0 + i, {"trace_id": f"t{i}", "service_id": "s", "duration": (i * 37) % 1000}, b"")
+        for i in range(50)
+    ]
+    eng.write("g", "sw_trace", spans, ordered_tags=("duration",))
+    # slowest 5 traces
+    durations = {f"t{i}": (i * 37) % 1000 for i in range(50)}
+    expect = sorted(durations, key=lambda k: -durations[k])[:5]
+    got = eng.query_ordered(
+        "g", "sw_trace", "duration", TimeRange(T0, T0 + 1000), limit=5
+    )
+    assert got == expect
+    # ascending with range bound
+    got = eng.query_ordered(
+        "g", "sw_trace", "duration", TimeRange(T0, T0 + 1000),
+        lo=100, hi=300, asc=True, limit=3,
+    )
+    in_range = sorted((d, k) for k, d in durations.items() if 100 <= d <= 300)
+    assert got == [k for _, k in in_range[:3]]
+
+
+def test_stream_parts_merge_without_data_loss(registry, tmp_path):
+    """Merged stream parts must keep their 'stream' meta key and must NOT
+    version-dedup rows sharing (series, ts)."""
+    eng = _stream_engine(registry, tmp_path)
+    # 10 flushes -> 10 parts; several elements share (service, ts)
+    for b in range(10):
+        eng.write("g", "sw_log", [
+            ElementValue(f"e{b}-{i}", T0 + (i // 2), {"service_id": "s", "level": "INFO"})
+            for i in range(6)
+        ])
+        eng.flush()
+    db = eng._tsdb("g")
+    from banyandb_tpu.utils.hashing import series_id, shard_id
+
+    sid = series_id([b"sw_log", b"s"])
+    shard = db.segments[0].shards[shard_id(sid, 2)]
+    assert len(shard.parts) == 10
+    while shard.merge():
+        pass
+    assert len(shard.parts) < 10
+    r = eng.query(QueryRequest(("g",), "sw_log", TimeRange(T0, T0 + 100), limit=1000))
+    assert len(r.data_points) == 60  # every element survives the merge
+
+
+def test_measure_and_stream_parts_never_cross_merge():
+    from banyandb_tpu.storage.merge import resource_key
+
+    class FakePart:
+        def __init__(self, meta):
+            self.meta = meta
+
+    assert resource_key(FakePart({"measure": "m"})) == ("measure", "m")
+    assert resource_key(FakePart({"stream": "m"})) == ("stream", "m")
+    assert resource_key(FakePart({"trace": "t"})) == ("trace", "t")
+    assert resource_key(FakePart({"measure": "m"})) != resource_key(
+        FakePart({"stream": "m"})
+    )
+
+
+# ---------------- Property ----------------
+
+
+def test_property_crud_and_revisions(registry, tmp_path):
+    eng = PropertyEngine(registry, tmp_path / "data")
+    p1 = eng.apply(Property("g", "ui_template", "id-1", {"kind": "dashboard", "owner": "alice"}))
+    assert p1.mod_revision == p1.create_revision > 0
+    p2 = eng.apply(Property("g", "ui_template", "id-1", {"owner": "bob"}))
+    assert p2.mod_revision > p1.mod_revision
+    assert p2.create_revision == p1.create_revision
+    assert p2.tags == {"kind": "dashboard", "owner": "bob"}  # merge strategy
+
+    p3 = eng.apply(Property("g", "ui_template", "id-1", {"owner": "carol"}), strategy="replace")
+    assert p3.tags == {"owner": "carol"}
+
+    got = eng.get("g", "ui_template", "id-1")
+    assert got.tags == {"owner": "carol"}
+    assert eng.get("g", "ui_template", "ghost") is None
+
+    assert eng.delete("g", "ui_template", "id-1")
+    assert not eng.delete("g", "ui_template", "id-1")
+    assert eng.get("g", "ui_template", "id-1") is None
+
+
+def test_property_query_and_persistence(registry, tmp_path):
+    eng = PropertyEngine(registry, tmp_path / "data")
+    for i in range(20):
+        eng.apply(Property("g", "node", f"n{i}", {"role": "data" if i % 2 else "liaison"}))
+    got = eng.query("g", "node", tag_filters={"role": "data"})
+    assert len(got) == 10
+    got = eng.query("g", "node", ids=["n3", "n4"])
+    assert {p.id for p in got} == {"n3", "n4"}
+    eng.persist()
+
+    eng2 = PropertyEngine(registry, tmp_path / "data")
+    assert len(eng2.query("g", "node")) == 20
+    assert eng2.get("g", "node", "n7").tags["role"] == "data"
+
+
+# ---------------- TopN ----------------
+
+
+def test_topn_preaggregation(registry, tmp_path):
+    registry.create_measure(
+        Measure(
+            group="g", name="endpoint_cpm",
+            tags=(TagSpec("endpoint", TagType.STRING),),
+            fields=(FieldSpec("value", FieldType.INT),),
+            entity=Entity(("endpoint",)),
+        )
+    )
+    registry.create_topn(
+        TopNAggregation(
+            group="g", name="top_endpoints", source_measure="endpoint_cpm",
+            field_name="value", field_value_sort="desc",
+            group_by_tag_names=("endpoint",), counters_number=100,
+        )
+    )
+    eng = MeasureEngine(registry, tmp_path / "data")
+    # two windows of traffic; endpoint load proportional to index
+    rng = np.random.default_rng(5)
+    for w in range(3):
+        for i in range(300):
+            ep = int(rng.integers(0, 10))
+            eng.write(
+                WriteRequest("g", "endpoint_cpm", (
+                    DataPointValue(
+                        T0 + w * 60_000 + i * 100,
+                        {"endpoint": f"ep-{ep}"},
+                        {"value": ep + 1},
+                        version=1,
+                    ),
+                ))
+            )
+    eng.topn.flush_all_windows()
+
+    ranked = topn_mod.query_topn(
+        eng, "g", "top_endpoints", TimeRange(T0, T0 + 10 * 60_000), n=3
+    )
+    assert len(ranked) == 3
+    # oracle: total value per endpoint across all windows
+    assert ranked[0][1] >= ranked[1][1] >= ranked[2][1]
+    top_ep = ranked[0][0][0]
+    assert top_ep in {"ep-9", "ep-8"}  # heaviest endpoints by construction
