@@ -1,0 +1,14 @@
+"""Cluster fabric: liaison/data roles over a pluggable message transport.
+
+Analog of the reference's banyand/queue (pub/sub/local) + pkg/bus +
+pkg/node + banyand/dquery: writes route by (group, shard) with
+replica fan-out; queries scatter per-shard to primary-alive nodes and
+reduce partial aggregates at the liaison (two rounds for percentile so
+node histograms share a range).  Transports: in-process (standalone and
+the reference's in-process multi-node test trick) and gRPC sockets.
+"""
+
+from banyandb_tpu.cluster.bus import Topic, LocalBus
+from banyandb_tpu.cluster.node import NodeInfo, RoundRobinSelector
+from banyandb_tpu.cluster.data_node import DataNode
+from banyandb_tpu.cluster.liaison import Liaison

@@ -1,0 +1,159 @@
+"""Data node role: engines + bus handlers (pkg/cmdsetup/data.go analog).
+
+Hosts the storage engines and serves the internal topics: writes land in
+the local engines; partial-aggregate queries run the device map phase
+over the shard subset named in the envelope; chunked part sync
+reassembles shipped parts.
+"""
+
+from __future__ import annotations
+
+import zlib
+from pathlib import Path
+
+from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.cluster import serde
+from banyandb_tpu.cluster.bus import LocalBus, Topic
+from banyandb_tpu.models.measure import MeasureEngine
+from banyandb_tpu.utils import fs
+
+
+class DataNode:
+    def __init__(self, name: str, registry: SchemaRegistry, root: str | Path):
+        import shutil
+
+        self.name = name
+        self.registry = registry
+        self.root = Path(root)
+        self.measure = MeasureEngine(registry, self.root)
+        self.bus = LocalBus()
+        self._sync_sessions: dict[str, dict] = {}
+        # abandoned chunked-sync sessions from a previous process die here
+        shutil.rmtree(self.root / ".sync-staging", ignore_errors=True)
+        self._register_handlers()
+
+    def _register_handlers(self) -> None:
+        self.bus.subscribe(Topic.MEASURE_WRITE, self._on_measure_write)
+        self.bus.subscribe(Topic.MEASURE_QUERY_PARTIAL, self._on_measure_query_partial)
+        self.bus.subscribe(Topic.MEASURE_QUERY_RAW, self._on_measure_query_raw)
+        self.bus.subscribe(Topic.HEALTH, lambda env: {"status": "ok", "node": self.name})
+        self.bus.subscribe(Topic.SCHEMA_SYNC, self._on_schema_sync)
+        self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
+
+    # -- write plane --------------------------------------------------------
+    def _on_measure_write(self, env: dict) -> dict:
+        req = serde.write_request_from_json(env["request"])
+        n = self.measure.write(req)
+        return {"written": n}
+
+    # -- query plane --------------------------------------------------------
+    def _on_measure_query_partial(self, env: dict) -> dict:
+        req = serde.query_request_from_json(env["request"])
+        shard_ids = set(env["shards"]) if env.get("shards") is not None else None
+        hist_range = tuple(env["hist_range"]) if env.get("hist_range") else None
+        partials = self.measure.query_partials(
+            req, shard_ids=shard_ids, hist_range=hist_range
+        )
+        return {"partials": serde.partials_to_json(partials)}
+
+    def _on_measure_query_raw(self, env: dict) -> dict:
+        req = serde.query_request_from_json(env["request"])
+        shard_ids = set(env["shards"]) if env.get("shards") is not None else None
+        res = self.measure.query(req, shard_ids=shard_ids)
+        return {"data_points": res.data_points}
+
+    # -- schema sync (schemaserver/gossip analog, push-based) ---------------
+    def _on_schema_sync(self, env: dict) -> dict:
+        from banyandb_tpu.api import schema as schema_mod
+
+        kind = env["kind"]
+        cls = schema_mod._KINDS[kind]
+        obj = schema_mod._from_jsonable(cls, env["item"])
+        self.registry._put(kind, obj)
+        return {"revision": self.registry.revision}
+
+    # -- chunked part sync (sub/chunked_sync.go analog) ----------------------
+    def _on_sync_part(self, env: dict) -> dict:
+        import base64
+
+        phase = env["phase"]
+        session = env["session"]
+        if phase == "begin":
+            # Stage OUTSIDE the shard dir: opening the shard GCs unlisted
+            # entries, which would eat an in-flight session.
+            dest = self.root / ".sync-staging" / session
+            dest.mkdir(parents=True, exist_ok=True)
+            self._sync_sessions[session] = {
+                "dir": dest,
+                "files": {},
+                "group": env["group"],
+                "segment": env["segment"],
+                "shard": env["shard"],
+            }
+            return {"accepted": True}
+        state = self._sync_sessions.get(session)
+        if state is None:
+            raise KeyError(f"unknown sync session {session}")
+        if phase == "chunk":
+            blob = base64.b64decode(env["data"])
+            if zlib.crc32(blob) != env["crc32"]:
+                raise ValueError("chunk CRC mismatch")
+            buf = state["files"].setdefault(env["file"], bytearray())
+            assert len(buf) == env["offset"], "out-of-order chunk"
+            buf.extend(blob)
+            return {"received": len(blob)}
+        if phase == "finish":
+            # materialize the part dir, then introduce it into the shard
+            # (FinishSync -> introduce, §3.2 of SURVEY.md)
+            state = self._sync_sessions.pop(session)
+            for fname, buf in state["files"].items():
+                fs.atomic_write(state["dir"] / fname, bytes(buf))
+            db = self.measure._tsdb(state["group"])
+            seg = db.segment_for(int(env["segment_start_millis"]))
+            shard = seg.shards[int(state["shard"].split("-")[1])]
+            import os
+
+            from banyandb_tpu.storage.part import Part
+
+            with shard._lock:
+                shard._epoch += 1
+                part_name = f"part-{shard._epoch:016x}"
+                final = shard.root / part_name
+                os.rename(state["dir"], final)
+                part = shard._parts[part_name] = Part(final)
+                shard._publish()
+            self._register_synced_series(seg, part)
+            return {"introduced": part_name}
+        raise ValueError(f"bad sync phase {phase}")
+
+    def _register_synced_series(self, seg, part) -> None:
+        """Entity-tag series registration for a shipped part — without it,
+        entity-filtered queries would prune the part's blocks away (the
+        reference ships series docs alongside parts,
+        banyand/measure/write_liaison.go:138 TopicMeasureSeriesSync)."""
+        measure_name = part.meta.get("measure")
+        if not measure_name:
+            return
+        try:
+            m = self.registry.get_measure(
+                part.meta.get("group") or self._group_of(part), measure_name
+            )
+        except (KeyError, RuntimeError):
+            return
+        entity_tags = [t for t in m.entity.tag_names if t in part.meta["tags"]]
+        if len(entity_tags) != len(m.entity.tag_names):
+            return
+        cols = part.read(range(len(part.blocks)), tags=entity_tags)
+        import numpy as np
+
+        series, first_idx = np.unique(cols.series, return_index=True)
+        for sid, i in zip(series.tolist(), first_idx.tolist()):
+            tags = {
+                t: cols.dicts[t][cols.tags[t][i]] for t in entity_tags
+            }
+            tags["@measure"] = measure_name.encode()
+            seg.series_index.insert_series(sid, tags)
+
+    def _group_of(self, part) -> str:
+        # part dirs live at <root>/measure/<group>/seg-*/shard-*/part-*
+        return part.dir.parent.parent.parent.name

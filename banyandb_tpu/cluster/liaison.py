@@ -1,0 +1,234 @@
+"""Liaison role: user gateway + distributed query planner
+(banyand/liaison + banyand/dquery analog).
+
+- Writes: points route by (measure entity -> seriesID -> shard), fan out
+  to the shard's replica set (pkg/node/round_robin.go contract).
+- Aggregate queries: per-shard primary-alive nodes; each node maps its
+  shard subset to Partials on device; liaison reduces
+  (measure_exec.combine_partials) and finalizes.  Percentile runs two
+  rounds so every node's histogram shares the global range.
+- Raw queries: scatter, merge rows, order + limit.
+- Health checking: per-call failover to the next replica, plus an
+  explicit probe() to refresh the alive set (pub.go:301,364 analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import uuid
+from typing import Optional
+
+from banyandb_tpu.api.model import Aggregation, QueryRequest, QueryResult, WriteRequest
+from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.cluster import serde
+from banyandb_tpu.cluster.bus import Topic
+from banyandb_tpu.cluster.node import NodeInfo, RoundRobinSelector
+from banyandb_tpu.cluster.rpc import TransportError
+from banyandb_tpu.query import measure_exec
+from banyandb_tpu.utils import hashing
+
+
+class Liaison:
+    def __init__(
+        self,
+        registry: SchemaRegistry,
+        transport,
+        nodes: list[NodeInfo],
+        *,
+        replicas: int = 0,
+    ):
+        self.registry = registry
+        self.transport = transport
+        self.selector = RoundRobinSelector(nodes, replicas)
+        self.alive: set[str] = {n.name for n in nodes}
+
+    # -- health -------------------------------------------------------------
+    def probe(self) -> set[str]:
+        alive = set()
+        for n in self.selector.nodes:
+            try:
+                r = self.transport.call(n.addr, Topic.HEALTH.value, {}, timeout=5)
+                if r.get("status") == "ok":
+                    alive.add(n.name)
+            except TransportError:
+                pass
+        self.alive = alive
+        return alive
+
+    # -- schema push (barrier-lite: synchronous fan-out) --------------------
+    def sync_schema(self, kind: str, obj) -> None:
+        from banyandb_tpu.api.schema import _to_jsonable
+
+        env = {"kind": kind, "item": _to_jsonable(obj)}
+        for n in self.selector.nodes:
+            if n.name in self.alive:
+                self.transport.call(n.addr, Topic.SCHEMA_SYNC.value, env)
+
+    # -- writes -------------------------------------------------------------
+    def write_measure(self, req: WriteRequest) -> int:
+        """-> number of distinct points accepted (each counted once,
+        regardless of replica fan-out). Raises when a shard has no alive
+        replica — dropping writes silently is never acceptable."""
+        m = self.registry.get_measure(req.group, req.name)
+        shard_num = self.registry.get_group(req.group).resource_opts.shard_num
+        by_node: dict[str, list] = {}
+        addr_of: dict[str, str] = {}
+        accepted = 0
+        for p in req.points:
+            entity = [req.name.encode()] + [
+                hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
+            ]
+            shard = hashing.shard_id(hashing.series_id(entity), shard_num)
+            targets = [
+                n for n in self.selector.replica_set(shard) if n.name in self.alive
+            ]
+            if not targets:
+                raise TransportError(f"no alive replica for shard {shard}")
+            for node in targets:
+                by_node.setdefault(node.name, []).append(p)
+                addr_of[node.name] = node.addr
+            accepted += 1
+        for name, points in by_node.items():
+            env = {
+                "request": serde.write_request_to_json(
+                    WriteRequest(req.group, req.name, tuple(points))
+                )
+            }
+            self.transport.call(addr_of[name], Topic.MEASURE_WRITE.value, env)
+        return accepted
+
+    # -- queries ------------------------------------------------------------
+    def _shard_assignment(self, group: str) -> dict[NodeInfo, list[int]]:
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        assignment: dict[str, tuple[NodeInfo, list[int]]] = {}
+        for shard in range(shard_num):
+            node = self.selector.primary(shard, self.alive)
+            entry = assignment.setdefault(node.name, (node, []))
+            entry[1].append(shard)
+        return {node: shards for node, shards in assignment.values()}
+
+    def _scatter_partials(
+        self,
+        req: QueryRequest,
+        assignment: dict[NodeInfo, list[int]],
+        hist_range: Optional[tuple[float, float]],
+    ) -> list[measure_exec.Partials]:
+        env_base = {
+            "request": serde.query_request_to_json(req),
+            "hist_range": list(hist_range) if hist_range else None,
+        }
+        out = []
+        for node, shards in assignment.items():
+            env = dict(env_base, shards=shards)
+            r = self.transport.call(
+                node.addr, Topic.MEASURE_QUERY_PARTIAL.value, env
+            )
+            out.append(serde.partials_from_json(r["partials"]))
+        return out
+
+    def query_measure(self, req: QueryRequest) -> QueryResult:
+        group = req.groups[0]
+        m = self.registry.get_measure(group, req.name)
+        assignment = self._shard_assignment(group)
+
+        if not (req.agg or req.group_by or req.top):
+            # Raw scatter-gather.  Nodes scan ONLY their assigned shards
+            # (replicated rows must not repeat) and return the first
+            # offset+limit rows each; global offset applies after merge.
+            off = req.offset or 0
+            limit = req.limit or 100
+            node_req = dataclasses.replace(req, offset=0, limit=off + limit)
+            rows: list[dict] = []
+            for node, shards in assignment.items():
+                r = self.transport.call(
+                    node.addr,
+                    Topic.MEASURE_QUERY_RAW.value,
+                    {
+                        "request": serde.query_request_to_json(node_req),
+                        "shards": shards,
+                    },
+                )
+                rows.extend(r["data_points"])
+            rows.sort(
+                key=lambda d: d["timestamp"], reverse=(req.order_by_ts != "asc")
+            )
+            res = QueryResult()
+            res.data_points = rows[off : off + limit]
+            return res
+
+        want_percentile = bool(req.agg and req.agg.function == "percentile")
+        hist_range = None
+        if want_percentile:
+            # Round A: field stats only (agg=min keeps want_minmax on).
+            stats_req = dataclasses.replace(
+                req, agg=Aggregation("min", req.agg.field_name), top=None
+            )
+            stats = self._scatter_partials(stats_req, assignment, None)
+            lo, hi = float("inf"), float("-inf")
+            for p in stats:
+                st = p.field_stats.get(req.agg.field_name)
+                if st:
+                    lo, hi = min(lo, st[0]), max(hi, st[1])
+            if lo > hi:
+                lo, hi = 0.0, 1.0
+            hist_range = (lo, max(hi - lo, 1e-6))
+
+        partials = self._scatter_partials(req, assignment, hist_range)
+        return measure_exec.finalize_partials(m, req, partials)
+
+
+class ChunkedSyncClient:
+    """Ship a sealed part to a data node (pub/chunked_sync.go analog):
+    logical files, 1 MiB chunks, CRC32 per chunk."""
+
+    CHUNK = 1 << 20
+
+    def __init__(self, transport, addr: str):
+        self.transport = transport
+        self.addr = addr
+
+    def sync_part(
+        self,
+        part_dir,
+        *,
+        group: str,
+        segment: str,
+        segment_start_millis: int,
+        shard: str,
+    ) -> str:
+        import zlib
+        import base64
+        from pathlib import Path
+
+        part_dir = Path(part_dir)
+        session = uuid.uuid4().hex
+        base = {
+            "session": session,
+            "group": group,
+            "segment": segment,
+            "segment_start_millis": segment_start_millis,
+            "shard": shard,
+        }
+        self.transport.call(
+            self.addr, Topic.SYNC_PART.value, dict(base, phase="begin")
+        )
+        for f in sorted(part_dir.iterdir()):
+            data = f.read_bytes()
+            for off in range(0, max(len(data), 1), self.CHUNK):
+                blob = data[off : off + self.CHUNK]
+                self.transport.call(
+                    self.addr,
+                    Topic.SYNC_PART.value,
+                    dict(
+                        base,
+                        phase="chunk",
+                        file=f.name,
+                        offset=off,
+                        data=base64.b64encode(blob).decode(),
+                        crc32=zlib.crc32(blob),
+                    ),
+                )
+        r = self.transport.call(
+            self.addr, Topic.SYNC_PART.value, dict(base, phase="finish")
+        )
+        return r["introduced"]

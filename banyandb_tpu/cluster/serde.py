@@ -1,0 +1,196 @@
+"""Wire serialization for cluster envelopes (JSON + base64 for bytes,
+lists for small numpy arrays).
+
+The partial-aggregate payload is the analog of the reference's
+InternalQueryResponse with agg_return_partial
+(api/proto/banyandb/measure/v1/query.proto); a binary columnar frame mode
+(RawFrameSource analog) can replace this later without changing callers.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from banyandb_tpu.api.model import (
+    Aggregation,
+    Condition,
+    GroupBy,
+    LogicalExpression,
+    QueryRequest,
+    TimeRange,
+    Top,
+    DataPointValue,
+    WriteRequest,
+)
+from banyandb_tpu.query.measure_exec import Partials
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+# -- criteria ---------------------------------------------------------------
+
+
+def criteria_to_json(c) -> Optional[dict]:
+    if c is None:
+        return None
+    if isinstance(c, Condition):
+        v = c.value
+        if isinstance(v, bytes):
+            v = {"@bytes": _b64(v)}
+        elif isinstance(v, (list, tuple)):
+            v = [{"@bytes": _b64(x)} if isinstance(x, bytes) else x for x in v]
+        return {"kind": "cond", "name": c.name, "op": c.op, "value": v}
+    if isinstance(c, LogicalExpression):
+        return {
+            "kind": "le",
+            "op": c.op,
+            "left": criteria_to_json(c.left),
+            "right": criteria_to_json(c.right),
+        }
+    raise TypeError(type(c))
+
+
+def criteria_from_json(d: Optional[dict]):
+    if d is None:
+        return None
+    if d["kind"] == "cond":
+        v = d["value"]
+        if isinstance(v, dict) and "@bytes" in v:
+            v = _unb64(v["@bytes"])
+        elif isinstance(v, list):
+            v = [
+                _unb64(x["@bytes"]) if isinstance(x, dict) and "@bytes" in x else x
+                for x in v
+            ]
+        return Condition(d["name"], d["op"], v)
+    return LogicalExpression(
+        d["op"], criteria_from_json(d["left"]), criteria_from_json(d["right"])
+    )
+
+
+# -- requests ---------------------------------------------------------------
+
+
+def query_request_to_json(r: QueryRequest) -> dict:
+    return {
+        "groups": list(r.groups),
+        "name": r.name,
+        "time_range": [r.time_range.begin_millis, r.time_range.end_millis],
+        "criteria": criteria_to_json(r.criteria),
+        "tag_projection": list(r.tag_projection),
+        "field_projection": list(r.field_projection),
+        "group_by": list(r.group_by.tag_names) if r.group_by else None,
+        "agg": dataclasses.asdict(r.agg) if r.agg else None,
+        "top": dataclasses.asdict(r.top) if r.top else None,
+        "limit": r.limit,
+        "offset": r.offset,
+        "order_by_ts": r.order_by_ts,
+        "trace": r.trace,
+        "stages": list(r.stages),
+    }
+
+
+def query_request_from_json(d: dict) -> QueryRequest:
+    agg = d.get("agg")
+    top = d.get("top")
+    return QueryRequest(
+        groups=tuple(d["groups"]),
+        name=d["name"],
+        time_range=TimeRange(*d["time_range"]),
+        criteria=criteria_from_json(d.get("criteria")),
+        tag_projection=tuple(d.get("tag_projection", ())),
+        field_projection=tuple(d.get("field_projection", ())),
+        group_by=GroupBy(tuple(d["group_by"])) if d.get("group_by") else None,
+        agg=Aggregation(agg["function"], agg["field_name"], tuple(agg.get("quantiles", ())))
+        if agg
+        else None,
+        top=Top(top["number"], top["field_name"], top.get("field_value_sort", "desc"))
+        if top
+        else None,
+        limit=d.get("limit", 100),
+        offset=d.get("offset", 0),
+        order_by_ts=d.get("order_by_ts", ""),
+        trace=d.get("trace", False),
+        stages=tuple(d.get("stages", ())),
+    )
+
+
+def write_request_to_json(r: WriteRequest) -> dict:
+    return {
+        "group": r.group,
+        "name": r.name,
+        "points": [
+            {
+                "ts": p.ts_millis,
+                "tags": {
+                    k: {"@bytes": _b64(v)} if isinstance(v, bytes) else v
+                    for k, v in p.tags.items()
+                },
+                "fields": dict(p.fields),
+                "version": p.version,
+            }
+            for p in r.points
+        ],
+    }
+
+
+def write_request_from_json(d: dict) -> WriteRequest:
+    pts = []
+    for p in d["points"]:
+        tags = {
+            k: _unb64(v["@bytes"]) if isinstance(v, dict) and "@bytes" in v else v
+            for k, v in p["tags"].items()
+        }
+        pts.append(
+            DataPointValue(p["ts"], tags, dict(p["fields"]), p.get("version", 0))
+        )
+    return WriteRequest(d["group"], d["name"], tuple(pts))
+
+
+# -- partial aggregates -----------------------------------------------------
+
+
+def partials_to_json(p: Partials) -> dict:
+    return {
+        "group_tags": list(p.group_tags),
+        "groups": [[_b64(v) for v in g] for g in p.groups],
+        "count": p.count.tolist(),
+        "sums": {f: a.tolist() for f, a in p.sums.items()},
+        "mins": {f: a.tolist() for f, a in p.mins.items()},
+        "maxs": {f: a.tolist() for f, a in p.maxs.items()},
+        "hist": _b64(p.hist.astype(np.float64).tobytes()) if p.hist is not None else None,
+        "hist_shape": list(p.hist.shape) if p.hist is not None else None,
+        "hist_lo": p.hist_lo,
+        "hist_span": p.hist_span,
+        "field_stats": {f: list(v) for f, v in p.field_stats.items()},
+    }
+
+
+def partials_from_json(d: dict) -> Partials:
+    hist = None
+    if d.get("hist") is not None:
+        hist = np.frombuffer(_unb64(d["hist"]), dtype=np.float64).reshape(
+            d["hist_shape"]
+        ).copy()
+    return Partials(
+        group_tags=tuple(d["group_tags"]),
+        groups=[tuple(_unb64(v) for v in g) for g in d["groups"]],
+        count=np.asarray(d["count"], dtype=np.float64),
+        sums={f: np.asarray(a) for f, a in d["sums"].items()},
+        mins={f: np.asarray(a) for f, a in d["mins"].items()},
+        maxs={f: np.asarray(a) for f, a in d["maxs"].items()},
+        hist=hist,
+        hist_lo=d["hist_lo"],
+        hist_span=d["hist_span"],
+        field_stats={f: tuple(v) for f, v in d.get("field_stats", {}).items()},
+    )

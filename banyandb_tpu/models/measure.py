@@ -39,9 +39,12 @@ class MeasureEngine:
     def __init__(self, registry: SchemaRegistry, root: str | Path):
         from banyandb_tpu.models.topn import TopNProcessorManager
 
+        import threading
+
         self.registry = registry
         self.root = Path(root) / "measure"
         self._tsdbs: dict[str, TSDB] = {}
+        self._tsdb_lock = threading.Lock()
         self._loops = None
         self.topn = TopNProcessorManager(self)
 
@@ -62,19 +65,23 @@ class MeasureEngine:
 
     # -- plumbing ----------------------------------------------------------
     def _tsdb(self, group: str) -> TSDB:
-        db = self._tsdbs.get(group)
-        if db is None:
-            g = self.registry.get_group(group)
-            # One memtable schema per group would be wrong — tag/field sets
-            # differ per measure — so shards key their memtables per measure.
-            db = TSDB(
-                self.root,
-                group,
-                g.resource_opts,
-                mem_factory=lambda: _MultiMeasureMemtable(),
-            )
-            self._tsdbs[group] = db
-        return db
+        # Locked get-or-create: two racing creators would own duplicate
+        # Shard objects over one directory (epoch collisions, lost writes).
+        with self._tsdb_lock:
+            db = self._tsdbs.get(group)
+            if db is None:
+                g = self.registry.get_group(group)
+                # One memtable schema per group would be wrong — tag/field
+                # sets differ per measure — so shards key their memtables
+                # per measure.
+                db = TSDB(
+                    self.root,
+                    group,
+                    g.resource_opts,
+                    mem_factory=lambda: _MultiMeasureMemtable(),
+                )
+                self._tsdbs[group] = db
+            return db
 
     # -- write path (write_standalone.go analog) ---------------------------
     def write(self, req: WriteRequest, _internal: bool = False) -> int:
@@ -145,14 +152,14 @@ class MeasureEngine:
         return out
 
     # -- query path (query.go:88 analog) -----------------------------------
-    def query(self, req: QueryRequest) -> QueryResult:
+    def query(self, req: QueryRequest, shard_ids=None) -> QueryResult:
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
         if m.index_mode:
             # Short-circuit: whole measure lives in the series index
             # (SearchWithoutSeries, measure/query.go:506,559).
-            sources = _index_mode_sources(db, m, req)
+            sources = self._index_sources(db, m, req, shard_ids)
             if req.agg or req.group_by or req.top:
                 return measure_exec.execute_aggregate(m, req, sources)
             return _raw_rows(m, req, sources)
@@ -161,7 +168,7 @@ class MeasureEngine:
         # fresh snapshot (the reference's epoch-reference contract).
         for attempt in range(3):
             try:
-                sources = self._gather_sources(db, m, req)
+                sources = self._gather_sources(db, m, req, shard_ids=shard_ids)
                 break
             except FileNotFoundError:
                 if attempt == 2:
@@ -170,7 +177,58 @@ class MeasureEngine:
             return measure_exec.execute_aggregate(m, req, sources)
         return _raw_rows(m, req, sources)
 
-    def _gather_sources(self, db: TSDB, m: Measure, req: QueryRequest) -> list[ColumnData]:
+    def query_partials(
+        self,
+        req: QueryRequest,
+        shard_ids=None,
+        hist_range=None,
+    ):
+        """Data-node map phase: partial aggregates over (a subset of) local
+        shards (banyand/query processor + agg_return_partial analog)."""
+        group = req.groups[0]
+        m = self.registry.get_measure(group, req.name)
+        db = self._tsdb(group)
+        if m.index_mode:
+            sources = self._index_sources(db, m, req, shard_ids)
+            return measure_exec.compute_partials(
+                m, req, sources, hist_range=hist_range
+            )
+        for attempt in range(3):
+            try:
+                sources = self._gather_sources(db, m, req, shard_ids=shard_ids)
+                break
+            except FileNotFoundError:
+                if attempt == 2:
+                    raise
+        return measure_exec.compute_partials(m, req, sources, hist_range=hist_range)
+
+    def _index_sources(self, db, m, req, shard_ids):
+        """Index-mode sources, optionally restricted to a shard subset
+        (distributed scatter: shard = seriesID % shard_num)."""
+        sources = _index_mode_sources(db, m, req)
+        if shard_ids is None:
+            return sources
+        shard_num = self.registry.get_group(m.group).resource_opts.shard_num
+        out = []
+        for src in sources:
+            mask = np.isin(src.series % shard_num, list(shard_ids))
+            if not mask.any():
+                continue
+            out.append(
+                ColumnData(
+                    ts=src.ts[mask],
+                    series=src.series[mask],
+                    version=src.version[mask],
+                    tags={t: c[mask] for t, c in src.tags.items()},
+                    fields={f: v[mask] for f, v in src.fields.items()},
+                    dicts=src.dicts,
+                )
+            )
+        return out
+
+    def _gather_sources(
+        self, db: TSDB, m: Measure, req: QueryRequest, shard_ids=None
+    ) -> list[ColumnData]:
         sources: list[ColumnData] = []
         tag_names = [t.name for t in m.tags]
         field_names = [f.name for f in m.fields]
@@ -195,7 +253,9 @@ class MeasureEngine:
                 series_ids = np.sort(
                     seg.series_index.search(And(tuple(clauses)))
                 )
-            for shard in seg.shards:
+            for shard_idx, shard in enumerate(seg.shards):
+                if shard_ids is not None and shard_idx not in shard_ids:
+                    continue
                 mem_cols = shard.mem.columns_for(m.name)
                 if mem_cols is not None and mem_cols.ts.size:
                     sources.append(mem_cols)

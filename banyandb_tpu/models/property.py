@@ -41,29 +41,26 @@ class PropertyEngine:
         self._shards: dict[tuple[str, int], InvertedIndex] = {}
         self._revision = int(time.time() * 1000)
 
-    def _shard_for(self, group: str, name: str, pid: str) -> InvertedIndex:
-        g = self.registry.get_group(group)
-        shard_num = g.resource_opts.shard_num
-        sid = hashing.series_id([name.encode(), pid.encode()])
-        shard = hashing.shard_id(sid, shard_num)
-        key = (group, shard)
-        idx = self._shards.get(key)
-        if idx is None:
-            idx = InvertedIndex(self.root / group / f"shard-{shard}.idx")
-            self._shards[key] = idx
-        return idx
-
-    def _all_shards(self, group: str) -> list[InvertedIndex]:
-        g = self.registry.get_group(group)
-        out = []
-        for shard in range(g.resource_opts.shard_num):
+    def _shard_idx(self, group: str, shard: int) -> InvertedIndex:
+        with self._lock:
             key = (group, shard)
             idx = self._shards.get(key)
             if idx is None:
                 idx = InvertedIndex(self.root / group / f"shard-{shard}.idx")
                 self._shards[key] = idx
-            out.append(idx)
-        return out
+            return idx
+
+    def _shard_for(self, group: str, name: str, pid: str) -> InvertedIndex:
+        g = self.registry.get_group(group)
+        sid = hashing.series_id([name.encode(), pid.encode()])
+        return self._shard_idx(group, hashing.shard_id(sid, g.resource_opts.shard_num))
+
+    def _all_shards(self, group: str) -> list[InvertedIndex]:
+        g = self.registry.get_group(group)
+        return [
+            self._shard_idx(group, s)
+            for s in range(g.resource_opts.shard_num)
+        ]
 
     @staticmethod
     def _doc_id(name: str, pid: str) -> int:

@@ -58,9 +58,12 @@ class ElementValue:
 
 class StreamEngine:
     def __init__(self, registry: SchemaRegistry, root: str | Path):
+        import threading
+
         self.registry = registry
         self.root = Path(root) / "stream"
         self._tsdbs: dict[str, TSDB] = {}
+        self._tsdb_lock = threading.Lock()
         self._schemas: dict[tuple[str, str], Stream] = {}
 
     # Streams aren't in the core SchemaRegistry kinds yet; keep a local
@@ -76,17 +79,18 @@ class StreamEngine:
         return s
 
     def _tsdb(self, group: str) -> TSDB:
-        db = self._tsdbs.get(group)
-        if db is None:
-            g = self.registry.get_group(group)
-            db = TSDB(
-                self.root,
-                group,
-                g.resource_opts,
-                mem_factory=lambda: PayloadMemtable("stream"),
-            )
-            self._tsdbs[group] = db
-        return db
+        with self._tsdb_lock:
+            db = self._tsdbs.get(group)
+            if db is None:
+                g = self.registry.get_group(group)
+                db = TSDB(
+                    self.root,
+                    group,
+                    g.resource_opts,
+                    mem_factory=lambda: PayloadMemtable("stream"),
+                )
+                self._tsdbs[group] = db
+            return db
 
     def write(self, group: str, name: str, elements: list[ElementValue]) -> int:
         s = self.get_stream(group, name)

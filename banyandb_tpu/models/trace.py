@@ -64,9 +64,12 @@ class TraceEngine:
     def __init__(self, registry: SchemaRegistry, root: str | Path):
         import os
 
+        import threading
+
         self.registry = registry
         self.root = Path(root) / "trace"
         self._tsdbs: dict[str, TSDB] = {}
+        self._tsdb_lock = threading.Lock()
         self._schemas: dict[tuple[str, str], Trace] = {}
         # ordered-index instances per (group, segment-start, rule-tag)
         self._sidx: dict[tuple, InvertedIndex] = {}
@@ -86,23 +89,25 @@ class TraceEngine:
         return t
 
     def _tsdb(self, group: str) -> TSDB:
-        db = self._tsdbs.get(group)
-        if db is None:
-            g = self.registry.get_group(group)
-            db = TSDB(
-                self.root, group, g.resource_opts,
-                mem_factory=lambda: PayloadMemtable("trace"),
-            )
-            self._tsdbs[group] = db
-        return db
+        with self._tsdb_lock:
+            db = self._tsdbs.get(group)
+            if db is None:
+                g = self.registry.get_group(group)
+                db = TSDB(
+                    self.root, group, g.resource_opts,
+                    mem_factory=lambda: PayloadMemtable("trace"),
+                )
+                self._tsdbs[group] = db
+            return db
 
     def _ordered_index(self, group: str, seg, rule_tag: str) -> InvertedIndex:
-        key = (group, seg.start, rule_tag)
-        idx = self._sidx.get(key)
-        if idx is None:
-            idx = InvertedIndex(seg.root / f"sidx-{rule_tag}.idx")
-            self._sidx[key] = idx
-        return idx
+        with self._tsdb_lock:
+            key = (group, seg.start, rule_tag)
+            idx = self._sidx.get(key)
+            if idx is None:
+                idx = InvertedIndex(seg.root / f"sidx-{rule_tag}.idx")
+                self._sidx[key] = idx
+            return idx
 
     # -- write (svc write path analog) -------------------------------------
     def write(

@@ -186,12 +186,51 @@ def _collect_conditions(c: Optional[Criteria]) -> list[Condition]:
     return _collect_conditions(c.left) + _collect_conditions(c.right)
 
 
+@dataclass
+class Partials:
+    """Per-node partial aggregates keyed by decoded tag-value tuples.
+
+    The wire unit of distributed map-reduce aggregation (the reference's
+    agg_return_partial InternalQueryResponse,
+    docs/concept/distributed-measure-aggregation.md): nodes return these,
+    the liaison combines by group tuple and finalizes.  Arrays cover only
+    nonempty groups (dense [G] layouts never cross nodes).
+    """
+
+    group_tags: tuple[str, ...]
+    groups: list[tuple[bytes, ...]]  # tag-value tuple per nonempty group
+    count: np.ndarray  # f64 [K]
+    sums: dict  # field -> f64 [K]
+    mins: dict
+    maxs: dict
+    hist: Optional[np.ndarray] = None  # [K, B]
+    hist_lo: float = 0.0
+    hist_span: float = 1.0
+    field_stats: dict = dataclasses.field(default_factory=dict)  # f -> (min, max)
+
+
 def execute_aggregate(
     measure: Measure,
     request: QueryRequest,
     sources: list[ColumnData],
 ) -> QueryResult:
     """Run a group-by/aggregate/top-N/percentile query over decoded sources."""
+    partial = compute_partials(measure, request, sources)
+    return finalize_partials(measure, request, [partial])
+
+
+def compute_partials(
+    measure: Measure,
+    request: QueryRequest,
+    sources: list[ColumnData],
+    hist_range: Optional[tuple[float, float]] = None,
+) -> Partials:
+    """The 'map' phase: device scan+reduce over local sources.
+
+    `hist_range` fixes the percentile histogram range (distributed
+    two-pass: the liaison first combines field_stats, then re-requests
+    with the global range so node histograms are combinable).
+    """
     conds = _collect_conditions(request.criteria)
     group_tags = tuple(request.group_by.tag_names) if request.group_by else ()
     agg = request.agg
@@ -266,7 +305,9 @@ def execute_aggregate(
 
     want_percentile = bool(agg and agg.function == "percentile")
     hist_field = agg.field_name if want_percentile else ""
-    want_minmax = not agg or agg.function in ("min", "max")
+    # min/max always computed when percentile (field_stats feed the
+    # distributed two-pass range agreement).
+    want_minmax = not agg or agg.function in ("min", "max") or want_percentile
 
     nrows = CHUNK if n > CHUNK else pad_rows_bucket(max(n, 1))
     spec = PlanSpec(
@@ -285,7 +326,9 @@ def execute_aggregate(
         kernel = _KERNEL_CACHE[spec] = _build_kernel(spec)
 
     # --- histogram range from host stats (two-pass percentile) ------------
-    if want_percentile and n:
+    if hist_range is not None:
+        hist_lo, hist_span = hist_range
+    elif want_percentile and n:
         fv = chunks_np["fields"][hist_field]
         hist_lo = float(fv.min())
         hist_span = max(float(fv.max()) - hist_lo, 1e-6)
@@ -316,9 +359,38 @@ def execute_aggregate(
         if hist is not None:
             hist += np.asarray(out["hist"], dtype=np.float64)
 
-    return _finalize(
-        request, gd, group_tags, radices, count, sums, mins, maxs, hist,
-        hist_lo, hist_span,
+    # --- dense [G] arrays -> nonempty-group records ------------------------
+    if group_tags:
+        nz = np.nonzero(count > 0)[0]
+        codes = np.unravel_index(nz, radices) if len(nz) else [np.zeros(0, int)] * max(len(radices), 1)
+        values = {t: gd.values(t) for t in group_tags}
+        groups = [
+            tuple(values[t][int(codes[i][row])] for i, t in enumerate(group_tags))
+            for row in range(len(nz))
+        ]
+    else:
+        nz = np.asarray([0])
+        groups = [()]
+    field_stats = {}
+    if want_minmax:
+        for f in spec.fields:
+            valid_groups = count > 0
+            if valid_groups.any():
+                field_stats[f] = (
+                    float(mins[f][valid_groups].min()),
+                    float(maxs[f][valid_groups].max()),
+                )
+    return Partials(
+        group_tags=group_tags,
+        groups=groups,
+        count=count[nz],
+        sums={f: sums[f][nz] for f in spec.fields},
+        mins={f: mins[f][nz] for f in spec.fields},
+        maxs={f: maxs[f][nz] for f in spec.fields},
+        hist=hist[nz] if hist is not None else None,
+        hist_lo=hist_lo,
+        hist_span=hist_span,
+        field_stats=field_stats,
     )
 
 
@@ -416,43 +488,100 @@ def _device_chunk(cols: dict, start: int, end: int, spec: PlanSpec, epoch: int) 
     }
 
 
-def _finalize(
-    request: QueryRequest,
-    gd: GlobalDicts,
-    group_tags: tuple[str, ...],
-    radices: tuple[int, ...],
-    count: np.ndarray,
-    sums: dict,
-    mins: dict,
-    maxs: dict,
-    hist: Optional[np.ndarray],
-    hist_lo: float,
-    hist_span: float,
-) -> QueryResult:
-    agg = request.agg
-    nonempty = count > 0
-    G = count.shape[0]
+def combine_partials(partials: list[Partials]) -> Partials:
+    """The 'reduce' phase: merge node partials by group tuple.
 
-    # Aggregate value per group for the requested function.
+    Histograms only combine when every contributing partial used the same
+    (hist_lo, hist_span) — the distributed two-pass guarantees this.
+    """
+    base = partials[0]
+    want_hist = base.hist is not None
+    index: dict[tuple, int] = {}
+    groups: list[tuple] = []
+    count_l: list[float] = []
+    fields = sorted(base.sums.keys())
+    sums_l: dict[str, list] = {f: [] for f in fields}
+    mins_l: dict[str, list] = {f: [] for f in fields}
+    maxs_l: dict[str, list] = {f: [] for f in fields}
+    hist_l: list[np.ndarray] = []
+    field_stats: dict[str, tuple[float, float]] = {}
+
+    for p in partials:
+        if want_hist and (p.hist_lo != base.hist_lo or p.hist_span != base.hist_span):
+            raise ValueError("histogram partials with mismatched ranges")
+        for k, g in enumerate(p.groups):
+            i = index.get(g)
+            if i is None:
+                i = index[g] = len(groups)
+                groups.append(g)
+                count_l.append(0.0)
+                for f in fields:
+                    sums_l[f].append(0.0)
+                    mins_l[f].append(np.inf)
+                    maxs_l[f].append(-np.inf)
+                if want_hist:
+                    hist_l.append(np.zeros(_NUM_HIST_BUCKETS))
+            count_l[i] += float(p.count[k])
+            for f in fields:
+                sums_l[f][i] += float(p.sums[f][k])
+                mins_l[f][i] = min(mins_l[f][i], float(p.mins[f][k]))
+                maxs_l[f][i] = max(maxs_l[f][i], float(p.maxs[f][k]))
+            if want_hist and p.hist is not None:
+                hist_l[i] += p.hist[k]
+        for f, (lo, hi) in p.field_stats.items():
+            old = field_stats.get(f)
+            field_stats[f] = (
+                min(lo, old[0]) if old else lo,
+                max(hi, old[1]) if old else hi,
+            )
+
+    return Partials(
+        group_tags=base.group_tags,
+        groups=groups,
+        count=np.asarray(count_l),
+        sums={f: np.asarray(sums_l[f]) for f in fields},
+        mins={f: np.asarray(mins_l[f]) for f in fields},
+        maxs={f: np.asarray(maxs_l[f]) for f in fields},
+        hist=np.stack(hist_l) if want_hist and hist_l else (np.zeros((0, _NUM_HIST_BUCKETS)) if want_hist else None),
+        hist_lo=base.hist_lo,
+        hist_span=base.hist_span,
+        field_stats=field_stats,
+    )
+
+
+def finalize_partials(
+    measure: Measure, request: QueryRequest, partials: list[Partials]
+) -> QueryResult:
+    """Combine + select + decode: the liaison-side tail of the query."""
+    p = combine_partials(partials) if len(partials) != 1 else partials[0]
+    agg = request.agg
+    group_tags = p.group_tags
+    count = p.count
+    nonempty = count > 0
+
     def agg_values(fn: str, field: str) -> np.ndarray:
         if fn == "count":
             return count
         if fn == "sum":
-            return sums[field]
+            return p.sums[field]
         if fn == "mean":
-            return sums[field] / np.maximum(count, 1)
+            return p.sums[field] / np.maximum(count, 1)
         if fn == "min":
-            return mins[field]
+            return p.mins[field]
         if fn == "max":
-            return maxs[field]
+            return p.maxs[field]
         raise ValueError(f"unknown aggregate {fn}")
 
     result = QueryResult()
-    # Without group_by there is exactly one logical group: report it even
-    # when empty (a global count over no rows is 0, not "no result").
-    group_ids = (
-        np.asarray([0]) if not group_tags else np.nonzero(nonempty)[0]
-    )
+    if not group_tags:
+        # One logical group, reported even when empty (global count == 0).
+        group_ids = np.asarray([0]) if len(p.groups) else np.zeros(0, int)
+        if not len(p.groups):
+            p.groups = [()]
+            count = np.zeros(1)
+            group_ids = np.asarray([0])
+    else:
+        group_ids = np.nonzero(nonempty)[0]
 
     # Top-N selection narrows the group id set.  Ranking field is
     # top.field_name; the ranking function is the request's aggregate when
@@ -475,25 +604,24 @@ def _finalize(
 
     group_ids = group_ids[: request.limit] if request.limit else group_ids
 
-    # Decode group tuples back to tag values.
-    if group_tags:
-        codes = np.unravel_index(group_ids, radices) if len(group_ids) else [np.zeros(0, int)] * len(radices)
-        tag_values = {t: gd.values(t) for t in group_tags}
-        for row in range(len(group_ids)):
-            result.groups.append(
-                tuple(
-                    tag_values[t][int(codes[i][row])].decode(errors="replace")
-                    for i, t in enumerate(group_tags)
-                )
+    # Decode group tuples (bytes) to client values via the schema types.
+    from banyandb_tpu.query import filter as qfilter
+
+    for g in group_ids:
+        raw = p.groups[int(g)]
+        result.groups.append(
+            tuple(
+                qfilter.decode_tag_value(v, measure.tag(t).type)
+                for t, v in zip(group_tags, raw)
             )
-    else:
-        result.groups = [()] * len(group_ids)
+        )
 
     if agg:
         if agg.function == "percentile":
             qs = list(agg.quantiles or (0.5,))
-            vals = _invert_histogram(hist, group_ids, qs, hist_lo, hist_span)
-            result.values[f"percentile({agg.field_name})"] = vals
+            result.values[f"percentile({agg.field_name})"] = _invert_histogram(
+                p.hist, group_ids, qs, p.hist_lo, p.hist_span
+            )
         else:
             v = agg_values(agg.function, agg.field_name)[group_ids]
             result.values[f"{agg.function}({agg.field_name})"] = v.tolist()
@@ -502,12 +630,16 @@ def _finalize(
 
 
 def _invert_histogram(
-    hist: np.ndarray, group_ids: np.ndarray, qs: list[float], lo: float, span: float
+    hist: Optional[np.ndarray],
+    group_ids: np.ndarray,
+    qs: list[float],
+    lo: float,
+    span: float,
 ) -> list[list[float]]:
     width = span / _NUM_HIST_BUCKETS
     out = []
     for g in group_ids:
-        counts = hist[g]
+        counts = hist[g] if hist is not None and g < len(hist) else np.zeros(1)
         cdf = np.cumsum(counts)
         total = cdf[-1]
         row = []

@@ -1,0 +1,302 @@
+"""Cluster fabric: liaison/data roles, scatter-gather map-reduce, replica
+failover, chunked part sync, schema sync — in-process nodes (the
+reference's pkg/test/setup trick) + a real-gRPC smoke test."""
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api import (
+    Aggregation,
+    Catalog,
+    Condition,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    GroupBy,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    Top,
+    WriteRequest,
+)
+from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo
+from banyandb_tpu.cluster.liaison import ChunkedSyncClient
+from banyandb_tpu.cluster.rpc import GrpcBusServer, GrpcTransport, LocalTransport
+
+T0 = 1_700_000_000_000
+
+
+def _schema(reg, shard_num=4, replicas=0):
+    reg.create_group(
+        Group("sw", Catalog.MEASURE, ResourceOpts(shard_num=shard_num, replicas=replicas))
+    )
+    reg.create_measure(
+        Measure(
+            group="sw", name="cpm",
+            tags=(TagSpec("svc", TagType.STRING), TagSpec("region", TagType.STRING)),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+
+
+def _cluster(tmp_path, n_nodes=2, shard_num=4, replicas=0):
+    transport = LocalTransport()
+    nodes = []
+    datanodes = []
+    for i in range(n_nodes):
+        reg = SchemaRegistry(tmp_path / f"node{i}")
+        _schema(reg, shard_num, replicas)
+        dn = DataNode(f"data-{i}", reg, tmp_path / f"node{i}" / "data")
+        addr = transport.register(dn.name, dn.bus)
+        nodes.append(NodeInfo(dn.name, addr))
+        datanodes.append(dn)
+    liaison_reg = SchemaRegistry(tmp_path / "liaison")
+    _schema(liaison_reg, shard_num, replicas)
+    liaison = Liaison(liaison_reg, transport, nodes, replicas=replicas)
+    return transport, liaison, datanodes
+
+
+def _points(n, seed=3):
+    rng = np.random.default_rng(seed)
+    svc = rng.integers(0, 12, n)
+    region = rng.integers(0, 3, n)
+    val = rng.gamma(2.0, 50.0, n)
+    return svc, region, val, tuple(
+        DataPointValue(
+            T0 + i,
+            {"svc": f"svc-{svc[i]}", "region": f"r{region[i]}"},
+            {"v": float(val[i])},
+            version=1,
+        )
+        for i in range(n)
+    )
+
+
+def test_distributed_write_and_aggregate(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path)
+    svc, region, val, pts = _points(3000)
+    liaison.write_measure(WriteRequest("sw", "cpm", pts))
+    # data is spread: every node should hold some rows
+    for dn in datanodes:
+        r = dn.measure.query(
+            QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 10_000), agg=Aggregation("count", "v"))
+        )
+        assert r.values["count"][0] > 0
+
+    res = liaison.query_measure(
+        QueryRequest(
+            ("sw",), "cpm", TimeRange(T0, T0 + 10_000),
+            group_by=GroupBy(("svc",)), agg=Aggregation("sum", "v"), limit=50,
+        )
+    )
+    got = dict(zip([g[0] for g in res.groups], res.values["sum(v)"]))
+    for s in range(12):
+        assert got[f"svc-{s}"] == pytest.approx(val[svc == s].sum(), rel=1e-4)
+
+
+def test_distributed_percentile_two_rounds(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path)
+    svc, region, val, pts = _points(6000)
+    liaison.write_measure(WriteRequest("sw", "cpm", pts))
+    res = liaison.query_measure(
+        QueryRequest(
+            ("sw",), "cpm", TimeRange(T0, T0 + 10_000),
+            group_by=GroupBy(("region",)),
+            agg=Aggregation("percentile", "v", quantiles=(0.5, 0.95)),
+        )
+    )
+    got = dict(zip([g[0] for g in res.groups], res.values["percentile(v)"]))
+    for r in range(3):
+        expect = np.quantile(val[region == r], [0.5, 0.95])
+        span = val.max() - val.min()
+        np.testing.assert_allclose(got[f"r{r}"], expect, atol=span / 100)
+
+
+def test_distributed_raw_query(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path)
+    svc, region, val, pts = _points(500)
+    liaison.write_measure(WriteRequest("sw", "cpm", pts))
+    res = liaison.query_measure(
+        QueryRequest(
+            ("sw",), "cpm", TimeRange(T0, T0 + 10_000),
+            criteria=Condition("region", "eq", "r1"),
+            limit=25,
+        )
+    )
+    assert 0 < len(res.data_points) <= 25
+    assert all(dp["tags"]["region"] == "r1" for dp in res.data_points)
+    ts = [dp["timestamp"] for dp in res.data_points]
+    assert ts == sorted(ts, reverse=True)
+
+
+def test_replica_failover(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=3, replicas=1)
+    svc, region, val, pts = _points(2000)
+    liaison.write_measure(WriteRequest("sw", "cpm", pts))
+
+    def total():
+        res = liaison.query_measure(
+            QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 10_000), agg=Aggregation("sum", "v"))
+        )
+        return res.values["sum(v)"][0]
+
+    before = total()
+    assert before == pytest.approx(val.sum(), rel=1e-4)  # replicas not double-counted
+    # kill node 0; failover must keep the answer complete
+    transport.unregister("data-0")
+    liaison.probe()
+    assert liaison.alive == {"data-1", "data-2"}
+    assert total() == pytest.approx(before, rel=1e-6)
+
+
+def test_raw_query_pagination_and_replica_dedup(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=3, replicas=1)
+    pts = tuple(
+        DataPointValue(T0 + i, {"svc": f"svc-{i % 5}", "region": "r0"}, {"v": 1.0}, version=1)
+        for i in range(60)
+    )
+    assert liaison.write_measure(WriteRequest("sw", "cpm", pts)) == 60
+
+    # replicas must not duplicate raw rows
+    res = liaison.query_measure(
+        QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 1000), limit=200)
+    )
+    assert len(res.data_points) == 60
+
+    # pagination: rows 20..29 in ascending ts order
+    res = liaison.query_measure(
+        QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 1000),
+                     order_by_ts="asc", offset=20, limit=10)
+    )
+    assert [dp["timestamp"] for dp in res.data_points] == [T0 + i for i in range(20, 30)]
+
+
+def test_write_raises_when_shard_has_no_replica(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=2, replicas=0)
+    transport.unregister("data-0")
+    liaison.probe()
+    from banyandb_tpu.cluster.rpc import TransportError
+
+    svc, region, val, pts = _points(50)
+    with pytest.raises(TransportError, match="no alive replica"):
+        liaison.write_measure(WriteRequest("sw", "cpm", pts))
+
+
+def test_synced_part_visible_to_entity_filtered_query(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=1, shard_num=1)
+    dn = datanodes[0]
+    # destination already has local writes (series index non-empty)
+    liaison.write_measure(WriteRequest("sw", "cpm", (
+        DataPointValue(T0, {"svc": "local", "region": "r0"}, {"v": 1.0}, version=1),)))
+    # ship a part holding a DIFFERENT entity
+    reg = SchemaRegistry(tmp_path / "builder")
+    _schema(reg, shard_num=1)
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    builder = MeasureEngine(reg, tmp_path / "builder" / "data")
+    builder.write(WriteRequest("sw", "cpm", (
+        DataPointValue(T0 + 1, {"svc": "shipped", "region": "r0"}, {"v": 7.0}, version=1),)))
+    builder.flush()
+    seg = builder._tsdb("sw").segments[0]
+    ChunkedSyncClient(transport, "local:data-0").sync_part(
+        seg.shards[0].parts[0].dir,
+        group="sw", segment=seg.root.name,
+        segment_start_millis=seg.start, shard="shard-0",
+    )
+    r = dn.measure.query(
+        QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 100),
+                     criteria=Condition("svc", "eq", "shipped"),
+                     agg=Aggregation("sum", "v"))
+    )
+    assert r.values["sum(v)"][0] == 7.0
+
+
+def test_schema_sync_pushes_to_nodes(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path)
+    new_measure = Measure(
+        group="sw", name="latency",
+        tags=(TagSpec("svc", TagType.STRING),),
+        fields=(FieldSpec("ms", FieldType.FLOAT),),
+        entity=Entity(("svc",)),
+    )
+    liaison.registry.create_measure(new_measure)
+    liaison.sync_schema("measure", new_measure)
+    for dn in datanodes:
+        assert dn.registry.get_measure("sw", "latency").name == "latency"
+    # and writes against the new measure work end-to-end
+    liaison.write_measure(
+        WriteRequest("sw", "latency", (
+            DataPointValue(T0, {"svc": "a"}, {"ms": 5.0}, version=1),))
+    )
+
+
+def test_chunked_part_sync(tmp_path):
+    """Build a part on a 'liaison-local' engine, ship it, query it remotely."""
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=1, shard_num=1)
+    # local builder (wqueue analog): write + flush to get a sealed part
+    reg = SchemaRegistry(tmp_path / "builder")
+    _schema(reg, shard_num=1)
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    builder = MeasureEngine(reg, tmp_path / "builder" / "data")
+    svc, region, val, pts = _points(800, seed=9)
+    builder.write(WriteRequest("sw", "cpm", pts))
+    builder.flush()
+    db = builder._tsdb("sw")
+    seg = db.segments[0]
+    part = seg.shards[0].parts[0]
+
+    client = ChunkedSyncClient(transport, "local:data-0")
+    introduced = client.sync_part(
+        part.dir,
+        group="sw",
+        segment=seg.root.name,
+        segment_start_millis=seg.start,
+        shard="shard-0",
+    )
+    assert introduced.startswith("part-")
+    r = datanodes[0].measure.query(
+        QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 10_000), agg=Aggregation("count", "v"))
+    )
+    assert r.values["count"][0] == 800
+
+
+def test_grpc_transport_end_to_end(tmp_path):
+    """Real sockets: two data nodes behind gRPC, liaison over GrpcTransport."""
+    servers = []
+    nodes = []
+    datanodes = []
+    try:
+        for i in range(2):
+            reg = SchemaRegistry(tmp_path / f"g{i}")
+            _schema(reg, shard_num=2)
+            dn = DataNode(f"gdata-{i}", reg, tmp_path / f"g{i}" / "data")
+            srv = GrpcBusServer(dn.bus)
+            srv.start()
+            servers.append(srv)
+            nodes.append(NodeInfo(dn.name, srv.addr))
+            datanodes.append(dn)
+        transport = GrpcTransport()
+        liaison_reg = SchemaRegistry(tmp_path / "gl")
+        _schema(liaison_reg, shard_num=2)
+        liaison = Liaison(liaison_reg, transport, nodes)
+        assert liaison.probe() == {"gdata-0", "gdata-1"}
+
+        svc, region, val, pts = _points(400, seed=2)
+        liaison.write_measure(WriteRequest("sw", "cpm", pts))
+        res = liaison.query_measure(
+            QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 10_000),
+                         agg=Aggregation("sum", "v"))
+        )
+        assert res.values["sum(v)"][0] == pytest.approx(val.sum(), rel=1e-4)
+        transport.close()
+    finally:
+        for srv in servers:
+            srv.stop()
