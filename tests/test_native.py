@@ -1,0 +1,52 @@
+"""Native module (cpp/libbydb_native.so) vs Python/NumPy oracles.
+
+Skipped when the .so isn't built (`make -C cpp`)."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.utils import encoding as enc
+from banyandb_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    native.lib() is None, reason="native lib not built (make -C cpp)"
+)
+
+RNG = np.random.default_rng(17)
+
+
+def test_delta_roundtrip_widths():
+    for scale in (3, 300, 100_000, 2**40):
+        v = (RNG.integers(-scale, scale, 1000)).cumsum() + 1_700_000_000_000
+        payload, width = native.delta_encode(v)
+        out = native.delta_decode(int(v[0]), payload, len(v), width)
+        np.testing.assert_array_equal(out, v)
+
+
+def test_delta_matches_python_format():
+    """Native and NumPy paths must produce byte-identical column blobs."""
+    v = np.arange(0, 5000, 7, dtype=np.int64) + 1_700_000_000_000
+    payload, width = native.delta_encode(v)
+    deltas = np.diff(v)
+    packed, pywidth = enc._downcast(deltas)
+    assert width == pywidth
+    assert payload == packed.tobytes()
+    # and the full encode_int64 blob decodes either way
+    blob = enc.encode_int64(v)
+    np.testing.assert_array_equal(enc.decode_int64(blob, len(v)), v)
+
+
+def test_zigzag_varint_roundtrip():
+    v = RNG.integers(-(2**50), 2**50, 500)
+    v[:10] = [0, -1, 1, -2, 2, 127, -128, 2**31, -(2**31), 2**62]
+    payload = native.zigzag_varint_encode(v)
+    out = native.zigzag_varint_decode(payload, len(v))
+    np.testing.assert_array_equal(out, v)
+
+
+def test_crc32_matches_zlib():
+    data = bytes(RNG.integers(0, 255, 10_000, dtype=np.uint8))
+    assert native.crc32(data) == zlib.crc32(data)
+    assert native.crc32(b"") == zlib.crc32(b"")
