@@ -1,0 +1,256 @@
+"""BydbQL: the SQL-ish query language (pkg/bydbql analog).
+
+Grammar (subset matching what the engines support; reference grammar at
+pkg/bydbql/grammar.go, parser.go:67):
+
+    SELECT <projection> FROM MEASURE <name> IN <group>
+        [ TIME > <millis> AND TIME < <millis> | TIME BETWEEN a AND b ]
+        [ WHERE <cond> (AND <cond>)* ]
+        [ GROUP BY tag (, tag)* ]
+        [ TOP <n> BY <field> [ASC|DESC] ]
+        [ ORDER BY TIME [ASC|DESC] ]
+        [ LIMIT <n> ] [ OFFSET <n> ]
+
+    projection := * | item (, item)*
+    item       := tag | field | fn '(' field ')' | PERCENTILE(field, q, ...)
+    fn         := SUM | COUNT | MIN | MAX | MEAN | AVG
+    cond       := name op literal | name IN (lit, ...) | name NOT IN (...)
+    op         := = | != | < | <= | > | >=
+
+Hand-written tokenizer + recursive descent -> api.model.QueryRequest.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from banyandb_tpu.api.model import (
+    Aggregation,
+    Condition,
+    GroupBy,
+    LogicalExpression,
+    QueryRequest,
+    TimeRange,
+    Top,
+)
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<num>-?\d+(?:\.\d+)?)
+      | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+      | (?P<op><=|>=|!=|=|<|>|\(|\)|,|\*)
+      | (?P<word>[A-Za-z_][A-Za-z0-9_.\-]*)
+    )""",
+    re.VERBOSE,
+)
+
+_AGG_FNS = {"sum", "count", "min", "max", "mean", "avg", "percentile"}
+
+
+class QLError(ValueError):
+    pass
+
+
+def _tokenize(text: str) -> list[tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m:
+            if text[pos:].strip():
+                raise QLError(f"bad token at: {text[pos:pos+20]!r}")
+            break
+        pos = m.end()
+        for kind in ("num", "str", "op", "word"):
+            v = m.group(kind)
+            if v is not None:
+                out.append((kind, v))
+                break
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect_word(self, *words) -> str:
+        kind, v = self.next()
+        if kind != "word" or v.lower() not in words:
+            raise QLError(f"expected {'/'.join(words).upper()}, got {v!r}")
+        return v.lower()
+
+    def accept_word(self, *words) -> Optional[str]:
+        kind, v = self.peek()
+        if kind == "word" and v.lower() in words:
+            self.next()
+            return v.lower()
+        return None
+
+    def expect_op(self, op: str):
+        kind, v = self.next()
+        if kind != "op" or v != op:
+            raise QLError(f"expected {op!r}, got {v!r}")
+
+    def literal(self):
+        kind, v = self.next()
+        if kind == "num":
+            return float(v) if "." in v else int(v)
+        if kind == "str":
+            return v[1:-1].replace("\\'", "'").replace('\\"', '"')
+        if kind == "word":
+            return v  # bare identifier treated as string literal
+        raise QLError(f"expected literal, got {v!r}")
+
+
+def parse(text: str) -> QueryRequest:
+    return parse_with_catalog(text)[1]
+
+
+def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
+    """-> (catalog, request); catalog is "measure" | "stream"."""
+    p = _Parser(_tokenize(text))
+    p.expect_word("select")
+
+    # ---- projection ----
+    projections: list = []
+    agg: Optional[Aggregation] = None
+    if p.peek() == ("op", "*"):
+        p.next()
+    else:
+        while True:
+            kind, v = p.next()
+            if kind != "word":
+                raise QLError(f"bad projection item {v!r}")
+            name = v
+            if p.peek() == ("op", "(") and name.lower() in _AGG_FNS:
+                p.next()
+                field = p.next()[1]
+                fn = "mean" if name.lower() == "avg" else name.lower()
+                qs: list[float] = []
+                while p.peek() == ("op", ","):
+                    p.next()
+                    qs.append(float(p.next()[1]))
+                p.expect_op(")")
+                if agg is not None:
+                    raise QLError("only one aggregate per query")
+                agg = Aggregation(fn, field, tuple(qs))
+            else:
+                projections.append(name)
+            if p.peek() == ("op", ","):
+                p.next()
+                continue
+            break
+
+    p.expect_word("from")
+    catalog = p.expect_word("measure", "stream")
+    name = p.next()[1]
+    p.expect_word("in")
+    group = p.next()[1]
+
+    begin, end = 0, 2**62
+    criteria = None
+    group_by = None
+    top = None
+    limit, offset = 100, 0
+    order_by_ts = ""
+
+    def add_cond(c: Condition):
+        nonlocal criteria
+        criteria = c if criteria is None else LogicalExpression("and", criteria, c)
+
+    while True:
+        kw = p.accept_word(
+            "time", "where", "group", "top", "order", "limit", "offset"
+        )
+        if kw is None:
+            kind, v = p.peek()
+            if kind == "eof":
+                break
+            raise QLError(f"unexpected {v!r}")
+        if kw == "time":
+            kind, op = p.next()
+            if kind == "word" and op.lower() == "between":
+                begin = int(p.literal())
+                p.expect_word("and")
+                end = int(p.literal()) + 1
+            elif op in (">", ">="):
+                begin = int(p.literal()) + (1 if op == ">" else 0)
+                if p.accept_word("and"):
+                    p.expect_word("time")
+                    _, op2 = p.next()
+                    if op2 not in ("<", "<="):
+                        raise QLError("expected TIME < upper bound")
+                    end = int(p.literal()) + (1 if op2 == "<=" else 0)
+            elif op in ("<", "<="):
+                end = int(p.literal()) + (1 if op == "<=" else 0)
+            else:
+                raise QLError(f"bad TIME operator {op!r}")
+        elif kw == "where":
+            while True:
+                tag = p.next()[1]
+                neg = p.accept_word("not")
+                if neg and not (p.peek()[0] == "word" and p.peek()[1].lower() == "in"):
+                    raise QLError("NOT must be followed by IN")
+                if p.accept_word("in"):
+                    p.expect_op("(")
+                    vals = [p.literal()]
+                    while p.peek() == ("op", ","):
+                        p.next()
+                        vals.append(p.literal())
+                    p.expect_op(")")
+                    add_cond(Condition(tag, "not_in" if neg else "in", vals))
+                else:
+                    kind, op = p.next()
+                    opmap = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+                    if op not in opmap:
+                        raise QLError(f"bad operator {op!r}")
+                    add_cond(Condition(tag, opmap[op], p.literal()))
+                if not p.accept_word("and"):
+                    break
+        elif kw == "group":
+            p.expect_word("by")
+            tags = [p.next()[1]]
+            while p.peek() == ("op", ","):
+                p.next()
+                tags.append(p.next()[1])
+            group_by = GroupBy(tuple(tags))
+        elif kw == "top":
+            n = int(p.next()[1])
+            p.expect_word("by")
+            field = p.next()[1]
+            sort = p.accept_word("asc", "desc") or "desc"
+            top = Top(n, field, sort)
+        elif kw == "order":
+            p.expect_word("by")
+            p.expect_word("time")
+            order_by_ts = p.accept_word("asc", "desc") or "asc"
+        elif kw == "limit":
+            limit = int(p.next()[1])
+        elif kw == "offset":
+            offset = int(p.next()[1])
+
+    return catalog, QueryRequest(
+        groups=(group,),
+        name=name,
+        time_range=TimeRange(begin, end),
+        criteria=criteria,
+        tag_projection=tuple(projections),
+        field_projection=tuple(projections),
+        group_by=group_by,
+        agg=agg,
+        top=top,
+        limit=limit,
+        offset=offset,
+        order_by_ts=order_by_ts,
+    )

@@ -1,0 +1,150 @@
+"""bydbctl-analog CLI (bydbctl/internal/cmd surface, argparse flavor).
+
+    python -m banyandb_tpu.cli --addr 127.0.0.1:17912 health
+    ... group create sw --catalog measure --shards 2
+    ... measure create sw cpm --tags svc:string --fields v:float --entity svc
+    ... write sw cpm --point '{"ts": 1700000000000, "tags": {"svc": "a"}, "fields": {"v": 1}}'
+    ... query "SELECT sum(v) FROM MEASURE cpm IN sw GROUP BY svc"
+    ... snapshot
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from banyandb_tpu.cluster.rpc import GrpcTransport
+from banyandb_tpu.cluster.bus import Topic
+from banyandb_tpu.server import TOPIC_QL, TOPIC_REGISTRY, TOPIC_SNAPSHOT
+
+
+def _call(args, topic: str, envelope: dict) -> dict:
+    t = GrpcTransport()
+    try:
+        return t.call(args.addr, topic, envelope, timeout=args.timeout)
+    finally:
+        t.close()
+
+
+def _parse_specs(spec: str) -> list[dict]:
+    out = []
+    for item in spec.split(","):
+        name, _, typ = item.partition(":")
+        out.append({"name": name, "type": typ or "string"})
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("bydbctl (banyandb-tpu)")
+    ap.add_argument("--addr", default="127.0.0.1:17912")
+    # first query against a cold server may include a TPU kernel compile
+    ap.add_argument("--timeout", type=float, default=180.0)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("health")
+    sub.add_parser("snapshot")
+
+    g = sub.add_parser("group")
+    g.add_argument("action", choices=["create", "list"])
+    g.add_argument("name", nargs="?")
+    g.add_argument("--catalog", default="measure")
+    g.add_argument("--shards", type=int, default=1)
+    g.add_argument("--replicas", type=int, default=0)
+
+    m = sub.add_parser("measure")
+    m.add_argument("action", choices=["create", "list"])
+    m.add_argument("group")
+    m.add_argument("name", nargs="?")
+    m.add_argument("--tags", default="")
+    m.add_argument("--fields", default="")
+    m.add_argument("--entity", default="")
+    m.add_argument("--index-mode", action="store_true")
+
+    s = sub.add_parser("stream")
+    s.add_argument("action", choices=["create"])
+    s.add_argument("group")
+    s.add_argument("name")
+    s.add_argument("--tags", default="")
+    s.add_argument("--entity", default="")
+
+    w = sub.add_parser("write")
+    w.add_argument("group")
+    w.add_argument("name")
+    w.add_argument("--point", action="append", default=[], help="JSON data point")
+    w.add_argument("--file", help="JSON file: list of points")
+
+    q = sub.add_parser("query")
+    q.add_argument("ql", help="BydbQL text")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "health":
+        print(json.dumps(_call(args, Topic.HEALTH.value, {})))
+    elif args.cmd == "snapshot":
+        print(json.dumps(_call(args, TOPIC_SNAPSHOT, {})))
+    elif args.cmd == "group":
+        if args.action == "create":
+            item = {
+                "name": args.name,
+                "catalog": args.catalog,
+                "resource_opts": {
+                    "shard_num": args.shards,
+                    "replicas": args.replicas,
+                    "segment_interval": {"num": 1, "unit": "day"},
+                    "ttl": {"num": 7, "unit": "day"},
+                    "stages": [],
+                },
+            }
+            print(json.dumps(_call(args, TOPIC_REGISTRY, {"op": "create", "kind": "group", "item": item})))
+        else:
+            print(json.dumps(_call(args, TOPIC_REGISTRY, {"op": "list", "kind": "group"})))
+    elif args.cmd == "measure":
+        if args.action == "create":
+            item = {
+                "group": args.group,
+                "name": args.name,
+                "tags": _parse_specs(args.tags),
+                "fields": _parse_specs(args.fields) if args.fields else [],
+                "entity": {"tag_names": args.entity.split(",") if args.entity else []},
+                "interval": "",
+                "index_mode": args.index_mode,
+            }
+            print(json.dumps(_call(args, TOPIC_REGISTRY, {"op": "create", "kind": "measure", "item": item})))
+        else:
+            print(json.dumps(_call(args, TOPIC_REGISTRY, {"op": "list", "kind": "measure", "group": args.group})))
+    elif args.cmd == "stream":
+        item = {
+            "group": args.group,
+            "name": args.name,
+            "tags": _parse_specs(args.tags),
+            "entity": args.entity.split(",") if args.entity else [],
+        }
+        print(json.dumps(_call(args, TOPIC_REGISTRY, {"op": "create_stream", "kind": "stream", "item": item})))
+    elif args.cmd == "write":
+        points = [json.loads(p) for p in args.point]
+        if args.file:
+            points += json.loads(open(args.file).read())
+        env = {
+            "request": {
+                "group": args.group,
+                "name": args.name,
+                "points": [
+                    {
+                        "ts": p["ts"],
+                        "tags": p.get("tags", {}),
+                        "fields": p.get("fields", {}),
+                        "version": p.get("version", 0),
+                    }
+                    for p in points
+                ],
+            }
+        }
+        print(json.dumps(_call(args, Topic.MEASURE_WRITE.value, env)))
+    elif args.cmd == "query":
+        print(json.dumps(_call(args, TOPIC_QL, {"ql": args.ql}), indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,9 +42,10 @@ class LocalBus:
         self._handlers: dict[str, Handler] = {}
         self._lock = threading.Lock()
 
-    def subscribe(self, topic: Topic, handler: Handler) -> None:
+    def subscribe(self, topic: "Topic | str", handler: Handler) -> None:
         with self._lock:
-            self._handlers[topic.value] = handler
+            key = topic.value if isinstance(topic, Topic) else topic
+            self._handlers[key] = handler
 
     def handle(self, topic: str, envelope: dict) -> dict:
         h = self._handlers.get(topic)

@@ -241,7 +241,10 @@ def compute_partials(
     for c in conds:
         measure.tag(c.name)  # validate against schema (KeyError on typo)
         tags_code.add(c.name)
-    fields = set(request.field_projection)
+    # Projection names that aren't schema fields (e.g. tags from a QL
+    # SELECT list) are dropped — they'd only materialize zero columns.
+    known = {f.name for f in measure.fields}
+    fields = {f for f in request.field_projection if f in known}
     if agg:
         fields.add(agg.field_name)
     if request.top:

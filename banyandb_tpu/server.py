@@ -1,0 +1,264 @@
+"""Standalone server: all roles in one process (pkg/cmdsetup/standalone.go
+analog) behind the gRPC bus.
+
+Run: python -m banyandb_tpu.server --root /var/lib/banyandb --port 17912
+
+User-facing topics (the MeasureService/StreamService/TraceService/
+PropertyService + registry + BydbQLService analog): measure/stream/trace
+writes and queries, property apply/get/query, registry CRUD, BydbQL,
+health, snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import signal
+import threading
+from pathlib import Path
+
+from banyandb_tpu import bydbql
+from banyandb_tpu.api import schema as schema_mod
+from banyandb_tpu.api.model import QueryRequest, QueryResult
+from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.cluster import serde
+from banyandb_tpu.cluster.bus import LocalBus, Topic
+from banyandb_tpu.cluster.rpc import GrpcBusServer
+from banyandb_tpu.models.measure import MeasureEngine
+from banyandb_tpu.models.property import Property, PropertyEngine
+from banyandb_tpu.models.stream import ElementValue, Stream, StreamEngine
+from banyandb_tpu.models.trace import SpanValue, Trace, TraceEngine
+
+# user-facing topics beyond the internal cluster set
+TOPIC_QL = "bydbql"
+TOPIC_REGISTRY = "registry"
+TOPIC_STREAM_QUERY = "stream-query-user"
+TOPIC_SNAPSHOT = "snapshot"
+
+
+def _jsonable(v):
+    """bytes anywhere in a reply (data_binary tags, bodies, groups) ride
+    as base64 strings — json.dumps must never see raw bytes."""
+    if isinstance(v, bytes):
+        return base64.b64encode(v).decode()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+def result_to_json(res: QueryResult) -> dict:
+    return {
+        "groups": [_jsonable(list(g)) for g in res.groups],
+        "values": {k: _jsonable(list(vs)) for k, vs in res.values.items()},
+        "data_points": [_jsonable(dp) for dp in res.data_points],
+    }
+
+
+class StandaloneServer:
+    def __init__(self, root: str | Path, port: int = 17912):
+        self.root = Path(root)
+        self.registry = SchemaRegistry(self.root)
+        self.measure = MeasureEngine(self.registry, self.root / "data")
+        self.stream = StreamEngine(self.registry, self.root / "data")
+        self.trace = TraceEngine(self.registry, self.root / "data")
+        self.property = PropertyEngine(self.registry, self.root / "data")
+        self.bus = LocalBus()
+        self._register()
+        self.grpc = GrpcBusServer(self.bus, port=port)
+
+    # -- wiring -------------------------------------------------------------
+    def _register(self) -> None:
+        b = self.bus
+        b.subscribe(Topic.HEALTH, lambda env: {"status": "ok", "role": "standalone"})
+        b.subscribe(Topic.MEASURE_WRITE, self._measure_write)
+        b.subscribe(Topic.MEASURE_QUERY_RAW, self._measure_query)
+        b.subscribe(Topic.STREAM_WRITE, self._stream_write)
+        b.subscribe(Topic.TRACE_WRITE, self._trace_write)
+        b.subscribe(Topic.TRACE_QUERY_BY_ID, self._trace_query)
+        b.subscribe(Topic.PROPERTY_APPLY, self._property_apply)
+        b.subscribe(Topic.PROPERTY_QUERY, self._property_query)
+        b.subscribe(TOPIC_QL, self._ql)
+        b.subscribe(TOPIC_REGISTRY, self._registry_op)
+        b.subscribe(TOPIC_STREAM_QUERY, self._stream_query)
+        b.subscribe(TOPIC_SNAPSHOT, self._snapshot)
+
+    # -- handlers -----------------------------------------------------------
+    def _measure_write(self, env):
+        req = serde.write_request_from_json(env["request"])
+        return {"written": self.measure.write(req)}
+
+    def _measure_query(self, env):
+        req = serde.query_request_from_json(env["request"])
+        return {"result": result_to_json(self.measure.query(req))}
+
+    def _stream_write(self, env):
+        elements = [
+            ElementValue(
+                element_id=e["element_id"],
+                ts_millis=e["ts"],
+                tags=e["tags"],
+                body=base64.b64decode(e.get("body", "")),
+            )
+            for e in env["elements"]
+        ]
+        n = self.stream.write(env["group"], env["name"], elements)
+        return {"written": n}
+
+    def _stream_query(self, env):
+        req = serde.query_request_from_json(env["request"])
+        return {"result": result_to_json(self.stream.query(req))}
+
+    def _trace_write(self, env):
+        spans = [
+            SpanValue(
+                ts_millis=s["ts"],
+                tags=s["tags"],
+                span=base64.b64decode(s.get("span", "")),
+            )
+            for s in env["spans"]
+        ]
+        n = self.trace.write(
+            env["group"], env["name"], spans,
+            ordered_tags=tuple(env.get("ordered_tags", ())),
+        )
+        return {"written": n}
+
+    def _trace_query(self, env):
+        spans = self.trace.query_by_trace_id(
+            env["group"], env["name"], env["trace_id"]
+        )
+        return {
+            "spans": [
+                {**s, "span": base64.b64encode(s["span"]).decode()}
+                for s in spans
+            ]
+        }
+
+    def _property_apply(self, env):
+        p = self.property.apply(
+            Property(
+                group=env["group"], name=env["name"], id=env["id"],
+                tags=env.get("tags", {}),
+            ),
+            strategy=env.get("strategy", "merge"),
+        )
+        return {"mod_revision": p.mod_revision, "create_revision": p.create_revision}
+
+    def _property_query(self, env):
+        if "id" in env:
+            p = self.property.get(env["group"], env["name"], env["id"])
+            return {"properties": [p.tags] if p else []}
+        props = self.property.query(
+            env["group"], env["name"],
+            tag_filters=env.get("tag_filters"),
+            limit=env.get("limit", 100),
+        )
+        return {"properties": [{"id": p.id, "tags": p.tags} for p in props]}
+
+    def _ql(self, env):
+        catalog, req = bydbql.parse_with_catalog(env["ql"])
+        if catalog == "stream":
+            res = self.stream.query(req)
+        else:
+            res = self.measure.query(req)
+        return {"result": result_to_json(res)}
+
+    def _registry_op(self, env):
+        op, kind = env["op"], env["kind"]
+        if op == "create":
+            cls = schema_mod._KINDS[kind]
+            obj = schema_mod._from_jsonable(cls, env["item"])
+            if kind == "group":
+                rev = self.registry.create_group(obj)
+            elif kind == "measure":
+                rev = self.registry.create_measure(obj)
+            elif kind == "index_rule":
+                rev = self.registry.create_index_rule(obj)
+            elif kind == "topn":
+                rev = self.registry.create_topn(obj)
+            else:
+                raise KeyError(kind)
+            return {"revision": rev}
+        if op == "create_stream":
+            item = env["item"]
+            self.stream.create_stream(
+                Stream(
+                    group=item["group"], name=item["name"],
+                    tags=tuple(
+                        schema_mod.TagSpec(t["name"], schema_mod.TagType(t["type"]))
+                        for t in item["tags"]
+                    ),
+                    entity=tuple(item["entity"]),
+                )
+            )
+            return {"revision": self.registry.revision}
+        if op == "create_trace":
+            item = env["item"]
+            self.trace.create_trace(
+                Trace(
+                    group=item["group"], name=item["name"],
+                    tags=tuple(
+                        schema_mod.TagSpec(t["name"], schema_mod.TagType(t["type"]))
+                        for t in item["tags"]
+                    ),
+                    trace_id_tag=item["trace_id_tag"],
+                )
+            )
+            return {"revision": self.registry.revision}
+        if op == "list":
+            if kind == "group":
+                items = self.registry.list_groups()
+            elif kind == "measure":
+                items = self.registry.list_measures(env["group"])
+            else:
+                raise KeyError(kind)
+            return {"items": [schema_mod._to_jsonable(i) for i in items]}
+        raise KeyError(f"bad registry op {op}")
+
+    def _snapshot(self, env):
+        # flush everything so on-disk state is complete, then report dirs
+        flushed = []
+        flushed += self.measure.flush()
+        flushed += self.stream.flush()
+        flushed += self.trace.flush()
+        self.property.persist()
+        return {"flushed": flushed, "root": str(self.root)}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        self.measure.start_lifecycle()
+        self.grpc.start()
+
+    def stop(self) -> None:
+        self.measure.stop_lifecycle()
+        self.grpc.stop()
+
+    @property
+    def addr(self) -> str:
+        return self.grpc.addr
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser("banyandb-tpu server")
+    ap.add_argument("--root", required=True)
+    ap.add_argument("--port", type=int, default=17912)
+    args = ap.parse_args(argv)
+    srv = StandaloneServer(args.root, args.port)
+    srv.start()
+    print(f"banyandb-tpu standalone listening on {srv.addr}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+    # grpc's worker threads are non-daemon; an in-flight slow handler
+    # (e.g. a TPU compile) must not wedge process exit after SIGTERM.
+    import os
+
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""BydbQL parser + standalone server + CLI E2E."""
+
+import json
+
+import numpy as np
+import pytest
+
+from banyandb_tpu import bydbql
+from banyandb_tpu.api.model import Condition, LogicalExpression
+
+
+def test_ql_basic_select():
+    r = bydbql.parse("SELECT * FROM MEASURE cpm IN sw LIMIT 10")
+    assert r.name == "cpm" and r.groups == ("sw",) and r.limit == 10
+    assert r.agg is None and r.group_by is None
+
+
+def test_ql_aggregate_group_top():
+    r = bydbql.parse(
+        "SELECT sum(value) FROM MEASURE cpm IN sw "
+        "TIME > 100 AND TIME < 200 "
+        "WHERE region = 'us' AND svc != 'x' "
+        "GROUP BY svc, region TOP 5 BY value LIMIT 20"
+    )
+    assert r.agg.function == "sum" and r.agg.field_name == "value"
+    assert r.time_range.begin_millis == 101 and r.time_range.end_millis == 200
+    assert isinstance(r.criteria, LogicalExpression)
+    assert r.group_by.tag_names == ("svc", "region")
+    assert r.top.number == 5 and r.top.field_name == "value"
+    assert r.limit == 20
+
+
+def test_ql_percentile_and_in():
+    r = bydbql.parse(
+        "SELECT percentile(lat, 0.5, 0.99) FROM MEASURE m IN g "
+        "TIME BETWEEN 0 AND 999 WHERE svc IN ('a', 'b') ORDER BY TIME DESC OFFSET 5"
+    )
+    assert r.agg.function == "percentile"
+    assert r.agg.quantiles == (0.5, 0.99)
+    assert r.time_range.end_millis == 1000
+    assert isinstance(r.criteria, Condition) and r.criteria.op == "in"
+    assert r.order_by_ts == "desc" and r.offset == 5
+
+
+def test_ql_int_predicates():
+    r = bydbql.parse("SELECT count(v) FROM MEASURE m IN g WHERE status >= 500")
+    assert r.criteria == Condition("status", "ge", 500)
+
+
+def test_ql_errors():
+    with pytest.raises(bydbql.QLError):
+        bydbql.parse("SELEC * FROM MEASURE m IN g")
+    with pytest.raises(bydbql.QLError):
+        bydbql.parse("SELECT * FROM TABLE m IN g")
+    with pytest.raises(bydbql.QLError):
+        bydbql.parse("SELECT sum(v), count(v) FROM MEASURE m IN g")
+
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from banyandb_tpu.server import StandaloneServer
+
+    srv = StandaloneServer(tmp_path_factory.mktemp("srv"), port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _cli(server, *argv):
+    import io
+    from contextlib import redirect_stdout
+
+    from banyandb_tpu import cli
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["--addr", server.addr, *argv])
+    assert rc == 0
+    return json.loads(buf.getvalue())
+
+
+def test_server_cli_end_to_end(server):
+    assert _cli(server, "health")["status"] == "ok"
+    _cli(server, "group", "create", "sw", "--shards", "2")
+    _cli(
+        server, "measure", "create", "sw", "cpm",
+        "--tags", "svc:string,region:string",
+        "--fields", "value:float",
+        "--entity", "svc",
+    )
+    groups = _cli(server, "group", "list")["items"]
+    assert [g["name"] for g in groups] == ["sw"]
+
+    points = [
+        {"ts": T0 + i, "tags": {"svc": f"s{i%3}", "region": "us"}, "fields": {"value": i}, "version": 1}
+        for i in range(30)
+    ]
+    # write via repeated --point flags
+    args = ["write", "sw", "cpm"]
+    for p in points:
+        args += ["--point", json.dumps(p)]
+    assert _cli(server, *args)["written"] == 30
+
+    res = _cli(
+        server, "query",
+        f"SELECT sum(value) FROM MEASURE cpm IN sw TIME > {T0 - 1} AND TIME < {T0 + 100} GROUP BY svc",
+    )["result"]
+    got = dict(zip(tuple(tuple(g) for g in res["groups"]), res["values"]["sum(value)"]))
+    assert got[("s0",)] == sum(i for i in range(30) if i % 3 == 0)
+
+    snap = _cli(server, "snapshot")
+    assert snap["flushed"]
+
+
+def test_server_stream_and_trace_topics(server):
+    import base64
+
+    from banyandb_tpu.cluster.rpc import GrpcTransport
+    from banyandb_tpu.server import TOPIC_REGISTRY
+
+    t = GrpcTransport()
+    try:
+        t.call(server.addr, TOPIC_REGISTRY, {
+            "op": "create_stream", "kind": "stream",
+            "item": {"group": "sw", "name": "logs",
+                     "tags": [{"name": "svc", "type": "string"}],
+                     "entity": ["svc"]},
+        })
+        t.call(server.addr, "stream-write", {
+            "group": "sw", "name": "logs",
+            "elements": [
+                {"element_id": "e1", "ts": T0, "tags": {"svc": "a"},
+                 "body": base64.b64encode(b"hello").decode()},
+            ],
+        })
+        r = t.call(server.addr, "stream-query-user", {
+            "request": {
+                "groups": ["sw"], "name": "logs",
+                "time_range": [T0, T0 + 10], "limit": 10,
+            },
+        })
+        assert len(r["result"]["data_points"]) == 1
+        assert r["result"]["data_points"][0]["element_id"] == "e1"
+
+        t.call(server.addr, TOPIC_REGISTRY, {
+            "op": "create_trace", "kind": "trace",
+            "item": {"group": "sw", "name": "traces",
+                     "tags": [{"name": "trace_id", "type": "string"},
+                              {"name": "svc", "type": "string"}],
+                     "trace_id_tag": "trace_id"},
+        })
+        t.call(server.addr, "trace-write", {
+            "group": "sw", "name": "traces",
+            "spans": [{"ts": T0, "tags": {"trace_id": "t1", "svc": "a"},
+                       "span": base64.b64encode(b"span-bytes").decode()}],
+        })
+        r = t.call(server.addr, "trace-query-by-id", {
+            "group": "sw", "name": "traces", "trace_id": "t1",
+        })
+        assert len(r["spans"]) == 1
+        assert base64.b64decode(r["spans"][0]["span"]) == b"span-bytes"
+    finally:
+        t.close()
