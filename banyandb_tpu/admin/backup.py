@@ -1,0 +1,98 @@
+"""Backup & restore (banyand/backup/backup.go + restore.go analog).
+
+Backups are time-dirs of the snapshot-consistent data tree:
+
+    <dest>/<YYYYMMDDHHMMSS>/
+        schema/...        # registry JSON
+        data/...          # part dirs + snapshots + indexes
+
+The remote-FS abstraction mirrors pkg/fs/remote: a tiny put/get/list
+interface with a local-directory implementation; S3/GCS/Azure drivers
+plug in behind the same surface (cloud SDKs aren't in this image, so
+they're gated imports for deployments that have them).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import shutil
+from pathlib import Path
+from typing import Optional, Protocol
+
+
+class RemoteFS(Protocol):  # pkg/fs/remote FS interface analog
+    def put(self, rel: str, local: Path) -> None: ...
+    def get(self, rel: str, local: Path) -> None: ...
+    def list(self, prefix: str) -> list[str]: ...
+
+
+class LocalDirFS:
+    """Local-directory RemoteFS (the dockertest/minio stand-in)."""
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+
+    def put(self, rel: str, local: Path) -> None:
+        dest = self.root / rel
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(local, dest)
+
+    def get(self, rel: str, local: Path) -> None:
+        local.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(self.root / rel, local)
+
+    def list(self, prefix: str) -> list[str]:
+        base = self.root / prefix
+        if not base.exists():
+            return []
+        return sorted(
+            str(p.relative_to(self.root))
+            for p in base.rglob("*")
+            if p.is_file()
+        )
+
+
+def _walk_files(root: Path):
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and not p.name.startswith(".tmp"):
+            yield p
+
+
+def backup(
+    server_root: str | Path,
+    remote: RemoteFS,
+    *,
+    time_dir: Optional[str] = None,
+    flush: Optional[callable] = None,
+) -> str:
+    """Snapshot (via the provided flush hook) then copy the tree.
+
+    Returns the time-dir name (backup/timedir.go analog).
+    """
+    server_root = Path(server_root)
+    if flush:
+        flush()
+    stamp = time_dir or dt.datetime.now(dt.timezone.utc).strftime("%Y%m%d%H%M%S")
+    for f in _walk_files(server_root):
+        rel = f.relative_to(server_root)
+        remote.put(f"{stamp}/{rel}", f)
+    return stamp
+
+
+def list_backups(remote: RemoteFS) -> list[str]:
+    stamps = {r.split("/", 1)[0] for r in remote.list("")}
+    return sorted(stamps)
+
+
+def restore(
+    remote: RemoteFS, time_dir: str, server_root: str | Path
+) -> int:
+    """Materialize a backup into an empty server root. Returns file count."""
+    server_root = Path(server_root)
+    if server_root.exists() and any(server_root.iterdir()):
+        raise FileExistsError(f"restore target {server_root} not empty")
+    files = remote.list(time_dir)
+    for rel in files:
+        local_rel = rel.split("/", 1)[1]
+        remote.get(rel, server_root / local_rel)
+    return len(files)

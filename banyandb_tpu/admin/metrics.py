@@ -1,0 +1,155 @@
+"""Metrics facade (pkg/meter analog) with two sinks:
+
+- in-memory registry with Prometheus text exposition
+  (pkg/meter/prom analog — scrape via the server's "metrics" topic),
+- self-measure writer: periodic dump of all instruments as data points
+  into the `_monitoring` group (pkg/meter/native/provider.go:39,81
+  analog), so the database monitors itself with its own query engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+
+class Meter:
+    """Scoped instrument registry: counters, gauges, histograms."""
+
+    def __init__(self, scope: str = ""):
+        self.scope = scope
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        # histograms keep running (count, sum) — bounded memory per key
+        self._hist: dict[tuple, tuple[int, float]] = {}
+
+    def _key(self, name: str, labels: Optional[dict]) -> tuple:
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def counter_add(self, name: str, value: float = 1.0, labels: Optional[dict] = None):
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+
+    def gauge_set(self, name: str, value: float, labels: Optional[dict] = None):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, value: float, labels: Optional[dict] = None):
+        with self._lock:
+            k = self._key(name, labels)
+            count, total = self._hist.get(k, (0, 0.0))
+            self._hist[k] = (count + 1, total + value)
+
+    # -- exposition ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "histograms": dict(self._hist),
+            }
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (pkg/meter/prom analog)."""
+        pfx = (self.scope + "_") if self.scope else ""
+        lines = []
+
+        def fmt_labels(lbls: tuple) -> str:
+            if not lbls:
+                return ""
+            inner = ",".join(f'{k}="{v}"' for k, v in lbls)
+            return "{" + inner + "}"
+
+        snap = self.snapshot()
+        for (name, lbls), v in sorted(snap["counters"].items()):
+            lines.append(f"{pfx}{name}_total{fmt_labels(lbls)} {v}")
+        for (name, lbls), v in sorted(snap["gauges"].items()):
+            lines.append(f"{pfx}{name}{fmt_labels(lbls)} {v}")
+        for (name, lbls), (count, total) in sorted(snap["histograms"].items()):
+            lines.append(f"{pfx}{name}_count{fmt_labels(lbls)} {count}")
+            lines.append(f"{pfx}{name}_sum{fmt_labels(lbls)} {total}")
+        return "\n".join(lines) + "\n"
+
+
+class SelfMeasureSink:
+    """Write instruments as measure points into `_monitoring`
+    (the reference's native meter provider)."""
+
+    GROUP = "_monitoring"
+    MEASURE = "instruments"
+
+    def __init__(self, meter: Meter, measure_engine):
+        self.meter = meter
+        self.engine = measure_engine
+        self._ensure_schema()
+
+    def _ensure_schema(self) -> None:
+        from banyandb_tpu.api.schema import (
+            Catalog,
+            Entity,
+            FieldSpec,
+            FieldType,
+            Group,
+            Measure,
+            ResourceOpts,
+            TagSpec,
+            TagType,
+        )
+
+        reg = self.engine.registry
+        try:
+            reg.get_group(self.GROUP)
+        except KeyError:
+            reg.create_group(
+                Group(self.GROUP, Catalog.MEASURE, ResourceOpts(shard_num=1))
+            )
+        try:
+            reg.get_measure(self.GROUP, self.MEASURE)
+        except KeyError:
+            reg.create_measure(
+                Measure(
+                    group=self.GROUP,
+                    name=self.MEASURE,
+                    tags=(
+                        TagSpec("name", TagType.STRING),
+                        TagSpec("kind", TagType.STRING),
+                    ),
+                    fields=(FieldSpec("value", FieldType.FLOAT),),
+                    entity=Entity(("name", "kind")),
+                )
+            )
+
+    def flush(self, now_millis: Optional[int] = None) -> int:
+        from banyandb_tpu.api.model import DataPointValue, WriteRequest
+
+        ts = now_millis or int(time.time() * 1000)
+        snap = self.meter.snapshot()
+        points = []
+        def add(kind: str, name: str, lbls: tuple, value: float):
+            label_sfx = ",".join(f"{k}={val}" for k, val in lbls)
+            full = f"{name}|{label_sfx}" if label_sfx else name
+            points.append(
+                DataPointValue(
+                    ts_millis=ts,
+                    tags={"name": full, "kind": kind},
+                    fields={"value": float(value)},
+                    version=ts,
+                )
+            )
+
+        for (name, lbls), v in snap["counters"].items():
+            add("counter", name, lbls, v)
+        for (name, lbls), v in snap["gauges"].items():
+            add("gauge", name, lbls, v)
+        for (name, lbls), (count, total) in snap["histograms"].items():
+            add("histogram_count", name, lbls, count)
+            add("histogram_sum", name, lbls, total)
+        if points:
+            self.engine.write(
+                WriteRequest(self.GROUP, self.MEASURE, tuple(points)),
+                _internal=True,
+            )
+        return len(points)

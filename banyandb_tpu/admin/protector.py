@@ -1,0 +1,92 @@
+"""Memory admission control (banyand/protector/protector.go:55,97,108
+analog).
+
+Writes acquire resources against a memory budget derived from the cgroup
+limit (pkg/cgroups analog) or an explicit cap; over-budget acquisition
+raises ServerBusy after a bounded backoff — ingestion sheds load instead
+of OOMing the node.  On a TPU host the same gate also tracks a logical
+HBM budget for device-resident query state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+
+class ServerBusy(RuntimeError):
+    """ErrServerBusy (banyand/queue/queue.go:45 analog)."""
+
+
+def cgroup_memory_limit() -> Optional[int]:
+    """Read the v2 (then v1) cgroup memory limit, None when unlimited."""
+    for path, parse in (
+        ("/sys/fs/cgroup/memory.max", lambda s: None if s == "max" else int(s)),
+        (
+            "/sys/fs/cgroup/memory/memory.limit_in_bytes",
+            lambda s: None if int(s) >= 2**60 else int(s),
+        ),
+    ):
+        try:
+            return parse(Path(path).read_text().strip())
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def process_rss() -> int:
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * 4096
+
+
+class MemoryProtector:
+    def __init__(
+        self,
+        *,
+        limit_bytes: Optional[int] = None,
+        limit_ratio: float = 0.8,
+        hbm_limit_bytes: Optional[int] = None,
+        max_wait_s: float = 2.0,
+    ):
+        cg = cgroup_memory_limit()
+        self.limit = limit_bytes or (int(cg * limit_ratio) if cg else None)
+        self.hbm_limit = hbm_limit_bytes
+        self.max_wait_s = max_wait_s
+        self._lock = threading.Lock()
+        self._reserved = 0
+        self._hbm_reserved = 0
+
+    def acquire(self, size_bytes: int, *, hbm: bool = False) -> None:
+        """Block (with backoff) until the budget admits `size_bytes`,
+        else raise ServerBusy (AcquireResource analog)."""
+        deadline = time.monotonic() + self.max_wait_s
+        wait = 0.01
+        while True:
+            with self._lock:
+                if hbm:
+                    if self.hbm_limit is None or self._hbm_reserved + size_bytes <= self.hbm_limit:
+                        self._hbm_reserved += size_bytes
+                        return
+                else:
+                    if self.limit is None:
+                        self._reserved += size_bytes
+                        return
+                    used = process_rss() + self._reserved
+                    if used + size_bytes <= self.limit:
+                        self._reserved += size_bytes
+                        return
+            if time.monotonic() >= deadline:
+                raise ServerBusy(
+                    f"memory budget exceeded acquiring {size_bytes}B"
+                )
+            time.sleep(wait)
+            wait = min(wait * 2, 0.25)
+
+    def release(self, size_bytes: int, *, hbm: bool = False) -> None:
+        with self._lock:
+            if hbm:
+                self._hbm_reserved = max(0, self._hbm_reserved - size_bytes)
+            else:
+                self._reserved = max(0, self._reserved - size_bytes)

@@ -153,6 +153,9 @@ class MeasureEngine:
 
     # -- query path (query.go:88 analog) -----------------------------------
     def query(self, req: QueryRequest, shard_ids=None) -> QueryResult:
+        """Execute; when req.trace is set, attach in-band trace spans
+        (pkg/query/tracer.go analog: spans ride back in the response)."""
+        t_start = time.perf_counter()
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
@@ -160,22 +163,25 @@ class MeasureEngine:
             # Short-circuit: whole measure lives in the series index
             # (SearchWithoutSeries, measure/query.go:506,559).
             sources = self._index_sources(db, m, req, shard_ids)
-            if req.agg or req.group_by or req.top:
-                return measure_exec.execute_aggregate(m, req, sources)
-            return _raw_rows(m, req, sources)
-        # A concurrent merge can GC a part dir after we snapshot the part
-        # list; that read raises FileNotFoundError and we retry against the
-        # fresh snapshot (the reference's epoch-reference contract).
-        for attempt in range(3):
-            try:
-                sources = self._gather_sources(db, m, req, shard_ids=shard_ids)
-                break
-            except FileNotFoundError:
-                if attempt == 2:
-                    raise
+        else:
+            # A concurrent merge can GC a part dir after we snapshot the
+            # part list; that read raises FileNotFoundError and we retry
+            # against the fresh snapshot (the reference's epoch contract).
+            for attempt in range(3):
+                try:
+                    sources = self._gather_sources(db, m, req, shard_ids=shard_ids)
+                    break
+                except FileNotFoundError:
+                    if attempt == 2:
+                        raise
+        t_gather = time.perf_counter()
         if req.agg or req.group_by or req.top:
-            return measure_exec.execute_aggregate(m, req, sources)
-        return _raw_rows(m, req, sources)
+            res = measure_exec.execute_aggregate(m, req, sources)
+        else:
+            res = _raw_rows(m, req, sources)
+        if req.trace:
+            res.trace = _trace_spans(t_start, t_gather, sources, m.index_mode)
+        return res
 
     def query_partials(
         self,
@@ -359,6 +365,28 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
     for ts, _ver, tags, fields in ordered[off : off + (req.limit or 100)]:
         res.data_points.append({"timestamp": ts, "tags": tags, "fields": fields})
     return res
+
+
+def _trace_spans(t_start, t_gather, sources, index_mode: bool) -> dict:
+    """In-band query trace (pkg/query/tracer.go Span analog)."""
+    t_end = time.perf_counter()
+    rows = sum(int(s.ts.size) for s in sources)
+    return {
+        "spans": [
+            {
+                "name": "gather_sources",
+                "duration_ms": round((t_gather - t_start) * 1000, 3),
+                "sources": len(sources),
+                "rows": rows,
+                "index_mode": index_mode,
+            },
+            {
+                "name": "execute",
+                "duration_ms": round((t_end - t_gather) * 1000, 3),
+            },
+        ],
+        "total_ms": round((t_end - t_start) * 1000, 3),
+    }
 
 
 # -- series pruning helpers -------------------------------------------------

@@ -34,6 +34,16 @@ TOPIC_QL = "bydbql"
 TOPIC_REGISTRY = "registry"
 TOPIC_STREAM_QUERY = "stream-query-user"
 TOPIC_SNAPSHOT = "snapshot"
+TOPIC_METRICS = "metrics"
+
+# conservative per-point admission estimate for the memory protector
+_POINT_BYTES = 256
+
+
+def _rss() -> int:
+    from banyandb_tpu.admin.protector import process_rss
+
+    return process_rss()
 
 
 def _jsonable(v):
@@ -49,21 +59,30 @@ def _jsonable(v):
 
 
 def result_to_json(res: QueryResult) -> dict:
-    return {
+    out = {
         "groups": [_jsonable(list(g)) for g in res.groups],
         "values": {k: _jsonable(list(vs)) for k, vs in res.values.items()},
         "data_points": [_jsonable(dp) for dp in res.data_points],
     }
+    if res.trace is not None:
+        out["trace"] = res.trace
+    return out
 
 
 class StandaloneServer:
     def __init__(self, root: str | Path, port: int = 17912):
+        from banyandb_tpu.admin.metrics import Meter, SelfMeasureSink
+        from banyandb_tpu.admin.protector import MemoryProtector
+
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
         self.measure = MeasureEngine(self.registry, self.root / "data")
         self.stream = StreamEngine(self.registry, self.root / "data")
         self.trace = TraceEngine(self.registry, self.root / "data")
         self.property = PropertyEngine(self.registry, self.root / "data")
+        self.meter = Meter("banyandb")
+        self.self_metrics = SelfMeasureSink(self.meter, self.measure)
+        self.protector = MemoryProtector()
         self.bus = LocalBus()
         self._register()
         self.grpc = GrpcBusServer(self.bus, port=port)
@@ -83,15 +102,34 @@ class StandaloneServer:
         b.subscribe(TOPIC_REGISTRY, self._registry_op)
         b.subscribe(TOPIC_STREAM_QUERY, self._stream_query)
         b.subscribe(TOPIC_SNAPSHOT, self._snapshot)
+        b.subscribe(TOPIC_METRICS, self._metrics)
 
     # -- handlers -----------------------------------------------------------
     def _measure_write(self, env):
         req = serde.write_request_from_json(env["request"])
-        return {"written": self.measure.write(req)}
+        size = len(req.points) * _POINT_BYTES
+        # write-side admission control (protector.AcquireResource analog):
+        # shed load with ServerBusy instead of OOMing under pressure
+        self.protector.acquire(size)
+        try:
+            n = self.measure.write(req)
+        finally:
+            self.protector.release(size)
+        self.meter.counter_add("measure_write_points", n)
+        return {"written": n}
 
     def _measure_query(self, env):
+        import time as _time
+
         req = serde.query_request_from_json(env["request"])
-        return {"result": result_to_json(self.measure.query(req))}
+        t0 = _time.perf_counter()
+        res = self.measure.query(req)
+        self.meter.observe("measure_query_ms", (_time.perf_counter() - t0) * 1000)
+        return {"result": result_to_json(res)}
+
+    def _metrics(self, env):
+        self.meter.gauge_set("rss_bytes", _rss())
+        return {"prometheus": self.meter.prometheus_text()}
 
     def _stream_write(self, env):
         elements = [
@@ -224,6 +262,7 @@ class StandaloneServer:
         flushed += self.stream.flush()
         flushed += self.trace.flush()
         self.property.persist()
+        self.self_metrics.flush()  # self-measures land in _monitoring
         return {"flushed": flushed, "root": str(self.root)}
 
     # -- lifecycle ----------------------------------------------------------
