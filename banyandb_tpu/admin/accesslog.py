@@ -1,0 +1,70 @@
+"""Access + slow-query logging (pkg/accesslog analog).
+
+JSON-lines access records for writes and queries, with a separate
+slow-query threshold mirroring the reference's slow-query capture
+(banyand/dquery/measure.go:169-174).  Files rotate by size.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+
+class AccessLog:
+    def __init__(
+        self,
+        path: str | Path,
+        *,
+        slow_query_ms: float = 500.0,
+        max_bytes: int = 64 << 20,
+    ):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.slow_query_ms = slow_query_ms
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", buffering=1)
+
+    def _emit(self, record: dict) -> None:
+        record["ts"] = int(time.time() * 1000)
+        with self._lock:
+            if self._f.tell() > self.max_bytes:
+                # single-generation rotation: access.log -> access.log.1
+                self._f.close()
+                rotated = self.path.with_name(self.path.name + ".1")
+                self.path.replace(rotated)
+                self._f = open(self.path, "a", buffering=1)
+            self._f.write(json.dumps(record) + "\n")
+
+    def log_write(self, group: str, name: str, points: int, duration_ms: float) -> None:
+        self._emit(
+            {"kind": "write", "group": group, "name": name,
+             "points": points, "ms": round(duration_ms, 3)}
+        )
+
+    def log_query(
+        self,
+        group: str,
+        name: str,
+        duration_ms: float,
+        *,
+        ql: Optional[str] = None,
+        rows: int = 0,
+    ) -> None:
+        rec = {
+            "kind": "query", "group": group, "name": name,
+            "ms": round(duration_ms, 3), "rows": rows,
+        }
+        if ql:
+            rec["ql"] = ql
+        if duration_ms >= self.slow_query_ms:
+            rec["slow"] = True
+        self._emit(rec)
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()

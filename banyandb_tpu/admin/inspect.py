@@ -1,0 +1,79 @@
+"""On-disk inspection tools (banyand/cmd/dump + bydbctl analyze analog).
+
+Read-only walkers over a server root: groups -> segments -> shards ->
+parts with block stats, plus column-level detail for one part.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from banyandb_tpu.storage.part import Part
+
+
+def inspect_root(root: str | Path) -> dict:
+    """Summarize every engine tree under <root>/data."""
+    root = Path(root)
+    out: dict = {"engines": {}}
+    for engine_dir in sorted((root / "data").glob("*")):
+        if not engine_dir.is_dir():
+            continue
+        groups = {}
+        for group_dir in sorted(engine_dir.glob("*")):
+            if not group_dir.is_dir():
+                continue
+            segments = {}
+            for seg_dir in sorted(group_dir.glob("seg-*")):
+                shards = {}
+                for shard_dir in sorted(seg_dir.glob("shard-*")):
+                    parts = []
+                    for part_dir in sorted(shard_dir.glob("part-*")):
+                        try:
+                            p = Part(part_dir)
+                        except Exception:
+                            parts.append({"name": part_dir.name, "error": "unreadable"})
+                            continue
+                        parts.append(
+                            {
+                                "name": p.name,
+                                "rows": p.total_count,
+                                "blocks": len(p.blocks),
+                                "min_ts": p.min_ts,
+                                "max_ts": p.max_ts,
+                                "resource": p.meta.get("measure")
+                                or p.meta.get("stream")
+                                or p.meta.get("trace"),
+                                "bytes": sum(
+                                    f.stat().st_size for f in part_dir.iterdir()
+                                ),
+                            }
+                        )
+                    shards[shard_dir.name] = {
+                        "parts": parts,
+                        "rows": sum(x.get("rows", 0) for x in parts),
+                    }
+                segments[seg_dir.name] = shards
+            groups[group_dir.name] = segments
+        out["engines"][engine_dir.name] = groups
+    return out
+
+
+def inspect_part(part_dir: str | Path) -> dict:
+    """Column-level stats for one part (cmd/dump measure analog)."""
+    p = Part(part_dir)
+    part_dir = Path(part_dir)
+    cols = {}
+    for f in sorted(part_dir.iterdir()):
+        cols[f.name] = f.stat().st_size
+    return {
+        "meta": p.meta,
+        "files": cols,
+        "blocks": [
+            {
+                "count": b["count"],
+                "ts": [b["min_ts"], b["max_ts"]],
+                "series": [b["min_series"], b["max_series"]],
+            }
+            for b in p.blocks
+        ],
+    }

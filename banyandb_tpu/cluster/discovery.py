@@ -1,0 +1,80 @@
+"""Node discovery (banyand/metadata/discovery/{none,dns,file} analog).
+
+- StaticDiscovery: fixed node list (discovery "none").
+- FileDiscovery: watched JSON file of node records — the reference's
+  file-based discovery AND its in-process cluster-test trick
+  (pkg/test/setup NewDiscoveryFileWriter).  DNS SRV polling can plug in
+  behind the same refresh() surface.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable, Optional
+
+from banyandb_tpu.cluster.node import NodeInfo
+
+
+class StaticDiscovery:
+    def __init__(self, nodes: list[NodeInfo]):
+        self._nodes = list(nodes)
+
+    def nodes(self) -> list[NodeInfo]:
+        return list(self._nodes)
+
+    def refresh(self) -> bool:
+        return False
+
+
+class FileDiscovery:
+    """Watched JSON file: [{"name": ..., "addr": ..., "roles": [...]}].
+
+    refresh() re-reads when the mtime changed and returns True when the
+    node set changed; callers (Liaison) rebuild their selector then.
+    """
+
+    def __init__(self, path: str | Path, on_change: Optional[Callable] = None):
+        self.path = Path(path)
+        self.on_change = None  # initial load is not a "change"
+        self._mtime: tuple = (0, 0)
+        self._nodes: list[NodeInfo] = []
+        self.refresh()
+        self.on_change = on_change
+
+    @staticmethod
+    def write(path: str | Path, nodes: list[NodeInfo]) -> None:
+        """Test/ops helper: publish a node list (DiscoveryFileWriter)."""
+        from banyandb_tpu.utils import fs
+
+        fs.atomic_write_json(
+            path,
+            [
+                {"name": n.name, "addr": n.addr, "roles": list(n.roles)}
+                for n in nodes
+            ],
+        )
+
+    def nodes(self) -> list[NodeInfo]:
+        return list(self._nodes)
+
+    def refresh(self) -> bool:
+        try:
+            st = self.path.stat()
+            # ns mtime + size: whole-second mtime would miss rapid rewrites
+            stamp = (st.st_mtime_ns, st.st_size)
+        except FileNotFoundError:
+            return False
+        if stamp == self._mtime:
+            return False
+        self._mtime = stamp
+        data = json.loads(self.path.read_text())
+        new = [
+            NodeInfo(d["name"], d["addr"], tuple(d.get("roles", ("data",))))
+            for d in data
+        ]
+        changed = new != self._nodes
+        self._nodes = new
+        if changed and self.on_change:
+            self.on_change(new)
+        return changed

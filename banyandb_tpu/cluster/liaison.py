@@ -33,14 +33,29 @@ class Liaison:
         self,
         registry: SchemaRegistry,
         transport,
-        nodes: list[NodeInfo],
+        nodes: list[NodeInfo] = (),
         *,
         replicas: int = 0,
+        discovery=None,
     ):
         self.registry = registry
         self.transport = transport
-        self.selector = RoundRobinSelector(nodes, replicas)
+        self.replicas = replicas
+        self.discovery = discovery
+        if discovery is not None:
+            nodes = discovery.nodes()
+        self.selector = RoundRobinSelector(list(nodes), replicas)
         self.alive: set[str] = {n.name for n in nodes}
+
+    def refresh_nodes(self) -> bool:
+        """Re-read discovery; rebuild placement when the node set changed
+        (discovery/{file,dns} polling loop analog)."""
+        if self.discovery is None or not self.discovery.refresh():
+            return False
+        nodes = self.discovery.nodes()
+        self.selector = RoundRobinSelector(nodes, self.replicas)
+        self.probe()
+        return True
 
     # -- health -------------------------------------------------------------
     def probe(self) -> set[str]:
