@@ -6,11 +6,15 @@ TPU there is no hash table: tags are dictionary codes, so a group key is a
 *mixed-radix* int32 composed from the code columns, bounded by the product
 of dictionary sizes.  Aggregation is then a dense segment reduction:
 
-- ``scatter`` method: jax.ops.segment_sum/min/max (XLA scatter).
-- ``matmul`` method: one-hot(keys) @ values on the MXU — the TPU-native
-  path for sums/counts when the group count is modest (<= ~4096).
+- ``scatter``: jax.ops.segment_sum/min/max (XLA scatter).
+- ``matmul``: one-hot(keys) @ values on the MXU in one shot — for modest
+  group counts (<= ~4096) and row counts that fit a single operand.
+- ``matmul_tiled``: lax.scan over row tiles of MXU one-hot contractions —
+  the TPU path for large N where one-shot matmul won't fit and scatter
+  underuses the hardware.
 
-Both produce identical results; `group_reduce` picks per shape unless told.
+All produce identical results; ``method="auto"`` picks per shape and
+backend (TPU prefers the MXU paths).
 """
 
 from __future__ import annotations
@@ -67,13 +71,14 @@ class GroupReduceResult:
 def _pick_method(nrows: int, num_groups: int) -> str:
     # One-hot matmul materializes an [N, G+1] f32 operand through the MXU;
     # worth it while G stays in the low thousands AND the operand stays
-    # well under VMEM-friendly tile working sets, after which scatter wins
-    # on bytes moved.
-    return (
-        "matmul"
-        if num_groups <= 4096 and nrows * (num_groups + 1) <= 2**25
-        else "scatter"
-    )
+    # under a VMEM-friendly working set.  Past that, TPUs still prefer the
+    # tiled MXU scan (scatter is slow on TPU); other backends scatter.
+    if num_groups <= 4096:
+        if nrows * (num_groups + 1) <= 2**25:
+            return "matmul"
+        if jax.default_backend() == "tpu":
+            return "matmul_tiled"
+    return "scatter"
 
 
 def group_reduce(
@@ -107,7 +112,43 @@ def group_reduce(
             name: ((col * validf) @ onehot)[:num_groups]
             for name, col in fields.items()
         }
-    else:
+    elif method == "matmul_tiled":
+        # Large-N variant: scan over row tiles so each [TILE, G+1] one-hot
+        # stays VMEM-sized while sums still ride the MXU — the TPU
+        # alternative to scatter when N*G won't fit at once.
+        TILE = 8192
+        n = safe_key.shape[-1]
+        pad = (-n) % TILE
+        kp = jnp.pad(safe_key, (0, pad), constant_values=num_groups)
+        vp = jnp.pad(validf, (0, pad))
+        fps = {name: jnp.pad(col, (0, pad)) for name, col in fields.items()}
+        groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups + 1,), 0)
+        names = sorted(fields.keys())
+
+        def tile_fn(carry, xs):
+            k_t, v_t, f_t = xs
+            onehot = (k_t[:, None] == groups[None, :]).astype(jnp.float32)
+            cnt = carry[0] + v_t @ onehot
+            sums_t = [
+                carry[1 + i] + (f_t[i] * v_t) @ onehot
+                for i in range(len(names))
+            ]
+            return (cnt, *sums_t), None
+
+        init = tuple(
+            jnp.zeros(num_groups + 1, jnp.float32) for _ in range(1 + len(names))
+        )
+        tiles = (
+            kp.reshape(-1, TILE),
+            vp.reshape(-1, TILE),
+            jnp.stack([fps[nm].reshape(-1, TILE) for nm in names], axis=1)
+            if names
+            else jnp.zeros((kp.shape[0] // TILE, 0, TILE), jnp.float32),
+        )
+        out, _ = jax.lax.scan(tile_fn, init, tiles)
+        count = out[0][:num_groups]
+        sums = {nm: out[1 + i][:num_groups] for i, nm in enumerate(names)}
+    elif method == "scatter":
         seg = jax.ops.segment_sum
         count = seg(validf, safe_key, num_segments=num_groups + 1)[:num_groups]
         sums = {
@@ -116,6 +157,8 @@ def group_reduce(
             ]
             for name, col in fields.items()
         }
+    else:
+        raise ValueError(f"unknown group_reduce method {method!r}")
 
     mins: dict[str, jax.Array] = {}
     maxs: dict[str, jax.Array] = {}

@@ -73,6 +73,7 @@ class PlanSpec:
     want_minmax: bool
     hist_field: str = ""  # non-empty -> also emit histogram partials
     nrows: int = CHUNK
+    group_method: str = "auto"  # ops.group_reduce method override
 
 
 _KERNEL_CACHE: dict[PlanSpec, object] = {}
@@ -108,6 +109,7 @@ def _build_kernel(spec: PlanSpec):
             chunk["fields"],
             spec.num_groups,
             want_minmax=spec.want_minmax,
+            method=spec.group_method,
         )
         out = {
             "count": res.count,

@@ -89,7 +89,7 @@ def test_masks():
     )
 
 
-@pytest.mark.parametrize("method", ["scatter", "matmul"])
+@pytest.mark.parametrize("method", ["scatter", "matmul", "matmul_tiled"])
 def test_group_reduce_matches_numpy(method):
     n, g = 1024, 12
     key = RNG.integers(0, g, size=n).astype(np.int32)
@@ -115,6 +115,32 @@ def test_group_reduce_matches_numpy(method):
             np.testing.assert_allclose(
                 np.asarray(res.mean("v"))[gi], vals[sel].mean(), rtol=1e-3, atol=1e-5
             )
+
+
+def test_group_reduce_matmul_tiled_multi_tile():
+    """n > TILE with a non-divisible remainder: exercises the scan carry
+    and pad path (a single-tile case would not)."""
+    n, g = 20_000, 7
+    key = RNG.integers(0, g, size=n).astype(np.int32)
+    valid = RNG.random(n) > 0.1
+    vals = RNG.normal(size=n).astype(np.float32)
+    res = ops.group_reduce(
+        jnp.asarray(key), jnp.asarray(valid), {"v": jnp.asarray(vals)},
+        g, method="matmul_tiled",
+    )
+    for gi in range(g):
+        sel = (key == gi) & valid
+        assert float(res.count[gi]) == sel.sum()
+        np.testing.assert_allclose(
+            float(res.sums["v"][gi]), vals[sel].sum(), rtol=1e-4, atol=1e-2
+        )
+
+
+def test_group_reduce_unknown_method_raises():
+    with pytest.raises(ValueError, match="unknown group_reduce method"):
+        ops.group_reduce(
+            jnp.zeros(8, jnp.int32), jnp.ones(8, bool), {}, 2, method="typo"
+        )
 
 
 def test_group_reduce_empty_groups_marked():
