@@ -36,7 +36,14 @@ class DataNode:
         self.bus.subscribe(Topic.MEASURE_WRITE, self._on_measure_write)
         self.bus.subscribe(Topic.MEASURE_QUERY_PARTIAL, self._on_measure_query_partial)
         self.bus.subscribe(Topic.MEASURE_QUERY_RAW, self._on_measure_query_raw)
-        self.bus.subscribe(Topic.HEALTH, lambda env: {"status": "ok", "node": self.name})
+        self.bus.subscribe(
+            Topic.HEALTH,
+            lambda env: {
+                "status": "ok",
+                "node": self.name,
+                "schema_revision": self.registry.revision,
+            },
+        )
         self.bus.subscribe(Topic.SCHEMA_SYNC, self._on_schema_sync)
         self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
 

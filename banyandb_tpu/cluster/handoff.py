@@ -1,0 +1,79 @@
+"""Hinted handoff: per-down-node write spools with replay on recovery.
+
+Analog of banyand/trace/handoff_controller.go:42,82 + handoff_storage.go,
+generalized to any write envelope: when a replica is unreachable, its
+envelopes spool to disk (JSON lines, size-capped, oldest-dropped); when
+the node comes back (probe), the spool replays in order.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from pathlib import Path
+from typing import Callable
+
+
+class HandoffController:
+    def __init__(
+        self,
+        root: str | Path,
+        *,
+        max_bytes_per_node: int = 256 << 20,
+    ):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.max_bytes = max_bytes_per_node
+        self._lock = threading.Lock()
+
+    def _spool_path(self, node: str) -> Path:
+        return self.root / f"{node}.spool"
+
+    def spool(self, node: str, topic: str, envelope: dict) -> None:
+        """Append one missed delivery for `node` (size-capped)."""
+        line = json.dumps({"topic": topic, "envelope": envelope}) + "\n"
+        with self._lock:
+            path = self._spool_path(node)
+            size = path.stat().st_size if path.exists() else 0
+            if size + len(line) > self.max_bytes:
+                # cap by dropping the oldest half (the reference drops
+                # oldest entries when its spool cap is hit)
+                lines = path.read_text().splitlines(keepends=True)
+                keep = lines[len(lines) // 2 :]
+                path.write_text("".join(keep))
+            with open(path, "a") as f:
+                f.write(line)
+
+    def pending(self, node: str) -> int:
+        path = self._spool_path(node)
+        if not path.exists():
+            return 0
+        with open(path) as f:
+            return sum(1 for _ in f)
+
+    def replay(self, node: str, deliver: Callable[[str, dict], None]) -> int:
+        """Drain the spool through `deliver(topic, envelope)`.
+
+        Entries that fail again stay spooled (delivery stops at the first
+        failure to preserve order). Returns replayed count.
+        """
+        with self._lock:
+            path = self._spool_path(node)
+            if not path.exists():
+                return 0
+            lines = path.read_text().splitlines()
+        done = 0
+        for line in lines:
+            rec = json.loads(line)
+            try:
+                deliver(rec["topic"], rec["envelope"])
+            except Exception:
+                break
+            done += 1
+        with self._lock:
+            rest = lines[done:]
+            if rest:
+                self._spool_path(node).write_text("\n".join(rest) + "\n")
+            else:
+                self._spool_path(node).unlink(missing_ok=True)
+        return done

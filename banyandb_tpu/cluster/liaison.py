@@ -37,6 +37,7 @@ class Liaison:
         *,
         replicas: int = 0,
         discovery=None,
+        handoff_root: Optional[str] = None,
     ):
         self.registry = registry
         self.transport = transport
@@ -46,6 +47,11 @@ class Liaison:
             nodes = discovery.nodes()
         self.selector = RoundRobinSelector(list(nodes), replicas)
         self.alive: set[str] = {n.name for n in nodes}
+        self.handoff = None
+        if handoff_root:
+            from banyandb_tpu.cluster.handoff import HandoffController
+
+            self.handoff = HandoffController(handoff_root)
 
     def refresh_nodes(self) -> bool:
         """Re-read discovery; rebuild placement when the node set changed
@@ -68,25 +74,94 @@ class Liaison:
             except TransportError:
                 pass
         self.alive = alive
+        # Hinted-handoff replay (handoff_controller.go:82): drain the spool
+        # of EVERY alive node with pending entries — keyed on pending, not
+        # on the down->up transition, so a partially failed replay retries
+        # at the next probe instead of stranding the spool.
+        if self.handoff is not None:
+            for node in self.selector.nodes:
+                if node.name in alive and self.handoff.pending(node.name):
+                    self.handoff.replay(
+                        node.name,
+                        lambda topic, env, addr=node.addr: self.transport.call(
+                            addr, topic, env
+                        ),
+                    )
         return alive
 
-    # -- schema push (barrier-lite: synchronous fan-out) --------------------
-    def sync_schema(self, kind: str, obj) -> None:
+    # -- schema push + barrier ---------------------------------------------
+    def sync_schema(self, kind: str, obj) -> dict[str, int]:
+        """Push one schema object to all nodes; down nodes get the sync
+        spooled through hinted handoff (they catch up at recovery).
+
+        -> {node: that node's LOCAL registry revision after applying} —
+        the acks a later schema_barrier() verifies against.  Per-node
+        revisions are independent counters (there is no shared etcd
+        sequence here), so the barrier contract is ack-based, not a
+        global number.
+        """
         from banyandb_tpu.api.schema import _to_jsonable
 
         env = {"kind": kind, "item": _to_jsonable(obj)}
+        acks: dict[str, int] = {}
         for n in self.selector.nodes:
-            if n.name in self.alive:
-                self.transport.call(n.addr, Topic.SCHEMA_SYNC.value, env)
+            if n.name not in self.alive:
+                if self.handoff is not None:
+                    self.handoff.spool(n.name, Topic.SCHEMA_SYNC.value, env)
+                continue
+            try:
+                r = self.transport.call(n.addr, Topic.SCHEMA_SYNC.value, env)
+                acks[n.name] = r.get("revision", 0)
+            except TransportError:
+                self.alive.discard(n.name)
+                if self.handoff is not None:
+                    self.handoff.spool(n.name, Topic.SCHEMA_SYNC.value, env)
+                else:
+                    raise
+        return acks
+
+    def schema_barrier(self, acks: dict[str, int], timeout_s: float = 10.0) -> bool:
+        """Block until every acked node still reports a registry revision
+        >= its ack (schema/v1/barrier.proto + barrier_cluster.go analog:
+        await cluster-wide application).  A node that stops answering
+        HEALTH counts as BEHIND — unreachable is exactly the window the
+        barrier exists to close.  Returns False on timeout."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        addr_of = {n.name: n.addr for n in self.selector.nodes}
+        while True:
+            behind = []
+            for name, want in acks.items():
+                try:
+                    r = self.transport.call(
+                        addr_of[name], Topic.HEALTH.value, {}, timeout=5
+                    )
+                    if r.get("schema_revision", 0) < want:
+                        behind.append(name)
+                except TransportError:
+                    behind.append(name)
+            if not behind:
+                return True
+            if _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.05)
 
     # -- writes -------------------------------------------------------------
     def write_measure(self, req: WriteRequest) -> int:
         """-> number of distinct points accepted (each counted once,
-        regardless of replica fan-out). Raises when a shard has no alive
-        replica — dropping writes silently is never acceptable."""
+        regardless of replica fan-out).
+
+        Durability contract: a point is accepted only if at least ONE
+        replica durably received it over the wire.  Known-down replicas
+        get their copies spooled through hinted handoff (so a recovered
+        node catches up on everything missed, not just in-flight
+        failures); the spool is a bounded cache, never the only copy —
+        zero reachable replicas for a shard raises."""
         m = self.registry.get_measure(req.group, req.name)
         shard_num = self.registry.get_group(req.group).resource_opts.shard_num
         by_node: dict[str, list] = {}
+        spool_points: dict[str, list] = {}
         addr_of: dict[str, str] = {}
         accepted = 0
         for p in req.points:
@@ -94,22 +169,55 @@ class Liaison:
                 hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
             ]
             shard = hashing.shard_id(hashing.series_id(entity), shard_num)
-            targets = [
-                n for n in self.selector.replica_set(shard) if n.name in self.alive
-            ]
+            replicas = self.selector.replica_set(shard)
+            targets = [n for n in replicas if n.name in self.alive]
             if not targets:
                 raise TransportError(f"no alive replica for shard {shard}")
             for node in targets:
                 by_node.setdefault(node.name, []).append(p)
                 addr_of[node.name] = node.addr
+            if self.handoff is not None:
+                for node in replicas:
+                    if node.name not in self.alive:
+                        spool_points.setdefault(node.name, []).append(p)
             accepted += 1
+
+        delivered_to: set[str] = set()
+        failed: dict[str, dict] = {}
         for name, points in by_node.items():
             env = {
                 "request": serde.write_request_to_json(
                     WriteRequest(req.group, req.name, tuple(points))
                 )
             }
-            self.transport.call(addr_of[name], Topic.MEASURE_WRITE.value, env)
+            try:
+                self.transport.call(addr_of[name], Topic.MEASURE_WRITE.value, env)
+                delivered_to.add(name)
+            except TransportError:
+                self.alive.discard(name)
+                failed[name] = env
+        if not delivered_to and failed:
+            # every wire delivery failed: nothing is durable — refuse
+            raise TransportError(
+                f"write reached no replica (failed: {sorted(failed)})"
+            )
+        if self.handoff is not None:
+            for name, env in failed.items():
+                self.handoff.spool(name, Topic.MEASURE_WRITE.value, env)
+            for name, points in spool_points.items():
+                self.handoff.spool(
+                    name,
+                    Topic.MEASURE_WRITE.value,
+                    {
+                        "request": serde.write_request_to_json(
+                            WriteRequest(req.group, req.name, tuple(points))
+                        )
+                    },
+                )
+        elif failed:
+            raise TransportError(
+                f"replica write failed with no handoff: {sorted(failed)}"
+            )
         return accepted
 
     # -- queries ------------------------------------------------------------

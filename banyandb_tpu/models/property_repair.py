@@ -1,0 +1,118 @@
+"""Property anti-entropy repair: Merkle reconciliation between replicas.
+
+Analog of banyand/property/db/repair.go + repair_gossip.go
+(docs/concept/property-repair.md): each replica summarizes its
+(group, name) property set as a two-level hash tree — root over 256
+slots, slot over the docs hashing into it (slot = doc_id % 256) — and two
+replicas reconcile root -> differing slots -> per-doc (id, mod_revision)
+lists; the higher mod_revision wins each conflict and missing docs copy
+across.  The exchange shape mirrors the reference's bidi-gRPC rounds but
+runs over any pair of PropertyEngine handles (the gossip scheduler
+drives pair selection above this layer).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from banyandb_tpu.models.property import Property, PropertyEngine
+
+SLOTS = 256
+
+
+def _doc_hash(p) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(p.id.encode())
+    h.update(p.mod_revision.to_bytes(8, "little"))
+    for k in sorted(p.tags):
+        h.update(k.encode() + b"=" + str(p.tags[k]).encode() + b";")
+    return h.digest()
+
+
+def _slot_of(p) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(p.id.encode(), digest_size=2).digest(), "little"
+    ) % SLOTS
+
+
+def state_tree(engine: "PropertyEngine", group: str, name: str) -> dict:
+    """{'root': hex, 'slots': {slot: hex}} — the state-tree.data analog."""
+    slots: dict[int, hashlib.blake2b] = {}
+    for p in engine.query(group, name, limit=1_000_000):
+        s = _slot_of(p)
+        h = slots.get(s)
+        if h is None:
+            h = slots[s] = hashlib.blake2b(digest_size=16)
+        h.update(_doc_hash(p))
+    slot_hex = {s: h.hexdigest() for s, h in sorted(slots.items())}
+    root = hashlib.blake2b(digest_size=16)
+    for s, hx in sorted(slot_hex.items()):
+        root.update(s.to_bytes(2, "little") + bytes.fromhex(hx))
+    return {"root": root.hexdigest(), "slots": slot_hex}
+
+
+def _slot_docs(engine, group, name, slot: int) -> dict[str, "Property"]:
+    return {
+        p.id: p
+        for p in engine.query(group, name, limit=1_000_000)
+        if _slot_of(p) == slot
+    }
+
+
+def repair_pair(
+    a: "PropertyEngine", b: "PropertyEngine", group: str, name: str
+) -> int:
+    """Reconcile (group, name) between two replicas; returns docs copied.
+
+    Round 1: roots.  Round 2: differing slots.  Round 3: per-doc
+    (id, mod) — higher mod_revision wins, ties are already identical by
+    hash construction, missing docs copy across.
+    """
+    from banyandb_tpu.models.property import Property
+
+    ta, tb = state_tree(a, group, name), state_tree(b, group, name)
+    if ta["root"] == tb["root"]:
+        return 0
+    slots = set(ta["slots"]) | set(tb["slots"])
+    copied = 0
+    for s in slots:
+        if ta["slots"].get(s) == tb["slots"].get(s):
+            continue
+        docs_a = _slot_docs(a, group, name, int(s))
+        docs_b = _slot_docs(b, group, name, int(s))
+        for pid in set(docs_a) | set(docs_b):
+            pa, pb = docs_a.get(pid), docs_b.get(pid)
+            if pa is not None and (pb is None or pa.mod_revision > pb.mod_revision):
+                _install(b, pa)
+                copied += 1
+            elif pb is not None and (pa is None or pb.mod_revision > pa.mod_revision):
+                _install(a, pb)
+                copied += 1
+    return copied
+
+
+def _install(engine: "PropertyEngine", p) -> None:
+    """Install a replica's doc verbatim (preserving its mod_revision) —
+    repair must not mint new revisions or the tree never converges."""
+    import json
+
+    from banyandb_tpu.index.inverted import Doc
+
+    idx = engine._shard_for(p.group, p.name, p.id)
+    keywords = {"@name": p.name.encode(), "@id": p.id.encode()}
+    for k, v in p.tags.items():
+        keywords[k] = str(v).encode()
+    idx.insert(
+        [
+            Doc(
+                doc_id=engine._doc_id(p.name, p.id),
+                keywords=keywords,
+                numerics={"@mod": p.mod_revision, "@create": p.create_revision},
+                payload=json.dumps(
+                    {"id": p.id, "name": p.name, "tags": p.tags}
+                ).encode(),
+            )
+        ]
+    )

@@ -1,0 +1,202 @@
+"""Hinted handoff, schema barrier, property anti-entropy repair."""
+
+import pytest
+
+from banyandb_tpu.api import (
+    Aggregation,
+    Catalog,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo
+from banyandb_tpu.cluster.handoff import HandoffController
+from banyandb_tpu.cluster.rpc import LocalTransport
+from banyandb_tpu.models.property import Property, PropertyEngine
+from banyandb_tpu.models.property_repair import repair_pair, state_tree
+
+T0 = 1_700_000_000_000
+
+
+def _schema(reg, shard_num=2, replicas=1):
+    reg.create_group(
+        Group("sw", Catalog.MEASURE, ResourceOpts(shard_num=shard_num, replicas=replicas))
+    )
+    reg.create_measure(
+        Measure("sw", "cpm", (TagSpec("svc", TagType.STRING),),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    )
+
+
+def test_handoff_spool_and_replay(tmp_path):
+    h = HandoffController(tmp_path)
+    h.spool("node-x", "measure-write", {"a": 1})
+    h.spool("node-x", "measure-write", {"a": 2})
+    assert h.pending("node-x") == 2
+
+    delivered = []
+    n = h.replay("node-x", lambda t, e: delivered.append(e["a"]))
+    assert n == 2 and delivered == [1, 2]
+    assert h.pending("node-x") == 0
+
+    # failing delivery keeps order and remaining entries
+    h.spool("node-y", "t", {"a": 1})
+    h.spool("node-y", "t", {"a": 2})
+
+    def flaky(t, e):
+        raise RuntimeError("down")
+
+    assert h.replay("node-y", flaky) == 0
+    assert h.pending("node-y") == 2
+
+
+def test_liaison_handoff_on_mid_write_failure(tmp_path):
+    transport = LocalTransport()
+    nodes, dns = [], []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        _schema(reg)
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+        dns.append(dn)
+    lreg = SchemaRegistry(tmp_path / "l")
+    _schema(lreg)
+    liaison = Liaison(lreg, transport, nodes, replicas=1,
+                      handoff_root=tmp_path / "handoff")
+
+    # d1 dies AFTER routing decided (liaison still believes it's alive)
+    transport.unregister("d1")
+    pts = tuple(
+        DataPointValue(T0 + i, {"svc": f"s{i}"}, {"v": 1.0}, version=1)
+        for i in range(20)
+    )
+    assert liaison.write_measure(WriteRequest("sw", "cpm", pts)) == 20
+    assert liaison.handoff.pending("d1") > 0
+
+    # recovery: re-register, probe triggers replay
+    transport.register("d1", dns[1].bus)
+    liaison.probe()
+    assert liaison.handoff.pending("d1") == 0
+    r = dns[1].measure.query(
+        QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 100), agg=Aggregation("count", "v"))
+    )
+    assert r.values["count"][0] > 0  # replayed rows landed
+
+
+def test_handoff_covers_known_down_replicas(tmp_path):
+    """Writes while a replica is marked dead must be spooled too — not just
+    the one write that failed in flight."""
+    transport = LocalTransport()
+    nodes, dns = [], []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        _schema(reg, shard_num=2, replicas=1)
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+        dns.append(dn)
+    lreg = SchemaRegistry(tmp_path / "l")
+    _schema(lreg, shard_num=2, replicas=1)
+    liaison = Liaison(lreg, transport, nodes, replicas=1,
+                      handoff_root=tmp_path / "handoff")
+    transport.unregister("d1")
+    liaison.probe()  # d1 now known-down
+    pts = tuple(
+        DataPointValue(T0 + i, {"svc": f"s{i}"}, {"v": 1.0}, version=1)
+        for i in range(30)
+    )
+    assert liaison.write_measure(WriteRequest("sw", "cpm", pts)) == 30
+    assert liaison.handoff.pending("d1") > 0  # routed-away copies spooled
+
+    transport.register("d1", dns[1].bus)
+    liaison.probe()
+    assert liaison.handoff.pending("d1") == 0
+    # d1 holds every row of its replica shards: totals across nodes match
+    r0 = dns[0].measure.query(QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 100),
+                                           agg=Aggregation("count", "v")))
+    r1 = dns[1].measure.query(QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 100),
+                                           agg=Aggregation("count", "v")))
+    # replicas=1, 2 nodes: both nodes hold all shards' copies
+    assert r0.values["count"][0] == 30 and r1.values["count"][0] == 30
+
+
+def test_write_raises_when_nothing_durable(tmp_path):
+    transport = LocalTransport()
+    reg = SchemaRegistry(tmp_path / "n0")
+    _schema(reg, shard_num=1, replicas=0)
+    dn = DataNode("d0", reg, tmp_path / "n0" / "data")
+    nodes = [NodeInfo("d0", transport.register("d0", dn.bus))]
+    lreg = SchemaRegistry(tmp_path / "l")
+    _schema(lreg, shard_num=1, replicas=0)
+    liaison = Liaison(lreg, transport, nodes,
+                      handoff_root=tmp_path / "handoff")
+    transport.unregister("d0")  # dies after routing believes it's alive
+    from banyandb_tpu.cluster.rpc import TransportError
+
+    with pytest.raises(TransportError, match="reached no replica"):
+        liaison.write_measure(WriteRequest("sw", "cpm", (
+            DataPointValue(T0, {"svc": "s"}, {"v": 1.0}, version=1),)))
+
+
+def test_schema_barrier(tmp_path):
+    transport = LocalTransport()
+    nodes, dns = [], []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        _schema(reg)
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+        dns.append(dn)
+    lreg = SchemaRegistry(tmp_path / "l")
+    _schema(lreg)
+    liaison = Liaison(lreg, transport, nodes)
+
+    m = Measure("sw", "m2", (TagSpec("svc", TagType.STRING),),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    liaison.registry.create_measure(m)
+    acks = liaison.sync_schema("measure", m)
+    assert set(acks) == {"d0", "d1"}
+    assert liaison.schema_barrier(acks, timeout_s=2)
+    # a node that stops answering counts as BEHIND, not as passed
+    transport.unregister("d1")
+    assert not liaison.schema_barrier(acks, timeout_s=0.3)
+
+
+def _prop_engine(tmp_path, name):
+    reg = SchemaRegistry(tmp_path / name)
+    reg.create_group(Group("g", Catalog.PROPERTY, ResourceOpts(shard_num=2)))
+    return PropertyEngine(reg, tmp_path / name / "data")
+
+
+def test_property_repair_converges(tmp_path):
+    a = _prop_engine(tmp_path, "a")
+    b = _prop_engine(tmp_path, "b")
+    # shared history
+    for i in range(20):
+        p = a.apply(Property("g", "cfg", f"id{i}", {"v": str(i)}))
+        from banyandb_tpu.models import property_repair
+
+        property_repair._install(b, p)
+    assert state_tree(a, "g", "cfg")["root"] == state_tree(b, "g", "cfg")["root"]
+
+    # divergence: a updates id3; b gets a brand-new id99; b deletes nothing
+    a.apply(Property("g", "cfg", "id3", {"v": "NEW"}))
+    b.apply(Property("g", "cfg", "id99", {"v": "only-b"}))
+    assert state_tree(a, "g", "cfg")["root"] != state_tree(b, "g", "cfg")["root"]
+
+    copied = repair_pair(a, b, "g", "cfg")
+    assert copied >= 2
+    assert state_tree(a, "g", "cfg")["root"] == state_tree(b, "g", "cfg")["root"]
+    assert b.get("g", "cfg", "id3").tags["v"] == "NEW"
+    assert a.get("g", "cfg", "id99").tags["v"] == "only-b"
+    # idempotent once converged
+    assert repair_pair(a, b, "g", "cfg") == 0
