@@ -77,6 +77,22 @@ def main(argv=None) -> int:
     q = sub.add_parser("query")
     q.add_argument("ql", help="BydbQL text")
 
+    tg = sub.add_parser("trace-get")
+    tg.add_argument("group")
+    tg.add_argument("name")
+    tg.add_argument("trace_id")
+
+    pr = sub.add_parser("property")
+    pr.add_argument("action", choices=["apply", "get", "query"])
+    pr.add_argument("group")
+    pr.add_argument("name")
+    pr.add_argument("id", nargs="?")
+    pr.add_argument("--tags", default="{}", help="JSON tag map")
+
+    ins = sub.add_parser("inspect", help="offline on-disk inspection")
+    ins.add_argument("--root", help="server root (offline mode)")
+    ins.add_argument("--part", help="one part dir for column detail")
+
     args = ap.parse_args(argv)
 
     if args.cmd == "health":
@@ -143,6 +159,37 @@ def main(argv=None) -> int:
         print(json.dumps(_call(args, Topic.MEASURE_WRITE.value, env)))
     elif args.cmd == "query":
         print(json.dumps(_call(args, TOPIC_QL, {"ql": args.ql}), indent=1))
+    elif args.cmd == "trace-get":
+        print(json.dumps(_call(args, Topic.TRACE_QUERY_BY_ID.value, {
+            "group": args.group, "name": args.name, "trace_id": args.trace_id,
+        }), indent=1))
+    elif args.cmd == "property":
+        if args.action in ("apply", "get") and not args.id:
+            print(f"property {args.action} requires an id", file=sys.stderr)
+            return 2
+        if args.action == "apply":
+            print(json.dumps(_call(args, Topic.PROPERTY_APPLY.value, {
+                "group": args.group, "name": args.name, "id": args.id,
+                "tags": json.loads(args.tags),
+            })))
+        elif args.action == "get":
+            print(json.dumps(_call(args, Topic.PROPERTY_QUERY.value, {
+                "group": args.group, "name": args.name, "id": args.id,
+            })))
+        else:
+            print(json.dumps(_call(args, Topic.PROPERTY_QUERY.value, {
+                "group": args.group, "name": args.name,
+            }), indent=1))
+    elif args.cmd == "inspect":
+        from banyandb_tpu.admin.inspect import inspect_part, inspect_root
+
+        if args.part:
+            print(json.dumps(inspect_part(args.part), indent=1))
+        elif args.root:
+            print(json.dumps(inspect_root(args.root), indent=1))
+        else:
+            print("inspect needs --root or --part", file=sys.stderr)
+            return 2
     return 0
 
 

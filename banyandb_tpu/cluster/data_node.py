@@ -22,10 +22,15 @@ class DataNode:
     def __init__(self, name: str, registry: SchemaRegistry, root: str | Path):
         import shutil
 
+        from banyandb_tpu.models.stream import StreamEngine
+        from banyandb_tpu.models.trace import TraceEngine
+
         self.name = name
         self.registry = registry
         self.root = Path(root)
         self.measure = MeasureEngine(registry, self.root)
+        self.stream = StreamEngine(registry, self.root)
+        self.trace = TraceEngine(registry, self.root)
         self.bus = LocalBus()
         self._sync_sessions: dict[str, dict] = {}
         # abandoned chunked-sync sessions from a previous process die here
@@ -36,6 +41,10 @@ class DataNode:
         self.bus.subscribe(Topic.MEASURE_WRITE, self._on_measure_write)
         self.bus.subscribe(Topic.MEASURE_QUERY_PARTIAL, self._on_measure_query_partial)
         self.bus.subscribe(Topic.MEASURE_QUERY_RAW, self._on_measure_query_raw)
+        self.bus.subscribe(Topic.STREAM_WRITE, self._on_stream_write)
+        self.bus.subscribe(Topic.STREAM_QUERY, self._on_stream_query)
+        self.bus.subscribe(Topic.TRACE_WRITE, self._on_trace_write)
+        self.bus.subscribe(Topic.TRACE_QUERY_BY_ID, self._on_trace_query)
         self.bus.subscribe(
             Topic.HEALTH,
             lambda env: {
@@ -46,6 +55,54 @@ class DataNode:
         )
         self.bus.subscribe(Topic.SCHEMA_SYNC, self._on_schema_sync)
         self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
+
+    # -- stream plane (stream svc_data analog) ------------------------------
+    def _on_stream_write(self, env: dict) -> dict:
+        # schema piggybacked on first contact (streams live outside the
+        # core registry kinds; liaison ships the spec with writes)
+        if "schema" in env:
+            item = env["schema"]
+            try:
+                self.stream.get_stream(item["group"], item["name"])
+            except KeyError:
+                self.stream.create_stream(serde.stream_schema_from_json(item))
+        n = self.stream.write(
+            env["group"], env["name"], serde.elements_from_json(env["elements"])
+        )
+        return {"written": n}
+
+    def _on_stream_query(self, env: dict) -> dict:
+        import base64
+
+        req = serde.query_request_from_json(env["request"])
+        shard_ids = set(env["shards"]) if env.get("shards") is not None else None
+        res = self.stream.query(req, shard_ids=shard_ids)
+        return {
+            "data_points": [
+                {**dp, "body": base64.b64encode(dp["body"]).decode()}
+                for dp in res.data_points
+            ]
+        }
+
+    # -- trace plane (trace svc_data analog) --------------------------------
+    def _on_trace_write(self, env: dict) -> dict:
+        if "schema" in env:
+            item = env["schema"]
+            try:
+                self.trace.get_trace(item["group"], item["name"])
+            except KeyError:
+                self.trace.create_trace(serde.trace_schema_from_json(item))
+        n = self.trace.write(
+            env["group"], env["name"], serde.spans_from_json(env["spans"]),
+            ordered_tags=tuple(env.get("ordered_tags", ())),
+        )
+        return {"written": n}
+
+    def _on_trace_query(self, env: dict) -> dict:
+        spans = self.trace.query_by_trace_id(
+            env["group"], env["name"], env["trace_id"]
+        )
+        return {"spans": serde.spans_to_json(spans)}
 
     # -- write plane --------------------------------------------------------
     def _on_measure_write(self, env: dict) -> dict:

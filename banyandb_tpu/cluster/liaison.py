@@ -182,43 +182,57 @@ class Liaison:
                         spool_points.setdefault(node.name, []).append(p)
             accepted += 1
 
-        delivered_to: set[str] = set()
-        failed: dict[str, dict] = {}
-        for name, points in by_node.items():
-            env = {
+        def env_for(points):
+            return {
                 "request": serde.write_request_to_json(
                     WriteRequest(req.group, req.name, tuple(points))
                 )
             }
+
+        self._deliver_writes(
+            Topic.MEASURE_WRITE.value,
+            {n: env_for(p) for n, p in by_node.items()},
+            addr_of,
+            {n: env_for(p) for n, p in spool_points.items()},
+        )
+        return accepted
+
+    def _deliver_writes(
+        self,
+        topic: str,
+        by_node_env: dict[str, dict],
+        addr_of: dict[str, str],
+        spool_env: dict[str, dict],
+    ) -> None:
+        """Shared write-plane delivery contract (all three models):
+        - in-flight TransportError marks the node dead + spools (ordering
+          preserved via the handoff spool);
+        - zero successful wire deliveries -> raise (a spool alone is a
+          bounded cache, not durable storage);
+        - known-down replica copies (spool_env) land in the spool so a
+          recovered node replays the whole outage window."""
+        delivered_to: set[str] = set()
+        failed: dict[str, dict] = {}
+        for name, env in by_node_env.items():
             try:
-                self.transport.call(addr_of[name], Topic.MEASURE_WRITE.value, env)
+                self.transport.call(addr_of[name], topic, env)
                 delivered_to.add(name)
             except TransportError:
                 self.alive.discard(name)
                 failed[name] = env
         if not delivered_to and failed:
-            # every wire delivery failed: nothing is durable — refuse
             raise TransportError(
                 f"write reached no replica (failed: {sorted(failed)})"
             )
         if self.handoff is not None:
             for name, env in failed.items():
-                self.handoff.spool(name, Topic.MEASURE_WRITE.value, env)
-            for name, points in spool_points.items():
-                self.handoff.spool(
-                    name,
-                    Topic.MEASURE_WRITE.value,
-                    {
-                        "request": serde.write_request_to_json(
-                            WriteRequest(req.group, req.name, tuple(points))
-                        )
-                    },
-                )
+                self.handoff.spool(name, topic, env)
+            for name, env in spool_env.items():
+                self.handoff.spool(name, topic, env)
         elif failed:
             raise TransportError(
                 f"replica write failed with no handoff: {sorted(failed)}"
             )
-        return accepted
 
     # -- queries ------------------------------------------------------------
     def _shard_assignment(self, group: str) -> dict[NodeInfo, list[int]]:
@@ -298,6 +312,114 @@ class Liaison:
 
         partials = self._scatter_partials(req, assignment, hist_range)
         return measure_exec.finalize_partials(m, req, partials)
+
+
+    def _route_items(self, items, shard_of) -> tuple[dict, dict, dict]:
+        """items -> (by_node, spool_items, addr_of); raises when an item's
+        shard has no alive replica (same contract as write_measure)."""
+        by_node: dict[str, list] = {}
+        spool_items: dict[str, list] = {}
+        addr_of: dict[str, str] = {}
+        for item in items:
+            shard = shard_of(item)
+            replicas = self.selector.replica_set(shard)
+            targets = [n for n in replicas if n.name in self.alive]
+            if not targets:
+                raise TransportError(f"no alive replica for shard {shard}")
+            for node in targets:
+                by_node.setdefault(node.name, []).append(item)
+                addr_of[node.name] = node.addr
+            if self.handoff is not None:
+                for node in replicas:
+                    if node.name not in self.alive:
+                        spool_items.setdefault(node.name, []).append(item)
+        return by_node, spool_items, addr_of
+
+    # -- stream plane (liaison stream svc analog) ---------------------------
+    def write_stream(self, group: str, name: str, stream_schema: dict, elements: list[dict]) -> int:
+        """Route elements by entity-hash shard; schema piggybacks so data
+        nodes lazily learn the stream spec."""
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        entity_tags = stream_schema["entity"]
+
+        def shard_of(e):
+            entity = [name.encode()] + [
+                hashing.entity_bytes(e["tags"][t]) for t in entity_tags
+            ]
+            return hashing.shard_id(hashing.series_id(entity), shard_num)
+
+        by_node, spool_items, addr_of = self._route_items(elements, shard_of)
+
+        def env_for(elems):
+            return {"group": group, "name": name, "schema": stream_schema, "elements": elems}
+
+        self._deliver_writes(
+            Topic.STREAM_WRITE.value,
+            {n: env_for(e) for n, e in by_node.items()},
+            addr_of,
+            {n: env_for(e) for n, e in spool_items.items()},
+        )
+        return len(elements)
+
+    def query_stream(self, req: QueryRequest) -> QueryResult:
+        assignment = self._shard_assignment(req.groups[0])
+        off = req.offset or 0
+        limit = req.limit or 100
+        node_req = dataclasses.replace(req, offset=0, limit=off + limit)
+        rows: list[dict] = []
+        for node, shards in assignment.items():
+            r = self.transport.call(
+                node.addr,
+                Topic.STREAM_QUERY.value,
+                {"request": serde.query_request_to_json(node_req), "shards": shards},
+            )
+            rows.extend(r["data_points"])
+        rows.sort(key=lambda d: d["timestamp"], reverse=(req.order_by_ts != "asc"))
+        res = QueryResult()
+        res.data_points = rows[off : off + limit]
+        return res
+
+    # -- trace plane (liaison trace svc analog) -----------------------------
+    def write_trace(
+        self, group: str, name: str, trace_schema: dict, spans: list[dict],
+        ordered_tags: tuple[str, ...] = (),
+    ) -> int:
+        from banyandb_tpu.models.trace import trace_shard_id
+
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        tid_tag = trace_schema["trace_id_tag"]
+        by_node, spool_items, addr_of = self._route_items(
+            spans,
+            lambda s: trace_shard_id(str(s["tags"][tid_tag]), shard_num),
+        )
+
+        def env_for(batch):
+            return {
+                "group": group, "name": name, "schema": trace_schema,
+                "spans": batch, "ordered_tags": list(ordered_tags),
+            }
+
+        self._deliver_writes(
+            Topic.TRACE_WRITE.value,
+            {n: env_for(b) for n, b in by_node.items()},
+            addr_of,
+            {n: env_for(b) for n, b in spool_items.items()},
+        )
+        return len(spans)
+
+    def query_trace_by_id(self, group: str, name: str, trace_id: str) -> list[dict]:
+        """Single-shard lookup: route to the trace's shard owner."""
+        from banyandb_tpu.models.trace import trace_shard_id
+
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        shard = trace_shard_id(trace_id, shard_num)
+        node = self.selector.primary(shard, self.alive)
+        r = self.transport.call(
+            node.addr,
+            Topic.TRACE_QUERY_BY_ID.value,
+            {"group": group, "name": name, "trace_id": trace_id},
+        )
+        return r["spans"]
 
 
 class ChunkedSyncClient:

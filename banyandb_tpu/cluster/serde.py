@@ -157,6 +157,71 @@ def write_request_from_json(d: dict) -> WriteRequest:
     return WriteRequest(d["group"], d["name"], tuple(pts))
 
 
+# -- stream elements / trace spans (one wire format, used by the
+#    standalone server AND the data-node role) ------------------------------
+
+
+def elements_from_json(items: list[dict]):
+    from banyandb_tpu.models.stream import ElementValue
+
+    return [
+        ElementValue(
+            element_id=e["element_id"],
+            ts_millis=e["ts"],
+            tags=e["tags"],
+            body=_unb64(e.get("body", "")),
+        )
+        for e in items
+    ]
+
+
+def spans_from_json(items: list[dict]):
+    from banyandb_tpu.models.trace import SpanValue
+
+    return [
+        SpanValue(
+            ts_millis=s["ts"],
+            tags=s["tags"],
+            span=_unb64(s.get("span", "")),
+        )
+        for s in items
+    ]
+
+
+def spans_to_json(spans: list[dict]) -> list[dict]:
+    return [{**s, "span": _b64(s["span"])} for s in spans]
+
+
+def stream_schema_from_json(item: dict):
+    from banyandb_tpu.api import schema as schema_mod
+    from banyandb_tpu.models.stream import Stream
+
+    return Stream(
+        group=item["group"],
+        name=item["name"],
+        tags=tuple(
+            schema_mod.TagSpec(t["name"], schema_mod.TagType(t["type"]))
+            for t in item["tags"]
+        ),
+        entity=tuple(item["entity"]),
+    )
+
+
+def trace_schema_from_json(item: dict):
+    from banyandb_tpu.api import schema as schema_mod
+    from banyandb_tpu.models.trace import Trace
+
+    return Trace(
+        group=item["group"],
+        name=item["name"],
+        tags=tuple(
+            schema_mod.TagSpec(t["name"], schema_mod.TagType(t["type"]))
+            for t in item["tags"]
+        ),
+        trace_id_tag=item["trace_id_tag"],
+    )
+
+
 # -- partial aggregates -----------------------------------------------------
 
 

@@ -127,7 +127,7 @@ class StreamEngine:
                 out.extend(db.flush_all())
         return out
 
-    def query(self, req: QueryRequest) -> QueryResult:
+    def query(self, req: QueryRequest, shard_ids=None) -> QueryResult:
         group = req.groups[0]
         s = self.get_stream(group, req.name)
         db = self._tsdb(group)
@@ -138,7 +138,7 @@ class StreamEngine:
         rows: list[tuple] = []
         for attempt in range(3):
             try:
-                rows = self._scan(db, s, req, conds)
+                rows = self._scan(db, s, req, conds, shard_ids)
                 break
             except FileNotFoundError:
                 if attempt == 2:
@@ -156,13 +156,17 @@ class StreamEngine:
             )
         return res
 
-    def _scan(self, db: TSDB, s: Stream, req: QueryRequest, conds) -> list[tuple]:
+    def _scan(
+        self, db: TSDB, s: Stream, req: QueryRequest, conds, shard_ids=None
+    ) -> list[tuple]:
         rows: list[tuple] = []
         tag_names = [t.name for t in s.tags]
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
         ):
-            for shard in seg.shards:
+            for shard_idx, shard in enumerate(seg.shards):
+                if shard_ids is not None and shard_idx not in shard_ids:
+                    continue
                 mem_cols = shard.mem.columns_for(s.name)
                 sources = [mem_cols] if mem_cols is not None and mem_cols.ts.size else []
                 for part in shard.parts:

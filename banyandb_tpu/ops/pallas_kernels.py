@@ -1,0 +1,88 @@
+"""Pallas TPU kernels for the scan hot loop.
+
+The XLA path (ops.group_reduce) already fuses mask+reduce well; these
+hand-written kernels exist for the cases where explicit control of VMEM
+tiling wins: one pass over HBM-resident row tiles computing the
+filtered per-group sum/count without materializing the one-hot operand
+in HBM.  Grid = row tiles; the [G] accumulators live in the output block
+(revisited by every grid step — TPU grids execute sequentially, so
+read-modify-write accumulation across steps is sound).
+
+Runs in interpret mode on CPU for correctness tests; compiled mode on
+TPU (pallas_guide.md patterns: grid accumulation, @pl.when init).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+TILE = 2048
+
+
+def _fused_kernel(codes_ref, pred_ref, vals_ref, valid_ref, count_ref, sum_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        count_ref[:] = jnp.zeros_like(count_ref)
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+
+    codes = codes_ref[:]  # [1, TILE] int32 group codes
+    pred = pred_ref[:]  # [1, TILE] int32 0/1 predicate flags
+    vals = vals_ref[:]  # [1, TILE] f32
+    valid = valid_ref[:]  # [1, TILE] f32 (1.0 valid)
+
+    # predicate arrives as a per-row 0/1 flag; multiply is the AND
+    mask = valid * pred.astype(jnp.float32)
+
+    g = count_ref.shape[1]
+    groups = jax.lax.broadcasted_iota(jnp.int32, (1, g), 1)
+    onehot = (codes[0, :, None] == groups[0, None, :]).astype(jnp.float32)
+    count_ref[:] += (mask[0, :] @ onehot)[None, :]
+    sum_ref[:] += ((vals[0, :] * mask[0, :]) @ onehot)[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def fused_group_sum(
+    codes: jax.Array,
+    pred_mask: jax.Array,
+    values: jax.Array,
+    valid: jax.Array,
+    *,
+    num_groups: int,
+    interpret: bool = False,
+):
+    """Filtered per-group (count, sum) in one pass.
+
+    codes: int32 [N] group codes; pred_mask: bool [N] predicate;
+    values: f32 [N]; valid: bool [N]. N must be a TILE multiple.
+    -> (count f32 [G], sum f32 [G])
+    """
+    n = codes.shape[0]
+    assert n % TILE == 0, f"N={n} must be a multiple of {TILE}"
+    grid = (n // TILE,)
+
+    codes2 = codes.reshape(1, n)
+    pred2 = pred_mask.astype(jnp.int32).reshape(1, n)
+    vals2 = values.reshape(1, n)
+    valid2 = valid.astype(jnp.float32).reshape(1, n)
+
+    row_spec = pl.BlockSpec((1, TILE), lambda i: (0, i))
+    acc_spec = pl.BlockSpec((1, num_groups), lambda i: (0, 0))
+
+    count, total = pl.pallas_call(
+        _fused_kernel,
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec, row_spec],
+        out_specs=(acc_spec, acc_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
+            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
+        ),
+        interpret=interpret,
+    )(codes2, pred2, vals2, valid2)
+    return count[0], total[0]

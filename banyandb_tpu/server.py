@@ -26,8 +26,8 @@ from banyandb_tpu.cluster.bus import LocalBus, Topic
 from banyandb_tpu.cluster.rpc import GrpcBusServer
 from banyandb_tpu.models.measure import MeasureEngine
 from banyandb_tpu.models.property import Property, PropertyEngine
-from banyandb_tpu.models.stream import ElementValue, Stream, StreamEngine
-from banyandb_tpu.models.trace import SpanValue, Trace, TraceEngine
+from banyandb_tpu.models.stream import Stream, StreamEngine
+from banyandb_tpu.models.trace import Trace, TraceEngine
 
 # user-facing topics beyond the internal cluster set
 TOPIC_QL = "bydbql"
@@ -132,16 +132,9 @@ class StandaloneServer:
         return {"prometheus": self.meter.prometheus_text()}
 
     def _stream_write(self, env):
-        elements = [
-            ElementValue(
-                element_id=e["element_id"],
-                ts_millis=e["ts"],
-                tags=e["tags"],
-                body=base64.b64decode(e.get("body", "")),
-            )
-            for e in env["elements"]
-        ]
-        n = self.stream.write(env["group"], env["name"], elements)
+        n = self.stream.write(
+            env["group"], env["name"], serde.elements_from_json(env["elements"])
+        )
         return {"written": n}
 
     def _stream_query(self, env):
@@ -149,16 +142,8 @@ class StandaloneServer:
         return {"result": result_to_json(self.stream.query(req))}
 
     def _trace_write(self, env):
-        spans = [
-            SpanValue(
-                ts_millis=s["ts"],
-                tags=s["tags"],
-                span=base64.b64decode(s.get("span", "")),
-            )
-            for s in env["spans"]
-        ]
         n = self.trace.write(
-            env["group"], env["name"], spans,
+            env["group"], env["name"], serde.spans_from_json(env["spans"]),
             ordered_tags=tuple(env.get("ordered_tags", ())),
         )
         return {"written": n}
@@ -167,12 +152,7 @@ class StandaloneServer:
         spans = self.trace.query_by_trace_id(
             env["group"], env["name"], env["trace_id"]
         )
-        return {
-            "spans": [
-                {**s, "span": base64.b64encode(s["span"]).decode()}
-                for s in spans
-            ]
-        }
+        return {"spans": serde.spans_to_json(spans)}
 
     def _property_apply(self, env):
         p = self.property.apply(

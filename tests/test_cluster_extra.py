@@ -171,6 +171,68 @@ def test_schema_barrier(tmp_path):
     assert not liaison.schema_barrier(acks, timeout_s=0.3)
 
 
+def test_distributed_stream_and_trace(tmp_path):
+    import base64
+
+    transport = LocalTransport()
+    nodes, dns = [], []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        _schema(reg, shard_num=4, replicas=1)
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+        dns.append(dn)
+    lreg = SchemaRegistry(tmp_path / "l")
+    _schema(lreg, shard_num=4, replicas=1)
+    liaison = Liaison(lreg, transport, nodes, replicas=1)
+
+    stream_schema = {
+        "group": "sw", "name": "logs",
+        "tags": [{"name": "svc", "type": "string"}, {"name": "level", "type": "string"}],
+        "entity": ["svc"],
+    }
+    elements = [
+        {"element_id": f"e{i}", "ts": T0 + i,
+         "tags": {"svc": f"s{i % 5}", "level": "ERROR" if i % 4 == 0 else "INFO"},
+         "body": base64.b64encode(f"line{i}".encode()).decode()}
+        for i in range(80)
+    ]
+    assert liaison.write_stream("sw", "logs", stream_schema, elements) == 80
+
+    from banyandb_tpu.api.model import Condition
+
+    res = liaison.query_stream(
+        QueryRequest(("sw",), "logs", TimeRange(T0, T0 + 1000),
+                     criteria=Condition("level", "eq", "ERROR"), limit=100)
+    )
+    assert len(res.data_points) == 20  # replicas not duplicated
+    assert all(dp["tags"]["level"] == "ERROR" for dp in res.data_points)
+
+    trace_schema = {
+        "group": "sw", "name": "traces",
+        "tags": [{"name": "trace_id", "type": "string"},
+                 {"name": "svc", "type": "string"},
+                 {"name": "duration", "type": "int"}],
+        "trace_id_tag": "trace_id",
+    }
+    spans = [
+        {"ts": T0 + i, "tags": {"trace_id": f"t{i // 3}", "svc": "s", "duration": i},
+         "span": base64.b64encode(f"sp{i}".encode()).decode()}
+        for i in range(30)
+    ]
+    assert liaison.write_trace("sw", "traces", trace_schema, spans,
+                               ordered_tags=("duration",)) == 30
+    got = liaison.query_trace_by_id("sw", "traces", "t4")
+    assert len(got) == 3
+    assert base64.b64decode(got[0]["span"]) == b"sp12"
+
+    # failover: trace lookup survives losing one node (replicas=1)
+    transport.unregister("d0")
+    liaison.probe()
+    got = liaison.query_trace_by_id("sw", "traces", "t4")
+    assert len(got) == 3
+
+
 def _prop_engine(tmp_path, name):
     reg = SchemaRegistry(tmp_path / name)
     reg.create_group(Group("g", Catalog.PROPERTY, ResourceOpts(shard_num=2)))

@@ -1,0 +1,41 @@
+"""Pallas fused scan kernel vs NumPy oracle (interpret mode on CPU;
+compiled on TPU)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from banyandb_tpu.ops.pallas_kernels import TILE, fused_group_sum
+
+RNG = np.random.default_rng(33)
+
+
+def test_fused_group_sum_matches_oracle():
+    n, g = TILE * 4, 16
+    codes = RNG.integers(0, g, n).astype(np.int32)
+    pred = RNG.random(n) > 0.3
+    vals = RNG.normal(size=n).astype(np.float32)
+    valid = RNG.random(n) > 0.1
+
+    interpret = jax.default_backend() != "tpu"
+    count, total = fused_group_sum(
+        jnp.asarray(codes), jnp.asarray(pred), jnp.asarray(vals),
+        jnp.asarray(valid), num_groups=g, interpret=interpret,
+    )
+    mask = pred & valid
+    for gi in range(g):
+        sel = mask & (codes == gi)
+        assert float(count[gi]) == sel.sum()
+        np.testing.assert_allclose(
+            float(total[gi]), vals[sel].sum(), rtol=1e-4, atol=1e-3
+        )
+
+
+def test_fused_group_sum_rejects_ragged():
+    with pytest.raises(AssertionError, match="multiple"):
+        fused_group_sum(
+            jnp.zeros(100, jnp.int32), jnp.ones(100, bool),
+            jnp.zeros(100, jnp.float32), jnp.ones(100, bool),
+            num_groups=4, interpret=True,
+        )
