@@ -121,6 +121,39 @@ class Measure:
 
 
 @dataclass(frozen=True)
+class Stream:
+    """database/v1 Stream schema: tagged append-only elements, no fields."""
+
+    group: str
+    name: str
+    tags: tuple[TagSpec, ...]
+    entity: tuple[str, ...]
+
+    def tag(self, name: str) -> TagSpec:
+        for t in self.tags:
+            if t.name == name:
+                return t
+        raise KeyError(f"tag {name} not in stream {self.name}")
+
+
+@dataclass(frozen=True)
+class Trace:
+    """database/v1 Trace schema: spans keyed by a trace-id tag."""
+
+    group: str
+    name: str
+    tags: tuple[TagSpec, ...]
+    trace_id_tag: str
+    timestamp_tag: str = ""
+
+    def tag(self, name: str) -> TagSpec:
+        for t in self.tags:
+            if t.name == name:
+                return t
+        raise KeyError(f"tag {name} not in trace {self.name}")
+
+
+@dataclass(frozen=True)
 class IndexRule:
     """database/v1 IndexRule: which tags get inverted/skipping/tree index."""
 
@@ -148,6 +181,8 @@ class TopNAggregation:
 _KINDS = {
     "group": Group,
     "measure": Measure,
+    "stream": Stream,
+    "trace": Trace,
     "index_rule": IndexRule,
     "topn": TopNAggregation,
 }
@@ -308,6 +343,26 @@ class SchemaRegistry:
 
     def delete_measure(self, group: str, name: str) -> None:
         self._delete("measure", f"{group}/{name}")
+
+    def create_stream(self, s: Stream) -> int:
+        self.get_group(s.group)
+        return self._put("stream", s)
+
+    def get_stream(self, group: str, name: str) -> Stream:
+        return self._get("stream", f"{group}/{name}")
+
+    def list_streams(self, group: str) -> list[Stream]:
+        return [s for s in self._store["stream"].values() if s.group == group]
+
+    def create_trace(self, t: Trace) -> int:
+        self.get_group(t.group)
+        return self._put("trace", t)
+
+    def get_trace(self, group: str, name: str) -> Trace:
+        return self._get("trace", f"{group}/{name}")
+
+    def list_traces(self, group: str) -> list[Trace]:
+        return [t for t in self._store["trace"].values() if t.group == group]
 
     def create_index_rule(self, r: IndexRule) -> int:
         return self._put("index_rule", r)

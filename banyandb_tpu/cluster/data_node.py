@@ -76,10 +76,25 @@ class DataNode:
 
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
-        res = self.stream.query(req, shard_ids=shard_ids)
+        try:
+            res = self.stream.query(req, shard_ids=shard_ids)
+        except KeyError:
+            # This node may simply never have learned the stream (schemas
+            # arrive with writes/SCHEMA_SYNC); a scatter must not fail the
+            # whole query because one node holds no data for it.
+            return {"data_points": []}
         return {
             "data_points": [
-                {**dp, "body": base64.b64encode(dp["body"]).decode()}
+                {
+                    **dp,
+                    "tags": {
+                        k: {"@bytes": base64.b64encode(v).decode()}
+                        if isinstance(v, bytes)
+                        else v
+                        for k, v in dp["tags"].items()
+                    },
+                    "body": base64.b64encode(dp["body"]).decode(),
+                }
                 for dp in res.data_points
             ]
         }
@@ -99,9 +114,14 @@ class DataNode:
         return {"written": n}
 
     def _on_trace_query(self, env: dict) -> dict:
-        spans = self.trace.query_by_trace_id(
-            env["group"], env["name"], env["trace_id"]
-        )
+        try:
+            spans = self.trace.query_by_trace_id(
+                env["group"], env["name"], env["trace_id"]
+            )
+        except KeyError:
+            # unknown-to-this-node trace name: an ordinary not-found lookup
+            # must return empty, not a shard-dependent error
+            return {"spans": []}
         return {"spans": serde.spans_to_json(spans)}
 
     # -- write plane --------------------------------------------------------

@@ -160,27 +160,15 @@ class Liaison:
         zero reachable replicas for a shard raises."""
         m = self.registry.get_measure(req.group, req.name)
         shard_num = self.registry.get_group(req.group).resource_opts.shard_num
-        by_node: dict[str, list] = {}
-        spool_points: dict[str, list] = {}
-        addr_of: dict[str, str] = {}
-        accepted = 0
-        for p in req.points:
+
+        def shard_of(p):
             entity = [req.name.encode()] + [
                 hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
             ]
-            shard = hashing.shard_id(hashing.series_id(entity), shard_num)
-            replicas = self.selector.replica_set(shard)
-            targets = [n for n in replicas if n.name in self.alive]
-            if not targets:
-                raise TransportError(f"no alive replica for shard {shard}")
-            for node in targets:
-                by_node.setdefault(node.name, []).append(p)
-                addr_of[node.name] = node.addr
-            if self.handoff is not None:
-                for node in replicas:
-                    if node.name not in self.alive:
-                        spool_points.setdefault(node.name, []).append(p)
-            accepted += 1
+            return hashing.shard_id(hashing.series_id(entity), shard_num)
+
+        by_node, spool_points, addr_of = self._route_items(req.points, shard_of)
+        accepted = len(req.points)
 
         def env_for(points):
             return {
@@ -376,7 +364,20 @@ class Liaison:
             rows.extend(r["data_points"])
         rows.sort(key=lambda d: d["timestamp"], reverse=(req.order_by_ts != "asc"))
         res = QueryResult()
-        res.data_points = rows[off : off + limit]
+        # decode back to the native engine contract (body/tags as bytes):
+        # cluster and standalone callers see identical shapes
+        import base64
+
+        for dp in rows[off : off + limit]:
+            dp = dict(dp)
+            dp["body"] = base64.b64decode(dp.get("body", ""))
+            dp["tags"] = {
+                k: base64.b64decode(v["@bytes"])
+                if isinstance(v, dict) and "@bytes" in v
+                else v
+                for k, v in dp["tags"].items()
+            }
+            res.data_points.append(dp)
         return res
 
     # -- trace plane (liaison trace svc analog) -----------------------------
@@ -419,7 +420,13 @@ class Liaison:
             Topic.TRACE_QUERY_BY_ID.value,
             {"group": group, "name": name, "trace_id": trace_id},
         )
-        return r["spans"]
+        import base64
+
+        # native engine contract: span payloads come back as bytes
+        return [
+            {**s, "span": base64.b64decode(s.get("span", ""))}
+            for s in r["spans"]
+        ]
 
 
 class ChunkedSyncClient:

@@ -194,7 +194,7 @@ def spans_to_json(spans: list[dict]) -> list[dict]:
 
 def stream_schema_from_json(item: dict):
     from banyandb_tpu.api import schema as schema_mod
-    from banyandb_tpu.models.stream import Stream
+    from banyandb_tpu.api.schema import Stream
 
     return Stream(
         group=item["group"],
@@ -209,7 +209,7 @@ def stream_schema_from_json(item: dict):
 
 def trace_schema_from_json(item: dict):
     from banyandb_tpu.api import schema as schema_mod
-    from banyandb_tpu.models.trace import Trace
+    from banyandb_tpu.api.schema import Trace
 
     return Trace(
         group=item["group"],

@@ -30,20 +30,9 @@ from banyandb_tpu.storage.tsdb import TSDB
 from banyandb_tpu.utils import hashing
 
 
-@dataclass(frozen=True)
-class Stream:
-    """database/v1 Stream schema analog."""
-
-    group: str
-    name: str
-    tags: tuple  # TagSpec tuple
-    entity: tuple  # entity tag names
-
-    def tag(self, name: str):
-        for t in self.tags:
-            if t.name == name:
-                return t
-        raise KeyError(f"tag {name} not in stream {self.name}")
+# Stream schema objects live in the registry (persisted + SCHEMA_SYNC'd
+# like measures); re-exported here for engine-local convenience.
+from banyandb_tpu.api.schema import Stream  # noqa: E402
 
 
 @dataclass(frozen=True)
@@ -64,19 +53,12 @@ class StreamEngine:
         self.root = Path(root) / "stream"
         self._tsdbs: dict[str, TSDB] = {}
         self._tsdb_lock = threading.Lock()
-        self._schemas: dict[tuple[str, str], Stream] = {}
 
-    # Streams aren't in the core SchemaRegistry kinds yet; keep a local
-    # registry surface with the same create/get verbs.
     def create_stream(self, s: Stream) -> None:
-        self.registry.get_group(s.group)
-        self._schemas[(s.group, s.name)] = s
+        self.registry.create_stream(s)
 
     def get_stream(self, group: str, name: str) -> Stream:
-        s = self._schemas.get((group, name))
-        if s is None:
-            raise KeyError(f"stream {group}/{name} not found")
-        return s
+        return self.registry.get_stream(group, name)
 
     def _tsdb(self, group: str) -> TSDB:
         with self._tsdb_lock:

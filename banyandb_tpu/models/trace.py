@@ -30,21 +30,9 @@ from banyandb_tpu.utils.bloom import Bloom
 BLOOM_FILE = "traceid.filter"
 
 
-@dataclass(frozen=True)
-class Trace:
-    """database/v1 Trace schema analog."""
-
-    group: str
-    name: str
-    tags: tuple  # TraceTagSpec analog (TagSpec tuple)
-    trace_id_tag: str
-    timestamp_tag: str = ""
-
-    def tag(self, name: str):
-        for t in self.tags:
-            if t.name == name:
-                return t
-        raise KeyError(f"tag {name} not in trace {self.name}")
+# Trace schema objects live in the registry (persisted + SCHEMA_SYNC'd
+# like measures); re-exported here for engine-local convenience.
+from banyandb_tpu.api.schema import Trace  # noqa: E402
 
 
 @dataclass(frozen=True)
@@ -70,7 +58,6 @@ class TraceEngine:
         self.root = Path(root) / "trace"
         self._tsdbs: dict[str, TSDB] = {}
         self._tsdb_lock = threading.Lock()
-        self._schemas: dict[tuple[str, str], Trace] = {}
         # ordered-index instances per (group, segment-start, rule-tag)
         self._sidx: dict[tuple, InvertedIndex] = {}
         # doc-id uniqueness across spans sharing (trace, ts): monotonic seq
@@ -79,14 +66,10 @@ class TraceEngine:
         self._doc_seq = 0
 
     def create_trace(self, t: Trace) -> None:
-        self.registry.get_group(t.group)
-        self._schemas[(t.group, t.name)] = t
+        self.registry.create_trace(t)
 
     def get_trace(self, group: str, name: str) -> Trace:
-        t = self._schemas.get((group, name))
-        if t is None:
-            raise KeyError(f"trace {group}/{name} not found")
-        return t
+        return self.registry.get_trace(group, name)
 
     def _tsdb(self, group: str) -> TSDB:
         with self._tsdb_lock:
@@ -186,8 +169,11 @@ class TraceEngine:
                     name = part.meta.get("trace")
                     if not name or (part.dir / BLOOM_FILE).exists():
                         continue
-                    t = self._schemas.get((group, name))
-                    if t is None or t.trace_id_tag not in part.meta["tags"]:
+                    try:
+                        t = self.registry.get_trace(group, name)
+                    except KeyError:
+                        continue
+                    if t.trace_id_tag not in part.meta["tags"]:
                         continue
                     ids = part.dict_for(t.trace_id_tag)
                     bloom = Bloom(max(len(ids), 1))

@@ -224,7 +224,10 @@ def test_distributed_stream_and_trace(tmp_path):
                                ordered_tags=("duration",)) == 30
     got = liaison.query_trace_by_id("sw", "traces", "t4")
     assert len(got) == 3
-    assert base64.b64decode(got[0]["span"]) == b"sp12"
+    assert got[0]["span"] == b"sp12"  # native bytes, same as standalone
+    # unknown trace id returns [] regardless of which shard it hashes to
+    for tid in ("zzz", "abc", "nope-1", "nope-2"):
+        assert liaison.query_trace_by_id("sw", "traces", tid) == []
 
     # failover: trace lookup survives losing one node (replicas=1)
     transport.unregister("d0")
