@@ -77,22 +77,20 @@ class DataNode:
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         try:
-            res = self.stream.query(req, shard_ids=shard_ids)
+            # Only the schema lookup is forgiving: this node may simply
+            # never have learned the stream (schemas arrive with writes /
+            # SCHEMA_SYNC) and must not fail the whole scatter.  Errors
+            # from the query itself (e.g. typo'd predicate tags) propagate
+            # exactly like standalone mode.
+            self.stream.get_stream(req.groups[0], req.name)
         except KeyError:
-            # This node may simply never have learned the stream (schemas
-            # arrive with writes/SCHEMA_SYNC); a scatter must not fail the
-            # whole query because one node holds no data for it.
             return {"data_points": []}
+        res = self.stream.query(req, shard_ids=shard_ids)
         return {
             "data_points": [
                 {
                     **dp,
-                    "tags": {
-                        k: {"@bytes": base64.b64encode(v).decode()}
-                        if isinstance(v, bytes)
-                        else v
-                        for k, v in dp["tags"].items()
-                    },
+                    "tags": serde.tags_to_json(dp["tags"]),
                     "body": base64.b64encode(dp["body"]).decode(),
                 }
                 for dp in res.data_points
@@ -115,13 +113,15 @@ class DataNode:
 
     def _on_trace_query(self, env: dict) -> dict:
         try:
-            spans = self.trace.query_by_trace_id(
-                env["group"], env["name"], env["trace_id"]
-            )
+            # forgiving only for the schema lookup: an ordinary not-found
+            # must not turn into a shard-dependent error; real query
+            # errors propagate like standalone mode
+            self.trace.get_trace(env["group"], env["name"])
         except KeyError:
-            # unknown-to-this-node trace name: an ordinary not-found lookup
-            # must return empty, not a shard-dependent error
             return {"spans": []}
+        spans = self.trace.query_by_trace_id(
+            env["group"], env["name"], env["trace_id"]
+        )
         return {"spans": serde.spans_to_json(spans)}
 
     # -- write plane --------------------------------------------------------

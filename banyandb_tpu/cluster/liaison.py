@@ -371,12 +371,7 @@ class Liaison:
         for dp in rows[off : off + limit]:
             dp = dict(dp)
             dp["body"] = base64.b64decode(dp.get("body", ""))
-            dp["tags"] = {
-                k: base64.b64decode(v["@bytes"])
-                if isinstance(v, dict) and "@bytes" in v
-                else v
-                for k, v in dp["tags"].items()
-            }
+            dp["tags"] = serde.tags_from_json(dp["tags"])
             res.data_points.append(dp)
         return res
 

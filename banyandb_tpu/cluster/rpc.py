@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import threading
 from concurrent import futures
+from pathlib import Path
 from typing import Optional
 
 from banyandb_tpu.cluster.bus import LocalBus
@@ -53,9 +54,21 @@ class LocalTransport:
 
 
 class GrpcBusServer:
-    """Serves a LocalBus over gRPC generic handlers (sub.NewServer analog)."""
+    """Serves a LocalBus over gRPC generic handlers (sub.NewServer analog).
 
-    def __init__(self, bus: LocalBus, port: int = 0, host: str = "127.0.0.1"):
+    TLS: pass cert_file+key_file for server TLS (pkg/tls analog; the
+    reference hot-reloads via fsnotify — restart-to-rotate here, reload
+    hook tracked for a later round)."""
+
+    def __init__(
+        self,
+        bus: LocalBus,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        *,
+        cert_file: Optional[str] = None,
+        key_file: Optional[str] = None,
+    ):
         import grpc
 
         self.bus = bus
@@ -84,7 +97,18 @@ class GrpcBusServer:
                      ("grpc.max_send_message_length", 64 * 1024 * 1024)],
         )
         self._server.add_generic_rpc_handlers((handler,))
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if cert_file and key_file:
+            creds = grpc.ssl_server_credentials(
+                [
+                    (
+                        Path(key_file).read_bytes(),
+                        Path(cert_file).read_bytes(),
+                    )
+                ]
+            )
+            self.port = self._server.add_secure_port(f"{host}:{port}", creds)
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.addr = f"{host}:{self.port}"
 
     def start(self) -> None:
@@ -95,11 +119,22 @@ class GrpcBusServer:
 
 
 class GrpcTransport:
-    """Client side: per-address channels (banyand/queue/pub analog)."""
+    """Client side: per-address channels (banyand/queue/pub analog).
 
-    def __init__(self):
+    TLS: pass ca_file (PEM of the server cert / CA) to dial with
+    credentials; optionally override the expected server name for
+    self-signed certs."""
+
+    def __init__(
+        self,
+        *,
+        ca_file: Optional[str] = None,
+        server_name_override: Optional[str] = None,
+    ):
         self._channels: dict[str, object] = {}
         self._lock = threading.Lock()
+        self._ca_file = ca_file
+        self._server_name_override = server_name_override
 
     def _stub(self, addr: str):
         import grpc
@@ -107,11 +142,27 @@ class GrpcTransport:
         with self._lock:
             ch = self._channels.get(addr)
             if ch is None:
-                ch = self._channels[addr] = grpc.insecure_channel(
-                    addr,
-                    options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
-                             ("grpc.max_send_message_length", 64 * 1024 * 1024)],
-                )
+                options = [
+                    ("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                    ("grpc.max_send_message_length", 64 * 1024 * 1024),
+                ]
+                if self._ca_file:
+                    from pathlib import Path as _P
+
+                    creds = grpc.ssl_channel_credentials(
+                        _P(self._ca_file).read_bytes()
+                    )
+                    if self._server_name_override:
+                        options.append(
+                            (
+                                "grpc.ssl_target_name_override",
+                                self._server_name_override,
+                            )
+                        )
+                    ch = grpc.secure_channel(addr, creds, options=options)
+                else:
+                    ch = grpc.insecure_channel(addr, options=options)
+                self._channels[addr] = ch
             return ch.unary_unary(
                 _METHOD,
                 request_serializer=lambda b: b,

@@ -192,6 +192,21 @@ def spans_to_json(spans: list[dict]) -> list[dict]:
     return [{**s, "span": _b64(s["span"])} for s in spans]
 
 
+def tags_to_json(tags: dict) -> dict:
+    """Decoded tag values -> wire form (bytes as {'@bytes': b64})."""
+    return {
+        k: {"@bytes": _b64(v)} if isinstance(v, bytes) else v
+        for k, v in tags.items()
+    }
+
+
+def tags_from_json(tags: dict) -> dict:
+    return {
+        k: _unb64(v["@bytes"]) if isinstance(v, dict) and "@bytes" in v else v
+        for k, v in tags.items()
+    }
+
+
 def stream_schema_from_json(item: dict):
     from banyandb_tpu.api import schema as schema_mod
     from banyandb_tpu.api.schema import Stream

@@ -57,17 +57,43 @@ class Shard:
         self._parts: dict[str, Part] = {}
         self._load_snapshot()
 
+    FAILED_PARTS_DIR = "failed-parts"
+    FAILED_PARTS_CAP = 16  # quarantined dirs kept (oldest evicted)
+
     def _load_snapshot(self) -> None:
         snp = self.root / SNAPSHOT
         listed: set[str] = set()
+        quarantined = []
         if snp.exists():
             data = fs.read_json(snp)
             self._epoch = data["epoch"]
             listed = set(data["parts"])
             for name in data["parts"]:
                 pdir = self.root / name
-                if pdir.exists():
+                if not pdir.exists():
+                    continue
+                try:
                     self._parts[name] = Part(pdir)
+                except Exception:  # noqa: BLE001 - one bad part must not
+                    # brick the shard: quarantine and keep serving
+                    # (storage/failed_parts_handler.go analog)
+                    quarantined.append(name)
+        if quarantined:
+            import shutil
+
+            fp = self.root / self.FAILED_PARTS_DIR
+            fp.mkdir(exist_ok=True)
+            for name in quarantined:
+                dest = fp / name
+                if dest.exists():
+                    shutil.rmtree(dest, ignore_errors=True)
+                (self.root / name).rename(dest)
+                listed.discard(name)
+            # size cap: evict oldest quarantined dirs
+            kept = sorted(fp.iterdir(), key=lambda p: p.name)
+            for old in kept[: max(0, len(kept) - self.FAILED_PARTS_CAP)]:
+                shutil.rmtree(old, ignore_errors=True)
+            self._publish()
         # GC orphans: part dirs written but never published (crash between
         # PartWriter.write and _publish), and dirs dropped by a merge whose
         # deletion didn't complete.  Without this, a crash mid-flush would

@@ -1,0 +1,135 @@
+"""Migration tool, failed-parts quarantine, gRPC TLS."""
+
+import shutil
+import subprocess
+
+import pytest
+
+from banyandb_tpu.admin import migration
+from banyandb_tpu.api import (
+    Aggregation,
+    Catalog,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.models.measure import MeasureEngine
+
+T0 = 1_700_000_000_000
+
+
+def _engine(root, n=200):
+    reg = SchemaRegistry(root)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(
+        Measure("g", "m", (TagSpec("svc", TagType.STRING),),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    )
+    eng = MeasureEngine(reg, root / "data")
+    eng.write(WriteRequest("g", "m", tuple(
+        DataPointValue(T0 + i, {"svc": f"s{i%3}"}, {"v": float(i)}, version=1)
+        for i in range(n)
+    )))
+    eng.flush()
+    return eng
+
+
+def test_migration_analyze_plan_copy_verify(tmp_path):
+    _engine(tmp_path / "src")
+    info = migration.analyze(tmp_path / "src")
+    assert info["parts"] and all("error" not in p for p in info["parts"])
+
+    # pretend parts are an older format so the plan rewrites them
+    plan = migration.plan(tmp_path / "src", target_version=2)
+    assert set(plan["rewrite"]) == {p["dir"] for p in info["parts"]}
+
+    out = migration.copy(tmp_path / "src", tmp_path / "dst", plan)
+    assert out["rewritten_parts"] == len(plan["rewrite"])
+
+    v = migration.verify(tmp_path / "src", tmp_path / "dst")
+    assert v["ok"], v
+
+    # migrated tree is a working server root
+    reg2 = SchemaRegistry(tmp_path / "dst")
+    eng2 = MeasureEngine(reg2, tmp_path / "dst" / "data")
+    r = eng2.query(QueryRequest(("g",), "m", TimeRange(T0, T0 + 1000),
+                                agg=Aggregation("sum", "v")))
+    assert r.values["sum(v)"][0] == sum(range(200))
+
+
+def test_migration_verify_detects_divergence(tmp_path):
+    _engine(tmp_path / "src")
+    plan = migration.plan(tmp_path / "src", target_version=2)
+    migration.copy(tmp_path / "src", tmp_path / "dst", plan)
+    # corrupt one target column file
+    victim = next((tmp_path / "dst" / "data").glob("*/*/seg-*/shard-*/part-*/field_v.bin"))
+    victim.write_bytes(b"garbage")
+    v = migration.verify(tmp_path / "src", tmp_path / "dst")
+    assert not v["ok"] and v["mismatches"]
+
+
+def test_failed_part_quarantined_not_bricking(tmp_path):
+    eng = _engine(tmp_path, n=50)
+    # second part so the shard still has data after quarantine
+    eng.write(WriteRequest("g", "m", (
+        DataPointValue(T0 + 500, {"svc": "s0"}, {"v": 1.0}, version=1),)))
+    eng.flush()
+    shard_dir = next((tmp_path / "data" / "measure" / "g").glob("seg-*/shard-0"))
+    parts = sorted(shard_dir.glob("part-*"))
+    assert len(parts) == 2
+    (parts[0] / "metadata.json").write_text("{corrupt")
+
+    reg2 = SchemaRegistry(tmp_path)
+    eng2 = MeasureEngine(reg2, tmp_path / "data")
+    r = eng2.query(QueryRequest(("g",), "m", TimeRange(T0, T0 + 1000),
+                                agg=Aggregation("count", "v")))
+    assert r.values["count"][0] == 1  # surviving part serves
+    assert (shard_dir / "failed-parts" / parts[0].name).exists()
+    # a later flush must not collide with the quarantined name
+    eng2.write(WriteRequest("g", "m", (
+        DataPointValue(T0 + 600, {"svc": "s1"}, {"v": 2.0}, version=1),)))
+    assert eng2.flush()
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None, reason="needs openssl")
+def test_grpc_tls_end_to_end(tmp_path):
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(tmp_path / "key.pem"),
+            "-out", str(tmp_path / "cert.pem"),
+            "-days", "1", "-subj", "/CN=localhost",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        check=True, capture_output=True,
+    )
+    from banyandb_tpu.cluster.bus import LocalBus, Topic
+    from banyandb_tpu.cluster.rpc import GrpcBusServer, GrpcTransport, TransportError
+
+    bus = LocalBus()
+    bus.subscribe(Topic.HEALTH, lambda env: {"status": "ok"})
+    srv = GrpcBusServer(
+        bus, cert_file=str(tmp_path / "cert.pem"), key_file=str(tmp_path / "key.pem")
+    )
+    srv.start()
+    try:
+        t = GrpcTransport(ca_file=str(tmp_path / "cert.pem"))
+        assert t.call(srv.addr, Topic.HEALTH.value, {}, timeout=10)["status"] == "ok"
+        t.close()
+        # plaintext client against TLS server must fail, not hang
+        t2 = GrpcTransport()
+        with pytest.raises(TransportError):
+            t2.call(srv.addr, Topic.HEALTH.value, {}, timeout=5)
+        t2.close()
+    finally:
+        srv.stop()
