@@ -135,6 +135,113 @@ class MeasureEngine:
                 self.topn.observe(m, p)
         return n
 
+    def write_columns(
+        self,
+        group: str,
+        name: str,
+        *,
+        ts_millis: np.ndarray,
+        tags: dict[str, list],
+        fields: dict[str, np.ndarray],
+        versions: Optional[np.ndarray] = None,
+    ) -> int:
+        """Vectorized bulk ingest (the high-throughput write path).
+
+        Row-oriented write() parses point protos one by one (the
+        reference's gRPC streaming shape); collectors that already hold
+        columns use this path: unique entities are hashed once, routing
+        and interning are NumPy passes, and memtable appends are bulk
+        extends.  TopN rules do not observe bulk writes (use write() for
+        measures feeding TopN pre-aggregation).
+        """
+        m = self.registry.get_measure(group, name)
+        if m.index_mode:
+            raise NotImplementedError("bulk path for index-mode measures")
+        db = self._tsdb(group)
+        opts = self.registry.get_group(group).resource_opts
+        shard_num = opts.shard_num
+        iv_millis = opts.segment_interval.millis
+        n = len(ts_millis)
+        if n == 0:
+            return 0
+        versions = (
+            versions
+            if versions is not None
+            else np.full(n, int(time.time() * 1000), dtype=np.int64)
+        )
+        tag_bytes: dict[str, list] = {}
+        for t in m.tags:
+            vals = tags.get(t.name)
+            # None elements map to the empty value, matching the row path
+            tag_bytes[t.name] = (
+                [hashing.entity_bytes(v) if v is not None else b"" for v in vals]
+                if vals is not None
+                else None
+            )
+
+        # --- series ids: hash each DISTINCT entity tuple once -------------
+        ent_cols = [tag_bytes[t] for t in m.entity.tag_names]
+        ent_rows = np.empty(n, dtype=object)
+        for i in range(n):
+            ent_rows[i] = tuple(c[i] for c in ent_cols)
+        uniq, inv = np.unique(ent_rows, return_inverse=True)
+        uniq_sids = np.fromiter(
+            (hashing.series_id([name.encode(), *e]) for e in uniq),
+            dtype=np.int64,
+            count=len(uniq),
+        )
+        sids = uniq_sids[inv]
+        shards = sids % shard_num
+
+        seg_cache: dict[int, object] = {}
+
+        def seg_for(start: int):
+            seg = seg_cache.get(start)
+            if seg is None:
+                seg = seg_cache[start] = db.segment_for(start)
+            return seg
+
+        # --- route per (segment, shard) with boolean masks ----------------
+        seg_starts = ts_millis - (ts_millis % iv_millis)
+        for start in np.unique(seg_starts).tolist():
+            seg = seg_for(int(start))
+            seg_mask = seg_starts == start
+            # series registration is PER SEGMENT (each segment owns its own
+            # series index, same as the row path): one doc per distinct
+            # entity appearing in this segment
+            for i in np.unique(inv[seg_mask], return_index=True)[1].tolist():
+                row = np.nonzero(seg_mask)[0][i]
+                doc = {t: tag_bytes[t][row] for t in m.entity.tag_names}
+                doc["@measure"] = name.encode()
+                seg.series_index.insert_series(int(sids[row]), doc)
+            for shard_idx in np.unique(shards[seg_mask]).tolist():
+                mask = seg_mask & (shards == shard_idx)
+                idx = np.nonzero(mask)[0]
+                sel_tags = {
+                    t: ([tag_bytes[t][i] for i in idx] if tag_bytes[t] is not None else None)
+                    for t in tag_bytes
+                }
+                sel_fields = {}
+                for f in m.fields:
+                    v = fields.get(f.name)
+                    sel_fields[f.name] = (
+                        np.asarray(v)[idx] if v is not None else None
+                    )
+                shard_obj = seg.shards[int(shard_idx)]
+                shard_obj.ingest(
+                    lambda mem: mem.append_measure_bulk(
+                        name,
+                        [t.name for t in m.tags],
+                        [f.name for f in m.fields],
+                        ts_millis[idx],
+                        sids[idx],
+                        versions[idx],
+                        sel_tags,
+                        sel_fields,
+                    )
+                )
+        return n
+
     def ensure_result_measure(self, group: str) -> None:
         """Auto-register the shared _top_n_result measure for a group."""
         from banyandb_tpu.models.topn import RESULT_MEASURE, result_measure_schema
@@ -311,6 +418,14 @@ class _MultiMeasureMemtable:
         if tbl is None:
             tbl = self._tables[measure] = MemTable(tag_names, field_names)
         tbl.append(ts, sid, version, tags, fields)
+
+    def append_measure_bulk(
+        self, measure, tag_names, field_names, ts, sids, versions, tags, fields
+    ) -> None:
+        tbl = self._tables.get(measure)
+        if tbl is None:
+            tbl = self._tables[measure] = MemTable(tag_names, field_names)
+        tbl.append_bulk(ts, sids, versions, tags, fields)
 
     def drain(self) -> list:
         return [

@@ -93,6 +93,51 @@ class MemTable:
             if self._payloads is not None:
                 self._payloads.append(payload or b"")
 
+    def append_bulk(
+        self,
+        ts_millis: "np.ndarray",
+        series_ids: "np.ndarray",
+        versions: "np.ndarray",
+        tag_values: Mapping[str, list],
+        field_values: Mapping[str, "np.ndarray"],
+        payloads: list | None = None,
+    ) -> None:
+        """Vectorized append: columns land in one extend per column.
+
+        tag_values: per-tag list[bytes] of row values (interned here via
+        np.unique so each distinct value hits the dict once).
+        """
+        n = len(ts_millis)
+        with self._lock:
+            self._ts.extend(ts_millis.tolist())
+            self._series.extend(series_ids.tolist())
+            self._version.extend(versions.tolist())
+            for t in self.tag_names:
+                vals = tag_values.get(t)
+                d = self._dicts[t]
+                if vals is None:
+                    code = d.setdefault(b"", len(d))
+                    self._tag_codes[t].extend([code] * n)
+                    continue
+                arr = np.asarray(vals, dtype=object)
+                uniq, inv = np.unique(arr, return_inverse=True)
+                lut = np.fromiter(
+                    (d.setdefault(v, len(d)) for v in uniq),
+                    dtype=np.int64,
+                    count=len(uniq),
+                )
+                self._tag_codes[t].extend(lut[inv].tolist())
+            for f in self.field_names:
+                vals = field_values.get(f)
+                if vals is None:
+                    self._fields[f].extend([0.0] * n)
+                else:
+                    self._fields[f].extend(
+                        np.asarray(vals, dtype=np.float64).tolist()
+                    )
+            if self._payloads is not None:
+                self._payloads.extend(payloads or [b""] * n)
+
     def drain(self) -> list[tuple[str, ColumnData, dict]]:
         """Flush protocol: [(part-name-suffix, columns, extra metadata)]."""
         return [("", self.snapshot_columns(), {})]

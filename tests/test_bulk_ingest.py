@@ -1,0 +1,135 @@
+"""Vectorized bulk ingest path: parity with per-point writes + throughput."""
+
+import time
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api import (
+    Aggregation,
+    Catalog,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    GroupBy,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.models.measure import MeasureEngine
+
+T0 = 1_700_000_000_000
+
+
+def _engine(tmp_path, sub):
+    reg = SchemaRegistry(tmp_path / sub)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+    reg.create_measure(
+        Measure("g", "m",
+                (TagSpec("svc", TagType.STRING), TagSpec("region", TagType.STRING)),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    )
+    return MeasureEngine(reg, tmp_path / sub / "data")
+
+
+def test_bulk_matches_rowwise(tmp_path):
+    n = 2000
+    rng = np.random.default_rng(3)
+    svc = [f"s{i}" for i in rng.integers(0, 20, n)]
+    region = [f"r{i}" for i in rng.integers(0, 3, n)]
+    vals = rng.gamma(2.0, 30.0, n)
+    ts = T0 + np.arange(n)
+
+    row_eng = _engine(tmp_path, "row")
+    row_eng.write(WriteRequest("g", "m", tuple(
+        DataPointValue(int(ts[i]), {"svc": svc[i], "region": region[i]},
+                       {"v": float(vals[i])}, version=1)
+        for i in range(n)
+    )))
+    bulk_eng = _engine(tmp_path, "bulk")
+    bulk_eng.write_columns(
+        "g", "m",
+        ts_millis=ts,
+        tags={"svc": svc, "region": region},
+        fields={"v": vals},
+        versions=np.ones(n, dtype=np.int64),
+    )
+    bulk_eng.flush()
+
+    req = QueryRequest(("g",), "m", TimeRange(T0, T0 + n),
+                       group_by=GroupBy(("svc", "region")),
+                       agg=Aggregation("sum", "v"), limit=1000)
+    ra, rb = row_eng.query(req), bulk_eng.query(req)
+    a = dict(zip(ra.groups, ra.values["sum(v)"]))
+    b = dict(zip(rb.groups, rb.values["sum(v)"]))
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k] == pytest.approx(b[k], rel=1e-6)
+
+    # series pruning works for bulk-registered series
+    from banyandb_tpu.api import Condition
+
+    r = bulk_eng.query(QueryRequest(("g",), "m", TimeRange(T0, T0 + n),
+                                    criteria=Condition("svc", "eq", "s7"),
+                                    agg=Aggregation("count", "v")))
+    assert r.values["count"][0] == svc.count("s7")
+
+
+def test_bulk_multi_segment_series_registration(tmp_path):
+    """An entity spanning two segments must be registered in BOTH segment
+    series indexes, or entity-filtered queries silently drop the later
+    segment's rows after flush."""
+    DAY = 86_400_000
+    eng = _engine(tmp_path, "seg")
+    ts = np.array([T0, T0 + 10, T0 + DAY, T0 + DAY + 10])
+    eng.write_columns(
+        "g", "m",
+        ts_millis=ts,
+        tags={"svc": ["a", "b", "a", "b"], "region": ["r", None, "r", "r"]},
+        fields={"v": np.array([1.0, 2.0, 3.0, 4.0])},
+        versions=np.ones(4, dtype=np.int64),
+    )
+    eng.flush()
+    from banyandb_tpu.api import Condition
+
+    r = eng.query(QueryRequest(("g",), "m", TimeRange(T0, T0 + 2 * DAY),
+                               criteria=Condition("svc", "eq", "a"),
+                               agg=Aggregation("sum", "v")))
+    assert r.values["sum(v)"][0] == 4.0  # both segments' rows
+    # None tag landed as the empty value (row-path parity)
+    r = eng.query(QueryRequest(("g",), "m", TimeRange(T0, T0 + 2 * DAY),
+                               criteria=Condition("region", "eq", ""),
+                               limit=10))
+    assert len(r.data_points) == 1
+
+
+def test_bulk_throughput_sanity(tmp_path):
+    """Bulk path must beat row-wise by a wide margin (and give a number)."""
+    n = 50_000
+    rng = np.random.default_rng(5)
+    svc = [f"s{i}" for i in rng.integers(0, 100, n)]
+    region = [f"r{i}" for i in rng.integers(0, 3, n)]
+    vals = rng.gamma(2.0, 30.0, n)
+    ts = T0 + np.arange(n)
+
+    eng = _engine(tmp_path, "tp")
+    t0 = time.perf_counter()
+    eng.write_columns("g", "m", ts_millis=ts,
+                      tags={"svc": svc, "region": region}, fields={"v": vals},
+                      versions=np.ones(n, dtype=np.int64))
+    bulk_s = time.perf_counter() - t0
+    rate = n / bulk_s
+    # CPU box: expect >= 200k points/s on the bulk path (the reference's
+    # whole-cluster baseline is ~9.5k/s)
+    assert rate > 100_000, f"bulk ingest too slow: {rate:.0f} pts/s"
+
+    r = eng.query(QueryRequest(("g",), "m", TimeRange(T0, T0 + n),
+                               agg=Aggregation("count", "v")))
+    assert r.values["count"][0] == n
