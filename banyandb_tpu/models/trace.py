@@ -64,6 +64,10 @@ class TraceEngine:
         # salted per engine instance so restarts don't re-mint old ids
         self._doc_salt = os.urandom(8)
         self._doc_seq = 0
+        # tail-sampling pipeline (post-trace-pipeline analog)
+        from banyandb_tpu.models.trace_pipeline import TracePipelineRegistry
+
+        self.pipeline = TracePipelineRegistry()
 
     def create_trace(self, t: Trace) -> None:
         self.registry.create_trace(t)
@@ -80,6 +84,8 @@ class TraceEngine:
                     self.root, group, g.resource_opts,
                     mem_factory=lambda: PayloadMemtable("trace"),
                 )
+                # sampler-chain gating at merge (trace/merger.go:318-342)
+                db.merge_filter = self.pipeline.merge_filter_for(group)
                 self._tsdbs[group] = db
             return db
 
@@ -183,6 +189,22 @@ class TraceEngine:
 
                     fs.atomic_write(part.dir / BLOOM_FILE, bloom.to_bytes())
 
+    def finalize_segments(self, group: str) -> int:
+        """Run the sampler chain over COMPLETE segments: every shard's
+        parts merge in one pass, so whole-trace keep decisions see every
+        span (PIPELINE_EVENT_FINALIZE, trace finalize_scanner analog).
+        Returns the number of shards compacted."""
+        db = self._tsdb(group)
+        n = 0
+        for seg in db.segments:
+            for shard in seg.shards:
+                parts = shard.parts
+                if len(parts) < 2:
+                    continue
+                if shard.merge(min_merge=len(parts), max_parts=len(parts)):
+                    n += 1
+        return n
+
     # -- queries -----------------------------------------------------------
     def query_by_trace_id(self, group: str, name: str, trace_id: str) -> list[dict]:
         """All spans of one trace (the trace span-store lookup)."""
@@ -234,9 +256,16 @@ class TraceEngine:
         hi: Optional[int] = None,
         asc: bool = False,
         limit: int = 20,
+        verify_live: bool = True,
     ) -> list[str]:
         """Trace ids ordered by an indexed numeric tag (sidx TYPE_TREE
-        retrieval: e.g. slowest traces in a window)."""
+        retrieval: e.g. slowest traces in a window).
+
+        verify_live drops ids whose spans were since removed by the
+        sampler pipeline (the ordered index is ingest-time and is not
+        rewritten by merge gating); cost is one span lookup per
+        candidate, bounded by `limit`.
+        """
         db = self._tsdb(group)
         seen: list[str] = []
         for seg in db.select_segments(time_range.begin_millis, time_range.end_millis):
@@ -250,8 +279,11 @@ class TraceEngine:
                 if not (time_range.begin_millis <= ts < time_range.end_millis):
                     continue
                 tid = d.keywords["@trace"].decode()
-                if tid not in seen:
-                    seen.append(tid)
+                if tid in seen:
+                    continue
+                if verify_live and not self.query_by_trace_id(group, name, tid):
+                    continue
+                seen.append(tid)
                 if len(seen) >= limit:
                     return seen
         return seen

@@ -47,11 +47,17 @@ def segment_start(ts_millis: int, interval_millis: int) -> int:
 class Shard:
     """One shard of one segment: a memtable + immutable parts + snapshot."""
 
-    def __init__(self, root: Path, mem_factory: Callable[[], MemTable]):
+    def __init__(
+        self,
+        root: Path,
+        mem_factory: Callable[[], MemTable],
+        merge_filter_provider: Optional[Callable] = None,
+    ):
         self.root = root
         self.root.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
         self._mem_factory = mem_factory
+        self._merge_filter_provider = merge_filter_provider
         self.mem = mem_factory()
         self._epoch = 0
         self._parts: dict[str, Part] = {}
@@ -161,7 +167,11 @@ class Shard:
             self._publish()
             return names
 
-    def merge(self) -> Optional[str]:
+    def merge(
+        self,
+        min_merge: Optional[int] = None,
+        max_parts: Optional[int] = None,
+    ) -> Optional[str]:
         """One merge round (merger.go:39 analog). Returns new part name.
 
         Column reads AND the merged-part encode/write happen outside the
@@ -177,10 +187,53 @@ class Shard:
 
         from banyandb_tpu.storage import merge as merge_mod
 
-        victims = merge_mod.pick_merge_victims(self.parts)
+        kwargs = {}
+        if min_merge is not None:
+            kwargs["min_merge"] = min_merge
+        if max_parts is not None:
+            kwargs["max_parts"] = max_parts
+        victims = merge_mod.pick_merge_victims(self.parts, **kwargs)
         if not victims:
             return None
         cols, extra_meta = merge_mod.merge_columns(victims)
+        # Sampler-chain gating at merge (trace/merger.go:318-342 analog):
+        # an engine-installed filter returns a keep-mask over merged rows.
+        if self._merge_filter_provider is not None:
+            fn = self._merge_filter_provider()
+            if fn is not None:
+                import numpy as _np
+
+                kind, name = merge_mod.resource_key(victims[0])
+                try:
+                    keep = fn(kind, name, cols)
+                    if keep is not None:
+                        keep = _np.asarray(keep, dtype=bool)
+                        if keep.shape != cols.ts.shape:
+                            raise ValueError(
+                                f"sampler mask {keep.shape} != rows {cols.ts.shape}"
+                            )
+                except Exception:  # noqa: BLE001 - a buggy plugin must
+                    # degrade to keep-all, never wedge the merge loop
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "merge filter failed; keeping all rows"
+                    )
+                    keep = None
+                if keep is not None:
+                    cols = ColumnData(
+                        ts=cols.ts[keep],
+                        series=cols.series[keep],
+                        version=cols.version[keep],
+                        tags={t: c[keep] for t, c in cols.tags.items()},
+                        fields={f: v[keep] for f, v in cols.fields.items()},
+                        dicts=cols.dicts,
+                        payloads=(
+                            [p for p, k in zip(cols.payloads, keep) if k]
+                            if cols.payloads is not None
+                            else None
+                        ),
+                    )
         tmp_dir = self.root / f".tmp-merge-{os.getpid()}-{id(cols):x}"
         PartWriter.write(
             tmp_dir,
@@ -220,12 +273,18 @@ class Segment:
         interval_millis: int,
         shard_num: int,
         mem_factory: Callable[[], MemTable],
+        merge_filter_provider: Optional[Callable] = None,
     ):
         self.root = root
         self.start = start_millis
         self.end = start_millis + interval_millis
         self.shards = [
-            Shard(root / f"shard-{i}", mem_factory) for i in range(shard_num)
+            Shard(
+                root / f"shard-{i}",
+                mem_factory,
+                merge_filter_provider=merge_filter_provider,
+            )
+            for i in range(shard_num)
         ]
         self._sidx = None
         self._sidx_lock = threading.Lock()
@@ -263,6 +322,11 @@ class TSDB:
         self.mem_factory = mem_factory
         self._lock = threading.Lock()
         self._segments: dict[int, Segment] = {}
+        # Optional merge-time row filter: fn(kind, name, ColumnData) ->
+        # keep-mask (bool array) or None.  The trace engine's sampler
+        # pipeline hook (PIPELINE_EVENT_MERGE analog) — engines set it;
+        # Shard.merge applies it after column combine.
+        self.merge_filter = None
         self._reopen()
 
     def _reopen(self) -> None:
@@ -278,7 +342,8 @@ class TSDB:
                 t = dt.datetime.strptime(stamp, "%Y%m%d")
             start = int(t.replace(tzinfo=dt.timezone.utc).timestamp() * 1000)
             self._segments[start] = Segment(
-                seg_dir, start, iv.millis, self.opts.shard_num, self.mem_factory
+                seg_dir, start, iv.millis, self.opts.shard_num,
+                self.mem_factory, lambda: self.merge_filter,
             )
 
     def segment_for(self, ts_millis: int, create: bool = True) -> Optional[Segment]:
@@ -293,6 +358,7 @@ class TSDB:
                     iv.millis,
                     self.opts.shard_num,
                     self.mem_factory,
+                    lambda: self.merge_filter,
                 )
                 self._segments[start] = seg
             return seg

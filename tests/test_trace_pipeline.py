@@ -1,0 +1,143 @@
+"""Post-trace pipeline: tail-sampling chains at merge."""
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api import (
+    Catalog,
+    Group,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+)
+from banyandb_tpu.models.trace import SpanValue, Trace, TraceEngine
+from banyandb_tpu.models.trace_pipeline import (
+    TraceBatch,
+    keep_slow_traces,
+    keep_tag_values,
+)
+
+T0 = 1_700_000_000_000
+
+
+def _engine(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.TRACE, ResourceOpts(shard_num=1)))
+    eng = TraceEngine(reg, tmp_path / "data")
+    eng.create_trace(
+        Trace(
+            group="g", name="t",
+            tags=(
+                TagSpec("trace_id", TagType.STRING),
+                TagSpec("status", TagType.STRING),
+                TagSpec("duration", TagType.INT),
+            ),
+            trace_id_tag="trace_id",
+        )
+    )
+    return eng
+
+
+def _spans(eng, n_traces=20, spans_per=4):
+    spans = []
+    for t in range(n_traces):
+        for s in range(spans_per):
+            spans.append(
+                SpanValue(
+                    ts_millis=T0 + t * 100 + s,
+                    tags={
+                        "trace_id": f"tr{t}",
+                        # trace 3 has an error span; traces >= 15 are slow
+                        "status": "error" if (t == 3 and s == 0) else "ok",
+                        "duration": 900 + s if t >= 15 else 10 + s,
+                    },
+                    span=f"{t}-{s}".encode(),
+                )
+            )
+    eng.write("g", "t", spans)
+    eng.flush()
+
+
+def _force_merges(eng):
+    """Compact down to ONE part so every row passed through merge gating
+    (the reference additionally gates at segment finalize; merge-to-one is
+    the test-deterministic equivalent)."""
+    shard = eng._tsdb("g").segments[0].shards[0]
+    while len(shard.parts) > 1 and shard.merge(min_merge=2, max_parts=2):
+        pass
+    return shard
+
+
+def test_sampler_drops_boring_spans_at_merge(tmp_path):
+    eng = _engine(tmp_path)
+    # keep error spans OR whole slow traces (chain stages are ANDed, so
+    # express the OR inside one sampler)
+    slow = keep_slow_traces("duration", 900)
+    errors = keep_tag_values("status", {b"error"})
+
+    def keep_interesting(batch: TraceBatch):
+        return slow(batch) | errors(batch)
+
+    eng.pipeline.register("g", "t", keep_interesting)
+
+    # ten flushes of the same workload -> multiple parts -> merge rounds
+    for _ in range(10):
+        _spans(eng)
+    shard = _force_merges(eng)
+    assert len(shard.parts) < 10
+
+    # slow traces survive whole
+    assert len(eng.query_by_trace_id("g", "t", "tr17")) > 0
+    # the error span of trace 3 survives
+    spans3 = eng.query_by_trace_id("g", "t", "tr3")
+    assert spans3 and all(s["tags"]["status"] == "error" for s in spans3)
+    # a boring fast trace is gone after merge gating
+    assert eng.query_by_trace_id("g", "t", "tr5") == []
+
+
+def test_finalize_sees_whole_segment(tmp_path):
+    """A slow span in a DIFFERENT part must still protect its trace when
+    gating runs at finalize (single whole-segment merge)."""
+    eng = _engine(tmp_path)
+    eng.pipeline.register("g", "t", keep_slow_traces("duration", 900))
+    # part 1: only the fast spans of trace trX
+    eng.write("g", "t", [
+        SpanValue(T0 + i, {"trace_id": "trX", "status": "ok", "duration": 5}, b"fast")
+        for i in range(3)
+    ], ordered_tags=("duration",))
+    eng.flush()
+    # part 2: the slow span of trX + a boring trace trY
+    eng.write("g", "t", [
+        SpanValue(T0 + 50, {"trace_id": "trX", "status": "ok", "duration": 950}, b"slow"),
+        SpanValue(T0 + 60, {"trace_id": "trY", "status": "ok", "duration": 3}, b"boring"),
+    ], ordered_tags=("duration",))
+    eng.flush()
+    assert eng.finalize_segments("g") == 1
+    assert len(eng.query_by_trace_id("g", "t", "trX")) == 4  # kept whole
+    assert eng.query_by_trace_id("g", "t", "trY") == []
+    # ordered index: dropped trY no longer surfaces in ordered queries
+    eng2_ids = eng.query_ordered("g", "t", "duration", TimeRange(T0, T0 + 100), asc=True)
+    assert "trY" not in eng2_ids and "trX" in eng2_ids
+
+
+def test_buggy_sampler_degrades_to_keep_all(tmp_path):
+    eng = _engine(tmp_path)
+    eng.pipeline.register("g", "t", lambda batch: np.ones(1, dtype=bool))  # wrong length
+    _spans(eng, n_traces=2)
+    _spans(eng, n_traces=2)
+    shard = _force_merges(eng)
+    assert len(shard.parts) == 1  # merge completed despite the bad mask
+    assert len(eng.query_by_trace_id("g", "t", "tr1")) == 8  # kept all
+
+
+def test_no_chain_means_no_filtering(tmp_path):
+    eng = _engine(tmp_path)
+    for _ in range(10):
+        _spans(eng, n_traces=3)
+    shard = _force_merges(eng)
+    # unsampled: every span survives merge (10 identical flushes of
+    # immutable appends -> 10 copies per span is the append contract)
+    spans = eng.query_by_trace_id("g", "t", "tr1")
+    assert len(spans) == 40
