@@ -3,8 +3,10 @@
 - StaticDiscovery: fixed node list (discovery "none").
 - FileDiscovery: watched JSON file of node records — the reference's
   file-based discovery AND its in-process cluster-test trick
-  (pkg/test/setup NewDiscoveryFileWriter).  DNS SRV polling can plug in
-  behind the same refresh() surface.
+  (pkg/test/setup NewDiscoveryFileWriter).
+- DnsDiscovery: address-record polling of a service hostname (the
+  headless-service shape of the reference's dns discovery; SRV-record
+  ports can plug in behind the same resolver seam).
 """
 
 from __future__ import annotations
@@ -25,6 +27,76 @@ class StaticDiscovery:
 
     def refresh(self) -> bool:
         return False
+
+
+class DnsDiscovery:
+    """DNS-polling discovery (banyand/metadata/discovery/dns analog).
+
+    Resolves a service hostname to its A/AAAA records each refresh();
+    node names derive from the resolved IPs, the port is fixed.  The
+    resolver is injectable (tests use a fake; production uses the
+    default socket resolver).
+    """
+
+    def __init__(
+        self,
+        hostname: str,
+        port: int,
+        *,
+        roles: tuple[str, ...] = ("data",),
+        resolver: Optional[Callable[[str], list[str]]] = None,
+        on_change: Optional[Callable] = None,
+    ):
+        self.hostname = hostname
+        self.port = port
+        self.roles = roles
+        self._resolver = resolver or _default_resolver
+        self.on_change = None
+        self._nodes: list[NodeInfo] = []
+        self.refresh()
+        self.on_change = on_change
+
+    def nodes(self) -> list[NodeInfo]:
+        return list(self._nodes)
+
+    def refresh(self) -> bool:
+        try:
+            ips = sorted(set(self._resolver(self.hostname)))
+        except OSError:
+            return False
+        if not ips:
+            # empty answer degrades exactly like a raising resolver: keep
+            # the last-known node set (a transiently endpoint-less service
+            # must not collapse the selector)
+            return False
+        new = [
+            NodeInfo(
+                f"{self.hostname}-{ip}", f"{_fmt_host(ip)}:{self.port}", self.roles
+            )
+            for ip in ips
+        ]
+        changed = new != self._nodes
+        self._nodes = new
+        if changed and self.on_change:
+            self.on_change(new)
+        return changed
+
+
+def _fmt_host(ip: str) -> str:
+    return f"[{ip}]" if ":" in ip else ip
+
+
+def _default_resolver(hostname: str) -> list[str]:
+    import socket
+
+    return sorted(
+        {
+            info[4][0]
+            for info in socket.getaddrinfo(
+                hostname, None, type=socket.SOCK_STREAM
+            )
+        }
+    )
 
 
 class FileDiscovery:

@@ -94,6 +94,34 @@ def test_file_discovery_refresh(tmp_path):
     assert changes == [2]
 
 
+def test_dns_discovery_with_fake_resolver():
+    from banyandb_tpu.cluster.discovery import DnsDiscovery
+
+    records = {"bydb-data.svc": ["10.0.0.2", "10.0.0.1"]}
+    changes = []
+    d = DnsDiscovery(
+        "bydb-data.svc", 17912,
+        resolver=lambda h: records[h],
+        on_change=lambda ns: changes.append([n.addr for n in ns]),
+    )
+    assert [n.addr for n in d.nodes()] == ["10.0.0.1:17912", "10.0.0.2:17912"]
+    assert not d.refresh()  # unchanged
+    records["bydb-data.svc"] = ["10.0.0.1", "10.0.0.3"]
+    assert d.refresh()
+    assert changes == [["10.0.0.1:17912", "10.0.0.3:17912"]]
+    # resolver failure AND empty answers both keep the last-known set
+    d2 = DnsDiscovery("bydb-data.svc", 1, resolver=lambda h: ["10.9.9.9"])
+    d2._resolver = lambda h: (_ for _ in ()).throw(OSError("nxdomain"))
+    assert not d2.refresh()
+    assert d2.nodes()
+    d2._resolver = lambda h: []
+    assert not d2.refresh()
+    assert d2.nodes()
+    # IPv6 addresses are bracketed for dialing
+    d3 = DnsDiscovery("v6.svc", 17912, resolver=lambda h: ["fd00::1"])
+    assert d3.nodes()[0].addr == "[fd00::1]:17912"
+
+
 def test_static_discovery():
     s = StaticDiscovery([NodeInfo("x", "local:x")])
     assert not s.refresh()
