@@ -1,0 +1,118 @@
+"""Node diagnostics collector (FODC agent analog, re-scoped to host
+telemetry per SURVEY.md §2 — the reference's eBPF kernel probes become
+/proc readings; on-demand pprof capture becomes a Python thread dump).
+
+collect() returns one self-contained snapshot: runtime parameters,
+process/memory stats, storage inventory, thread stacks, and the meter
+snapshot — served over the bus ("diagnostics" topic) and dumpable to a
+crash-artifact file (pkg/panicdiag analog).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+from typing import Optional
+
+
+def runtime_params() -> dict:
+    import jax
+
+    return {
+        "python": sys.version.split()[0],
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "pid": __import__("os").getpid(),
+    }
+
+
+def process_stats() -> dict:
+    out = {"uptime_s": time.monotonic()}
+    try:
+        with open("/proc/self/statm") as f:
+            pages = f.read().split()
+        out["rss_bytes"] = int(pages[1]) * 4096
+        out["vsz_bytes"] = int(pages[0]) * 4096
+    except OSError:
+        pass
+    try:
+        with open("/proc/self/io") as f:
+            for line in f:
+                k, _, v = line.partition(":")
+                if k in ("read_bytes", "write_bytes"):
+                    out[f"io_{k}"] = int(v)
+    except OSError:
+        pass
+    out["threads"] = threading.active_count()
+    return out
+
+
+def thread_dump() -> dict:
+    """Stacks of every live thread (pprof goroutine-dump analog)."""
+    frames = sys._current_frames()
+    out = {}
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        out[t.name] = (
+            traceback.format_stack(frame) if frame is not None else []
+        )
+    return out
+
+
+def storage_inventory(root: str | Path) -> dict:
+    from banyandb_tpu.admin.inspect import inspect_root
+
+    try:
+        info = inspect_root(root)
+    except OSError:
+        return {}
+    totals = {"parts": 0, "rows": 0, "bytes": 0}
+    for groups in info["engines"].values():
+        for segs in groups.values():
+            for shards in segs.values():
+                for shard in shards.values():
+                    for p in shard["parts"]:
+                        totals["parts"] += 1
+                        totals["rows"] += p.get("rows", 0)
+                        totals["bytes"] += p.get("bytes", 0)
+    return totals
+
+
+class DiagnosticsCollector:
+    """Bundles one node's full diagnostic snapshot (FODC agent collect)."""
+
+    def __init__(self, root: str | Path, meter=None):
+        self.root = Path(root)
+        self.meter = meter
+
+    def collect(self, *, include_threads: bool = False) -> dict:
+        snap = {
+            "ts_millis": int(time.time() * 1000),
+            "runtime": runtime_params(),
+            "process": process_stats(),
+            "storage": storage_inventory(self.root),
+        }
+        if self.meter is not None:
+            m = self.meter.snapshot()
+            snap["metrics"] = {
+                "counters": {str(k): v for k, v in m["counters"].items()},
+                "gauges": {str(k): v for k, v in m["gauges"].items()},
+            }
+        if include_threads:
+            snap["threads"] = thread_dump()
+        return snap
+
+    def write_crash_artifact(self, reason: str, dest: Optional[str | Path] = None) -> Path:
+        """Persist a full snapshot incl. stacks (pkg/panicdiag analog)."""
+        dest = Path(dest) if dest else self.root / "diagnostics"
+        dest.mkdir(parents=True, exist_ok=True)
+        snap = self.collect(include_threads=True)
+        snap["reason"] = reason
+        path = dest / f"crash-{snap['ts_millis']}.json"
+        path.write_text(json.dumps(snap, indent=1, default=str))
+        return path

@@ -35,6 +35,7 @@ TOPIC_REGISTRY = "registry"
 TOPIC_STREAM_QUERY = "stream-query-user"
 TOPIC_SNAPSHOT = "snapshot"
 TOPIC_METRICS = "metrics"
+TOPIC_DIAGNOSTICS = "diagnostics"
 
 # conservative per-point admission estimate for the memory protector
 _POINT_BYTES = 256
@@ -103,6 +104,7 @@ class StandaloneServer:
         b.subscribe(TOPIC_STREAM_QUERY, self._stream_query)
         b.subscribe(TOPIC_SNAPSHOT, self._snapshot)
         b.subscribe(TOPIC_METRICS, self._metrics)
+        b.subscribe(TOPIC_DIAGNOSTICS, self._diagnostics)
 
     # -- handlers -----------------------------------------------------------
     def _measure_write(self, env):
@@ -130,6 +132,14 @@ class StandaloneServer:
     def _metrics(self, env):
         self.meter.gauge_set("rss_bytes", _rss())
         return {"prometheus": self.meter.prometheus_text()}
+
+    def _diagnostics(self, env):
+        from banyandb_tpu.admin.diagnostics import DiagnosticsCollector
+
+        collector = DiagnosticsCollector(self.root, self.meter)
+        return collector.collect(
+            include_threads=bool(env.get("include_threads"))
+        )
 
     def _stream_write(self, env):
         n = self.stream.write(

@@ -1,0 +1,38 @@
+"""Diagnostics collector (FODC-agent-lite) + server topic."""
+
+import json
+
+from banyandb_tpu.admin.diagnostics import DiagnosticsCollector
+from banyandb_tpu.admin.metrics import Meter
+
+
+def test_collect_and_crash_artifact(tmp_path):
+    meter = Meter()
+    meter.counter_add("writes", 7)
+    c = DiagnosticsCollector(tmp_path, meter)
+    snap = c.collect()
+    assert snap["runtime"]["jax"]
+    assert snap["process"]["threads"] >= 1
+    assert "rss_bytes" in snap["process"]
+    assert "counters" in snap["metrics"]
+
+    path = c.write_crash_artifact("test-panic")
+    data = json.loads(path.read_text())
+    assert data["reason"] == "test-panic"
+    assert any("MainThread" in k for k in data["threads"])
+
+
+def test_server_diagnostics_topic(tmp_path):
+    from banyandb_tpu.cluster.rpc import GrpcTransport
+    from banyandb_tpu.server import TOPIC_DIAGNOSTICS, StandaloneServer
+
+    srv = StandaloneServer(tmp_path, port=0)
+    srv.start()
+    try:
+        t = GrpcTransport()
+        snap = t.call(srv.addr, TOPIC_DIAGNOSTICS, {"include_threads": True})
+        assert snap["runtime"]["backend"] == "cpu"
+        assert snap["threads"]
+        t.close()
+    finally:
+        srv.stop()
