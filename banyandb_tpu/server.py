@@ -36,6 +36,7 @@ TOPIC_STREAM_QUERY = "stream-query-user"
 TOPIC_SNAPSHOT = "snapshot"
 TOPIC_METRICS = "metrics"
 TOPIC_DIAGNOSTICS = "diagnostics"
+TOPIC_TOPN = "topn"
 
 # conservative per-point admission estimate for the memory protector
 _POINT_BYTES = 256
@@ -105,6 +106,7 @@ class StandaloneServer:
         b.subscribe(TOPIC_SNAPSHOT, self._snapshot)
         b.subscribe(TOPIC_METRICS, self._metrics)
         b.subscribe(TOPIC_DIAGNOSTICS, self._diagnostics)
+        b.subscribe(TOPIC_TOPN, self._topn)
 
     # -- handlers -----------------------------------------------------------
     def _measure_write(self, env):
@@ -132,6 +134,31 @@ class StandaloneServer:
     def _metrics(self, env):
         self.meter.gauge_set("rss_bytes", _rss())
         return {"prometheus": self.meter.prometheus_text()}
+
+    def _topn(self, env):
+        """TopN query over pre-aggregated windows (TopNService analog)."""
+        from banyandb_tpu.api.model import TimeRange
+        from banyandb_tpu.models import topn as topn_mod
+
+        rules = {r.name for r in self.registry.list_topn(env["group"])}
+        if env["name"] not in rules:
+            raise KeyError(
+                f"topn rule {env['name']} not found in group {env['group']}"
+            )
+        ranked = topn_mod.query_topn(
+            self.measure,
+            env["group"],
+            env["name"],
+            TimeRange(*env["time_range"]),
+            n=env.get("n", 10),
+            direction=env.get("direction", "desc"),
+            agg=env.get("agg", "sum"),
+        )
+        return {
+            "items": [
+                {"entity": list(ent), "value": val} for ent, val in ranked
+            ]
+        }
 
     def _diagnostics(self, env):
         from banyandb_tpu.admin.diagnostics import DiagnosticsCollector

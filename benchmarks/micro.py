@@ -1,0 +1,164 @@
+"""Micro-benchmarks (the in-tree `go test -bench` analog:
+bench_test.go / query_benchmark_test.go / merger_bench_test.go).
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python benchmarks/micro.py
+Prints one line per benchmark; add --json for machine-readable output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timeit(fn, warmup=1, iters=5):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_encoding():
+    from banyandb_tpu.utils import encoding as enc
+
+    n = 1_000_000
+    ts = np.arange(n, dtype=np.int64) * 1000 + 1_700_000_000_000
+    blob = enc.encode_int64(ts)
+    return {
+        "encode_int64_1M_regular": {
+            "s": timeit(lambda: enc.encode_int64(ts)),
+            "ratio": n * 8 / len(blob),
+        },
+        "decode_int64_1M": {"s": timeit(lambda: enc.decode_int64(blob, n))},
+    }
+
+
+def bench_group_reduce():
+    import jax
+    import jax.numpy as jnp
+
+    from banyandb_tpu import ops
+
+    n, g = 1 << 20, 1024
+    rng = np.random.default_rng(0)
+    key = jnp.asarray(rng.integers(0, g, n).astype(np.int32))
+    valid = jnp.asarray(np.ones(n, dtype=bool))
+    vals = {"v": jnp.asarray(rng.normal(size=n).astype(np.float32))}
+    out = {}
+    for method in ("scatter", "matmul_tiled"):
+        f = jax.jit(
+            lambda k, va, vl, m=method: ops.group_reduce(
+                k, va, vl, g, want_minmax=False, method=m
+            ).sums["v"]
+        )
+        jax.block_until_ready(f(key, valid, vals))
+        sec = timeit(lambda: jax.block_until_ready(f(key, valid, vals)))
+        out[f"group_reduce_{method}_1Mx1024"] = {
+            "s": sec,
+            "Mrows_per_s": n / sec / 1e6,
+        }
+    return out
+
+
+def bench_ingest():
+    import tempfile
+
+    from banyandb_tpu.api import (
+        Catalog, Entity, FieldSpec, FieldType, Group, Measure,
+        ResourceOpts, SchemaRegistry, TagSpec, TagType,
+    )
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    d = tempfile.mkdtemp()
+    reg = SchemaRegistry(d)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+    reg.create_measure(
+        Measure("g", "m", (TagSpec("svc", TagType.STRING),),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    )
+    eng = MeasureEngine(reg, d + "/data")
+    n = 100_000
+    rng = np.random.default_rng(1)
+    svc = [f"s{i}" for i in rng.integers(0, 100, n)]
+    vals = rng.gamma(2.0, 30.0, n)
+    ts = 1_700_000_000_000 + np.arange(n)
+    sec = timeit(
+        lambda: eng.write_columns(
+            "g", "m", ts_millis=ts, tags={"svc": svc}, fields={"v": vals},
+            versions=np.ones(n, dtype=np.int64),
+        ),
+        warmup=0,
+        iters=3,
+    )
+    fsec = timeit(lambda: eng.flush(), warmup=0, iters=1)
+    return {
+        "bulk_ingest_100k": {"s": sec, "kpts_per_s": n / sec / 1e3},
+        "flush_300k_rows": {"s": fsec},
+    }
+
+
+def bench_merge():
+    import tempfile
+
+    from banyandb_tpu.api import (
+        Catalog, Entity, FieldSpec, FieldType, Group,
+        Measure, ResourceOpts, SchemaRegistry, TagSpec, TagType,
+    )
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    d = tempfile.mkdtemp()
+    reg = SchemaRegistry(d)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(
+        Measure("g", "m", (TagSpec("svc", TagType.STRING),),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    )
+    eng = MeasureEngine(reg, d + "/data")
+    for b in range(8):
+        rng = np.random.default_rng(b)
+        n = 20_000
+        eng.write_columns(
+            "g", "m",
+            ts_millis=1_700_000_000_000 + np.arange(n) + b * n,
+            tags={"svc": [f"s{i}" for i in rng.integers(0, 50, n)]},
+            fields={"v": rng.normal(size=n)},
+            versions=np.ones(n, dtype=np.int64),
+        )
+        eng.flush()
+    shard = eng._tsdb("g").segments[0].shards[0]
+    t0 = time.perf_counter()
+    while shard.merge():
+        pass
+    sec = time.perf_counter() - t0
+    return {"merge_8x20k_parts": {"s": sec, "krows_per_s": 160 / sec}}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    results = {}
+    for name, fn in (
+        ("encoding", bench_encoding),
+        ("group_reduce", bench_group_reduce),
+        ("ingest", bench_ingest),
+        ("merge", bench_merge),
+    ):
+        results.update(fn())
+    if args.json:
+        print(json.dumps(results, indent=1))
+    else:
+        for k, v in results.items():
+            extras = " ".join(
+                f"{kk}={vv:.3f}" for kk, vv in v.items() if kk != "s"
+            )
+            print(f"{k:40s} {v['s'] * 1000:9.2f} ms  {extras}")
+
+
+if __name__ == "__main__":
+    main()

@@ -116,6 +116,49 @@ def test_server_cli_end_to_end(server):
     assert snap["flushed"]
 
 
+def test_server_topn_topic(server):
+    from banyandb_tpu.api import Entity, FieldSpec, FieldType, Measure, TagSpec, TagType, TopNAggregation
+    from banyandb_tpu.cluster.rpc import GrpcTransport
+
+    from banyandb_tpu.api import Catalog, Group, ResourceOpts
+
+    reg = server.registry
+    try:
+        reg.get_group("sw")
+    except KeyError:  # independent of test ordering
+        reg.create_group(Group("sw", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+    reg.create_measure(
+        Measure(group="sw", name="ep_cpm",
+                tags=(TagSpec("ep", TagType.STRING),),
+                fields=(FieldSpec("value", FieldType.INT),),
+                entity=Entity(("ep",)))
+    )
+    reg.create_topn(
+        TopNAggregation(group="sw", name="top_eps", source_measure="ep_cpm",
+                        field_name="value", group_by_tag_names=("ep",))
+    )
+    for w in range(3):
+        pts = [
+            {"ts": T0 + w * 60_000 + i, "tags": {"ep": f"e{i % 4}"},
+             "fields": {"value": (i % 4) * 10 + 1}, "version": 1}
+            for i in range(40)
+        ]
+        t = GrpcTransport()
+        t.call(server.addr, "measure-write", {
+            "request": {"group": "sw", "name": "ep_cpm", "points": pts}})
+        t.close()
+    server.measure.topn.flush_all_windows()
+    t = GrpcTransport()
+    r = t.call(server.addr, "topn", {
+        "group": "sw", "name": "top_eps",
+        "time_range": [T0, T0 + 10 * 60_000], "n": 2,
+    })
+    t.close()
+    assert len(r["items"]) == 2
+    assert r["items"][0]["entity"] == ["e3"]
+    assert r["items"][0]["value"] >= r["items"][1]["value"]
+
+
 def test_server_stream_and_trace_topics(server):
     import base64
 
