@@ -100,7 +100,9 @@ def _default_resolver(hostname: str) -> list[str]:
 
 
 class FileDiscovery:
-    """Watched JSON file: [{"name": ..., "addr": ..., "roles": [...]}].
+    """Watched JSON file:
+    [{"name": ..., "addr": ..., "roles": [...], "stages": [...]}]
+    ("stages" optional; empty/absent = the node serves every tier).
 
     refresh() re-reads when the mtime changed and returns True when the
     node set changed; callers (Liaison) rebuild their selector then.
@@ -122,7 +124,12 @@ class FileDiscovery:
         fs.atomic_write_json(
             path,
             [
-                {"name": n.name, "addr": n.addr, "roles": list(n.roles)}
+                {
+                    "name": n.name,
+                    "addr": n.addr,
+                    "roles": list(n.roles),
+                    "stages": list(n.stages),
+                }
                 for n in nodes
             ],
         )
@@ -142,7 +149,12 @@ class FileDiscovery:
         self._mtime = stamp
         data = json.loads(self.path.read_text())
         new = [
-            NodeInfo(d["name"], d["addr"], tuple(d.get("roles", ("data",))))
+            NodeInfo(
+                d["name"],
+                d["addr"],
+                tuple(d.get("roles", ("data",))),
+                tuple(d.get("stages", ())),
+            )
             for d in data
         ]
         changed = new != self._nodes

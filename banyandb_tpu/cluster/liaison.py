@@ -223,11 +223,37 @@ class Liaison:
             )
 
     # -- queries ------------------------------------------------------------
-    def _shard_assignment(self, group: str) -> dict[NodeInfo, list[int]]:
+    def _shard_assignment(
+        self, group: str, stages: tuple[str, ...] = ()
+    ) -> dict[NodeInfo, list[int]]:
+        """Per-shard primary-alive nodes, optionally restricted to nodes
+        serving the requested lifecycle stages (ResolveStage analog:
+        a query naming stages=('warm',) only consults warm-tier nodes)."""
         shard_num = self.registry.get_group(group).resource_opts.shard_num
+        eligible = self.alive
+        if stages:
+            eligible = {
+                n.name
+                for n in self.selector.nodes
+                if n.name in self.alive
+                and any(n.serves_stage(s) for s in stages)
+            }
+            if not eligible:
+                raise TransportError(
+                    f"no alive node serves stages {list(stages)}"
+                )
         assignment: dict[str, tuple[NodeInfo, list[int]]] = {}
         for shard in range(shard_num):
-            node = self.selector.primary(shard, self.alive)
+            try:
+                node = self.selector.primary(shard, eligible)
+            except RuntimeError as e:
+                # a shard whose whole replica set is outside the requested
+                # stage tier must fail with the stage named, not a
+                # confusing "no alive replica"
+                raise TransportError(
+                    f"shard {shard} has no alive replica serving stages "
+                    f"{list(stages) or ['*']}"
+                ) from e
             entry = assignment.setdefault(node.name, (node, []))
             entry[1].append(shard)
         return {node: shards for node, shards in assignment.values()}
@@ -254,7 +280,7 @@ class Liaison:
     def query_measure(self, req: QueryRequest) -> QueryResult:
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
-        assignment = self._shard_assignment(group)
+        assignment = self._shard_assignment(group, req.stages)
 
         if not (req.agg or req.group_by or req.top):
             # Raw scatter-gather.  Nodes scan ONLY their assigned shards
@@ -350,7 +376,7 @@ class Liaison:
         return len(elements)
 
     def query_stream(self, req: QueryRequest) -> QueryResult:
-        assignment = self._shard_assignment(req.groups[0])
+        assignment = self._shard_assignment(req.groups[0], req.stages)
         off = req.offset or 0
         limit = req.limit or 100
         node_req = dataclasses.replace(req, offset=0, limit=off + limit)

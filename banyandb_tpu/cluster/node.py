@@ -10,6 +10,12 @@ class NodeInfo:
     name: str
     addr: str  # transport address ("local:<name>" or "host:port")
     roles: tuple[str, ...] = ("data",)
+    # lifecycle stages this node serves (hot/warm/cold tier labels,
+    # banyand/queue/pub/stage.go ResolveStage analog); empty = all stages
+    stages: tuple[str, ...] = ()
+
+    def serves_stage(self, stage: str) -> bool:
+        return not self.stages or stage in self.stages
 
 
 class RoundRobinSelector:

@@ -171,6 +171,49 @@ def test_schema_barrier(tmp_path):
     assert not liaison.schema_barrier(acks, timeout_s=0.3)
 
 
+def test_stage_aware_query_routing(tmp_path):
+    """Queries naming lifecycle stages only consult nodes serving them
+    (tier parallelism, pub/stage.go ResolveStage analog)."""
+    transport = LocalTransport()
+    nodes, dns = [], []
+    for i, stages in enumerate((("hot",), ("warm",))):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        _schema(reg, shard_num=2, replicas=1)
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus),
+                              stages=stages))
+        dns.append(dn)
+    lreg = SchemaRegistry(tmp_path / "l")
+    _schema(lreg, shard_num=2, replicas=1)
+    liaison = Liaison(lreg, transport, nodes, replicas=1)
+    pts = tuple(
+        DataPointValue(T0 + i, {"svc": f"s{i}"}, {"v": 1.0}, version=1)
+        for i in range(40)
+    )
+    liaison.write_measure(WriteRequest("sw", "cpm", pts))  # replicated to both
+
+    import dataclasses as dc
+
+    base = QueryRequest(("sw",), "cpm", TimeRange(T0, T0 + 100),
+                        agg=Aggregation("count", "v"))
+    # unstaged: any alive primary
+    assert liaison.query_measure(base).values["count"][0] == 40
+    # staged: only the hot node is eligible, results still complete
+    assert liaison.query_measure(
+        dc.replace(base, stages=("hot",))
+    ).values["count"][0] == 40
+    # a stage nobody serves errors clearly
+    from banyandb_tpu.cluster.rpc import TransportError
+
+    with pytest.raises(TransportError, match="serves stages"):
+        liaison.query_measure(dc.replace(base, stages=("cold",)))
+    # replicas=0 tier gap: a shard whose only owner is outside the stage
+    # tier fails with the stage named (not "no alive replica")
+    l2 = Liaison(lreg, transport, nodes, replicas=0)
+    with pytest.raises(TransportError, match="serving stages \\['hot'\\]"):
+        l2.query_measure(dc.replace(base, stages=("hot",)))
+
+
 def test_distributed_stream_and_trace(tmp_path):
     import base64
 
