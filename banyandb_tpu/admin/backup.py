@@ -52,6 +52,89 @@ class LocalDirFS:
         )
 
 
+class S3FS:
+    """S3 RemoteFS driver (pkg/fs/remote/aws analog). Gated import: boto3
+    is not in the base image; deployments that have it get the driver."""
+
+    def __init__(self, bucket: str, prefix: str = "", client=None):
+        if client is None:
+            try:
+                import boto3  # noqa: PLC0415 - gated optional dependency
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "S3FS needs boto3 (not in the base image)"
+                ) from e
+            client = boto3.client("s3")
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        self.client = client
+
+    def _key(self, rel: str) -> str:
+        return f"{self.prefix}/{rel}" if self.prefix else rel
+
+    def put(self, rel: str, local: Path) -> None:
+        self.client.upload_file(str(local), self.bucket, self._key(rel))
+
+    def get(self, rel: str, local: Path) -> None:
+        local.parent.mkdir(parents=True, exist_ok=True)
+        self.client.download_file(self.bucket, self._key(rel), str(local))
+
+    def list(self, prefix: str) -> list[str]:
+        # Directory semantics (match LocalDirFS): a non-empty prefix only
+        # matches keys *under* it, never string-prefix siblings like
+        # "<prefix>-archive/...".
+        full = self._key(prefix).strip("/")
+        probe = full + "/" if full else ""
+        out = []
+        paginator = self.client.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=probe):
+            for obj in page.get("Contents", []):
+                key = obj["Key"]
+                if self.prefix:
+                    key = key[len(self.prefix) + 1 :]
+                out.append(key)
+        return sorted(out)
+
+
+class GcsFS:
+    """GCS RemoteFS driver (pkg/fs/remote/gcp analog). Gated import."""
+
+    def __init__(self, bucket: str, prefix: str = "", client=None):
+        if client is None:
+            try:
+                from google.cloud import storage  # noqa: PLC0415
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "GcsFS needs google-cloud-storage (not in the base image)"
+                ) from e
+            client = storage.Client()
+        if not hasattr(client, "bucket"):
+            raise TypeError("GcsFS client must expose .bucket(name)")
+        self.bucket = client.bucket(bucket)
+        self.prefix = prefix.strip("/")
+
+    def _key(self, rel: str) -> str:
+        return f"{self.prefix}/{rel}" if self.prefix else rel
+
+    def put(self, rel: str, local: Path) -> None:
+        self.bucket.blob(self._key(rel)).upload_from_filename(str(local))
+
+    def get(self, rel: str, local: Path) -> None:
+        local.parent.mkdir(parents=True, exist_ok=True)
+        self.bucket.blob(self._key(rel)).download_to_filename(str(local))
+
+    def list(self, prefix: str) -> list[str]:
+        full = self._key(prefix).strip("/")
+        probe = full + "/" if full else ""
+        out = []
+        for blob in self.bucket.list_blobs(prefix=probe):
+            key = blob.name
+            if self.prefix:
+                key = key[len(self.prefix) + 1 :]
+            out.append(key)
+        return sorted(out)
+
+
 def _walk_files(root: Path):
     for p in sorted(root.rglob("*")):
         if p.is_file() and not p.name.startswith(".tmp"):

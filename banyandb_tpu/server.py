@@ -15,6 +15,7 @@ import argparse
 import base64
 import signal
 import threading
+import time
 from pathlib import Path
 
 from banyandb_tpu import bydbql
@@ -23,6 +24,9 @@ from banyandb_tpu.api.model import QueryRequest, QueryResult
 from banyandb_tpu.api.schema import SchemaRegistry
 from banyandb_tpu.cluster import serde
 from banyandb_tpu.cluster.bus import LocalBus, Topic
+from banyandb_tpu.admin.accesslog import AccessLog
+from banyandb_tpu.admin.metrics import Meter, SelfMeasureSink
+from banyandb_tpu.admin.protector import MemoryProtector
 from banyandb_tpu.cluster.rpc import GrpcBusServer
 from banyandb_tpu.models.measure import MeasureEngine
 from banyandb_tpu.models.property import Property, PropertyEngine
@@ -73,9 +77,6 @@ def result_to_json(res: QueryResult) -> dict:
 
 class StandaloneServer:
     def __init__(self, root: str | Path, port: int = 17912):
-        from banyandb_tpu.admin.metrics import Meter, SelfMeasureSink
-        from banyandb_tpu.admin.protector import MemoryProtector
-
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
         self.measure = MeasureEngine(self.registry, self.root / "data")
@@ -85,6 +86,7 @@ class StandaloneServer:
         self.meter = Meter("banyandb")
         self.self_metrics = SelfMeasureSink(self.meter, self.measure)
         self.protector = MemoryProtector()
+        self.access_log = AccessLog(self.root / "logs" / "access.log")
         self.bus = LocalBus()
         self._register()
         self.grpc = GrpcBusServer(self.bus, port=port)
@@ -115,20 +117,27 @@ class StandaloneServer:
         # write-side admission control (protector.AcquireResource analog):
         # shed load with ServerBusy instead of OOMing under pressure
         self.protector.acquire(size)
+        t0 = time.perf_counter()
         try:
             n = self.measure.write(req)
         finally:
             self.protector.release(size)
         self.meter.counter_add("measure_write_points", n)
+        self.access_log.log_write(
+            req.group, req.name, n, (time.perf_counter() - t0) * 1000
+        )
         return {"written": n}
 
     def _measure_query(self, env):
-        import time as _time
-
         req = serde.query_request_from_json(env["request"])
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         res = self.measure.query(req)
-        self.meter.observe("measure_query_ms", (_time.perf_counter() - t0) * 1000)
+        ms = (time.perf_counter() - t0) * 1000
+        self.meter.observe("measure_query_ms", ms)
+        self.access_log.log_query(
+            req.groups[0], req.name, ms,
+            rows=len(res.data_points) or len(res.groups),
+        )
         return {"result": result_to_json(res)}
 
     def _metrics(self, env):
@@ -214,10 +223,17 @@ class StandaloneServer:
 
     def _ql(self, env):
         catalog, req = bydbql.parse_with_catalog(env["ql"])
+        t0 = time.perf_counter()
         if catalog == "stream":
             res = self.stream.query(req)
         else:
             res = self.measure.query(req)
+        self.access_log.log_query(
+            req.groups[0], req.name,
+            (time.perf_counter() - t0) * 1000,
+            ql=env["ql"],
+            rows=len(res.data_points) or len(res.groups),
+        )
         return {"result": result_to_json(res)}
 
     def _registry_op(self, env):
@@ -290,6 +306,7 @@ class StandaloneServer:
     def stop(self) -> None:
         self.measure.stop_lifecycle()
         self.grpc.stop()
+        self.access_log.close()
 
     @property
     def addr(self) -> str:
