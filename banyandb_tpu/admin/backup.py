@@ -52,7 +52,33 @@ class LocalDirFS:
         )
 
 
-class S3FS:
+class _PrefixedCloudFS:
+    """Shared key/prefix handling for bucket-store drivers.
+
+    Directory semantics (match LocalDirFS): a non-empty list() prefix
+    only matches keys *under* it, never string-prefix siblings like
+    "<prefix>-archive/...".
+    """
+
+    prefix: str
+
+    def _key(self, rel: str) -> str:
+        return f"{self.prefix}/{rel}" if self.prefix else rel
+
+    def _probe(self, prefix: str) -> str:
+        full = self._key(prefix).strip("/")
+        return full + "/" if full else ""
+
+    def _strip(self, key: str) -> str:
+        return key[len(self.prefix) + 1 :] if self.prefix else key
+
+    def list(self, prefix: str) -> list[str]:
+        return sorted(
+            self._strip(k) for k in self._iter_keys(self._probe(prefix))
+        )
+
+
+class S3FS(_PrefixedCloudFS):
     """S3 RemoteFS driver (pkg/fs/remote/aws analog). Gated import: boto3
     is not in the base image; deployments that have it get the driver."""
 
@@ -69,9 +95,6 @@ class S3FS:
         self.prefix = prefix.strip("/")
         self.client = client
 
-    def _key(self, rel: str) -> str:
-        return f"{self.prefix}/{rel}" if self.prefix else rel
-
     def put(self, rel: str, local: Path) -> None:
         self.client.upload_file(str(local), self.bucket, self._key(rel))
 
@@ -79,24 +102,14 @@ class S3FS:
         local.parent.mkdir(parents=True, exist_ok=True)
         self.client.download_file(self.bucket, self._key(rel), str(local))
 
-    def list(self, prefix: str) -> list[str]:
-        # Directory semantics (match LocalDirFS): a non-empty prefix only
-        # matches keys *under* it, never string-prefix siblings like
-        # "<prefix>-archive/...".
-        full = self._key(prefix).strip("/")
-        probe = full + "/" if full else ""
-        out = []
+    def _iter_keys(self, probe: str):
         paginator = self.client.get_paginator("list_objects_v2")
         for page in paginator.paginate(Bucket=self.bucket, Prefix=probe):
             for obj in page.get("Contents", []):
-                key = obj["Key"]
-                if self.prefix:
-                    key = key[len(self.prefix) + 1 :]
-                out.append(key)
-        return sorted(out)
+                yield obj["Key"]
 
 
-class GcsFS:
+class GcsFS(_PrefixedCloudFS):
     """GCS RemoteFS driver (pkg/fs/remote/gcp analog). Gated import."""
 
     def __init__(self, bucket: str, prefix: str = "", client=None):
@@ -113,9 +126,6 @@ class GcsFS:
         self.bucket = client.bucket(bucket)
         self.prefix = prefix.strip("/")
 
-    def _key(self, rel: str) -> str:
-        return f"{self.prefix}/{rel}" if self.prefix else rel
-
     def put(self, rel: str, local: Path) -> None:
         self.bucket.blob(self._key(rel)).upload_from_filename(str(local))
 
@@ -123,16 +133,9 @@ class GcsFS:
         local.parent.mkdir(parents=True, exist_ok=True)
         self.bucket.blob(self._key(rel)).download_to_filename(str(local))
 
-    def list(self, prefix: str) -> list[str]:
-        full = self._key(prefix).strip("/")
-        probe = full + "/" if full else ""
-        out = []
+    def _iter_keys(self, probe: str):
         for blob in self.bucket.list_blobs(prefix=probe):
-            key = blob.name
-            if self.prefix:
-                key = key[len(self.prefix) + 1 :]
-            out.append(key)
-        return sorted(out)
+            yield blob.name
 
 
 def _walk_files(root: Path):

@@ -67,11 +67,19 @@ class PropertyEngine:
         return hashing.series_id([name.encode(), pid.encode()])
 
     # -- apply/get/delete (liaison/grpc/property.go surface) ---------------
-    def apply(self, p: Property, strategy: str = "merge") -> Property:
+    def apply(
+        self,
+        p: Property,
+        strategy: str = "merge",
+        ttl_seconds: Optional[float] = None,
+    ) -> Property:
         """Create or update; returns the stored property with revisions.
 
         strategy="merge" merges tags into an existing doc (the reference's
         default apply strategy); "replace" overwrites the tag set.
+        ttl_seconds sets a lease: the property stops resolving at expiry
+        and is physically removed by sweep_expired (the reference's
+        property-expire-delete-timeout GC).
         """
         idx = self._shard_for(p.group, p.name, p.id)
         with self._lock:
@@ -95,12 +103,15 @@ class PropertyEngine:
         keywords = {"@name": p.name.encode(), "@id": p.id.encode()}
         for k, v in tags.items():
             keywords[k] = str(v).encode()
+        numerics = {"@mod": rev, "@create": create_rev}
+        if ttl_seconds is not None:
+            numerics["@expire"] = int((time.time() + ttl_seconds) * 1000)
         idx.insert(
             [
                 Doc(
                     doc_id=doc_id,
                     keywords=keywords,
-                    numerics={"@mod": rev, "@create": create_rev},
+                    numerics=numerics,
                     payload=json.dumps(
                         {"id": p.id, "name": p.name, "tags": tags}
                     ).encode(),
@@ -109,10 +120,32 @@ class PropertyEngine:
         )
         return stored
 
+    @staticmethod
+    def _expired(doc, now_millis: Optional[int] = None) -> bool:
+        exp = doc.numerics.get("@expire")
+        if exp is None:
+            return False
+        now = now_millis if now_millis is not None else int(time.time() * 1000)
+        return exp <= now
+
+    def sweep_expired(self, group: str, now_millis: Optional[int] = None) -> int:
+        """Physically remove expired docs (merge-time GC analog)."""
+        removed = 0
+        for idx in self._all_shards(group):
+            dead = [
+                doc_id
+                for doc_id in idx.search(None).tolist()
+                if self._expired(idx.get(doc_id), now_millis)
+            ]
+            if dead:
+                idx.delete(dead)
+                removed += len(dead)
+        return removed
+
     def get(self, group: str, name: str, pid: str) -> Optional[Property]:
         idx = self._shard_for(group, name, pid)
         doc = idx.get(self._doc_id(name, pid))
-        if doc is None:
+        if doc is None or self._expired(doc):
             return None
         src = json.loads(doc.payload)
         return Property(
@@ -148,6 +181,8 @@ class PropertyEngine:
         for idx in self._all_shards(group):
             for doc_id in idx.search(q).tolist():
                 doc = idx.get(doc_id)
+                if self._expired(doc):
+                    continue
                 src = json.loads(doc.payload)
                 if idset is not None and src["id"] not in idset:
                     continue

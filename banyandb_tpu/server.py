@@ -207,6 +207,7 @@ class StandaloneServer:
                 tags=env.get("tags", {}),
             ),
             strategy=env.get("strategy", "merge"),
+            ttl_seconds=env.get("ttl_seconds"),
         )
         return {"mod_revision": p.mod_revision, "create_revision": p.create_revision}
 
@@ -300,8 +301,16 @@ class StandaloneServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
-        self.measure.start_lifecycle()
+        # one lifecycle daemon drives storage loops AND property-lease GC
+        self.measure.start_lifecycle(extra_tick=self._sweep_properties)
         self.grpc.start()
+
+    def _sweep_properties(self) -> None:
+        for g in self.registry.list_groups():
+            try:
+                self.property.sweep_expired(g.name)
+            except Exception:  # noqa: BLE001 - GC must not kill the loop
+                pass
 
     def stop(self) -> None:
         self.measure.stop_lifecycle()

@@ -30,12 +30,14 @@ class LifecycleLoops:
         flush_min_rows: int = 1,
         retention_interval_s: float = 60.0,
         clock: Callable[[], float] = time.time,
+        extra_tick: Optional[Callable[[], None]] = None,
     ):
         self._tsdbs = tsdbs
         self.flush_interval_s = flush_interval_s
         self.flush_min_rows = flush_min_rows
         self.retention_interval_s = retention_interval_s
         self._clock = clock
+        self._extra_tick = extra_tick
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_retention = 0.0
@@ -79,6 +81,8 @@ class LifecycleLoops:
                 )
         if now - self._last_retention >= self.retention_interval_s:
             self._last_retention = now
+        if self._extra_tick is not None:
+            self._extra_tick()
         return stats
 
     def _run(self) -> None:

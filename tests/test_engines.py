@@ -246,6 +246,23 @@ def test_property_crud_and_revisions(registry, tmp_path):
     assert eng.get("g", "ui_template", "id-1") is None
 
 
+def test_property_ttl_lease_and_sweep(registry, tmp_path):
+    import time as _time
+
+    eng = PropertyEngine(registry, tmp_path / "data")
+    eng.apply(Property("g", "lease", "ephemeral", {"k": "v"}), ttl_seconds=0.05)
+    eng.apply(Property("g", "lease", "durable", {"k": "v"}))
+    assert eng.get("g", "lease", "ephemeral") is not None
+    _time.sleep(0.08)
+    # expired docs stop resolving...
+    assert eng.get("g", "lease", "ephemeral") is None
+    assert [p.id for p in eng.query("g", "lease")] == ["durable"]
+    # ...and sweep physically removes them (merge-time GC analog)
+    assert eng.sweep_expired("g") == 1
+    assert eng.sweep_expired("g") == 0
+    assert eng.get("g", "lease", "durable") is not None
+
+
 def test_property_query_and_persistence(registry, tmp_path):
     eng = PropertyEngine(registry, tmp_path / "data")
     for i in range(20):
