@@ -1,0 +1,41 @@
+"""Shared environment construction for the repo-root driver scripts.
+
+One place encodes the container gotcha: every python process loads the
+axon sitecustomize via PYTHONPATH, which grabs the (flaky, single-chip)
+TPU tunnel at interpreter start.  Child processes that must run on CPU
+get a scrubbed environment from here; bench.py and __graft_entry__.py
+both use it (tests/conftest.py covers the in-process pytest case with
+setdefault semantics instead).
+
+No jax imports allowed in this module — it runs before backend choice.
+"""
+
+from __future__ import annotations
+
+import os
+
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def scrubbed_cpu_env(
+    n_devices: int | None = None, base: dict | None = None
+) -> dict:
+    """Environment for a CPU child: no axon sitecustomize, repo importable,
+    optionally an n-device forced host platform."""
+    env = dict(base if base is not None else os.environ)
+    parts = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p and p != REPO_DIR
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([REPO_DIR] + parts)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env

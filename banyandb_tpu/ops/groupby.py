@@ -12,9 +12,22 @@ of dictionary sizes.  Aggregation is then a dense segment reduction:
 - ``matmul_tiled``: lax.scan over row tiles of MXU one-hot contractions —
   the TPU path for large N where one-shot matmul won't fit and scatter
   underuses the hardware.
+- ``pallas``: the hand-tiled Pallas kernel (ops.pallas_kernels) for
+  count/sums; min/max still ride XLA scatter.
 
 All produce identical results; ``method="auto"`` picks per shape and
 backend (TPU prefers the MXU paths).
+
+Precision contract (tested by tests/test_precision.py): per-group sums
+accumulate in f32 *within* a bounded row tile (<= 65536 rows for scatter,
+8192 for matmul_tiled, 2048 for pallas); tile partials combine across
+tiles with Kahan-compensated f32, so the cross-tile error is O(eps)
+independent of total row count. The one-shot ``matmul`` path is only
+selected for operands <= 2^25 elements (<= ~32k rows at G=1024), where a
+single f32 MXU contraction stays within ~K*eps/2 of exact. Callers
+merging partials across kernel invocations (measure_exec, the cluster
+combine plane) accumulate in f64 on the host. Counts are integer-valued
+and exact to 2^24 per tile — far above any tile bound here.
 """
 
 from __future__ import annotations
@@ -68,6 +81,58 @@ class GroupReduceResult:
         return self.count > 0
 
 
+def _kahan_add(s: jax.Array, c: jax.Array, x: jax.Array):
+    """One compensated accumulation step; true sum ~= s - c."""
+    y = x - c
+    t = s + y
+    return t, (t - s) - y
+
+
+def _kahan_tiled_reduce(
+    safe_key: jax.Array,
+    validf: jax.Array,
+    masked_fields: Mapping[str, jax.Array],
+    num_groups: int,
+    tile: int,
+    partial_fn,
+):
+    """Shared scaffold for bounded-span accumulation (precision contract):
+    pad rows to a tile multiple, scan tiles, Kahan-combine the per-tile
+    [G+1] partials produced by ``partial_fn(key_t, valid_t, fields_t)``
+    (ordered [count, field_0, ...]; fields arrive pre-masked by validf).
+    -> (count [G], sums {name: [G]})."""
+    names = sorted(masked_fields.keys())
+    n = safe_key.shape[-1]
+    pad = (-n) % tile
+    kp = jnp.pad(safe_key, (0, pad), constant_values=num_groups)
+    vp = jnp.pad(validf, (0, pad))
+    fps = {nm: jnp.pad(masked_fields[nm], (0, pad)) for nm in names}
+
+    def step(carry, xs):
+        parts = partial_fn(*xs)
+        return (
+            tuple(_kahan_add(s, c, p) for (s, c), p in zip(carry, parts)),
+            None,
+        )
+
+    zero = jnp.zeros(num_groups + 1, jnp.float32)
+    init = tuple((zero, zero) for _ in range(1 + len(names)))
+    tiles = (
+        kp.reshape(-1, tile),
+        vp.reshape(-1, tile),
+        jnp.stack([fps[nm].reshape(-1, tile) for nm in names], axis=1)
+        if names
+        else jnp.zeros((kp.shape[0] // tile, 0, tile), jnp.float32),
+    )
+    out, _ = jax.lax.scan(step, init, tiles)
+    count = (out[0][0] - out[0][1])[:num_groups]
+    sums = {
+        nm: (out[1 + i][0] - out[1 + i][1])[:num_groups]
+        for i, nm in enumerate(names)
+    }
+    return count, sums
+
+
 def _pick_method(nrows: int, num_groups: int) -> str:
     # One-hot matmul materializes an [N, G+1] f32 operand through the MXU;
     # worth it while G stays in the low thousands AND the operand stays
@@ -115,48 +180,83 @@ def group_reduce(
     elif method == "matmul_tiled":
         # Large-N variant: scan over row tiles so each [TILE, G+1] one-hot
         # stays VMEM-sized while sums still ride the MXU — the TPU
-        # alternative to scatter when N*G won't fit at once.
-        TILE = 8192
-        n = safe_key.shape[-1]
-        pad = (-n) % TILE
-        kp = jnp.pad(safe_key, (0, pad), constant_values=num_groups)
-        vp = jnp.pad(validf, (0, pad))
-        fps = {name: jnp.pad(col, (0, pad)) for name, col in fields.items()}
+        # alternative to scatter when N*G won't fit at once.  Tile partials
+        # combine with Kahan-compensated f32 (precision contract above).
         groups = jax.lax.broadcasted_iota(jnp.int32, (num_groups + 1,), 0)
-        names = sorted(fields.keys())
 
-        def tile_fn(carry, xs):
-            k_t, v_t, f_t = xs
+        def mm_partial(k_t, v_t, f_t):
             onehot = (k_t[:, None] == groups[None, :]).astype(jnp.float32)
-            cnt = carry[0] + v_t @ onehot
-            sums_t = [
-                carry[1 + i] + (f_t[i] * v_t) @ onehot
-                for i in range(len(names))
+            return [v_t @ onehot] + [
+                f_t[i] @ onehot for i in range(f_t.shape[0])
             ]
-            return (cnt, *sums_t), None
 
-        init = tuple(
-            jnp.zeros(num_groups + 1, jnp.float32) for _ in range(1 + len(names))
+        count, sums = _kahan_tiled_reduce(
+            safe_key,
+            validf,
+            {nm: col * validf for nm, col in fields.items()},
+            num_groups,
+            8192,
+            mm_partial,
         )
-        tiles = (
-            kp.reshape(-1, TILE),
-            vp.reshape(-1, TILE),
-            jnp.stack([fps[nm].reshape(-1, TILE) for nm in names], axis=1)
-            if names
-            else jnp.zeros((kp.shape[0] // TILE, 0, TILE), jnp.float32),
-        )
-        out, _ = jax.lax.scan(tile_fn, init, tiles)
-        count = out[0][:num_groups]
-        sums = {nm: out[1 + i][:num_groups] for i, nm in enumerate(names)}
     elif method == "scatter":
         seg = jax.ops.segment_sum
-        count = seg(validf, safe_key, num_segments=num_groups + 1)[:num_groups]
-        sums = {
-            name: seg(col * validf, safe_key, num_segments=num_groups + 1)[
-                :num_groups
-            ]
-            for name, col in fields.items()
-        }
+        CHUNK = 65536
+        if safe_key.shape[-1] <= CHUNK:
+            count = seg(validf, safe_key, num_segments=num_groups + 1)[:num_groups]
+            sums = {
+                name: seg(col * validf, safe_key, num_segments=num_groups + 1)[
+                    :num_groups
+                ]
+                for name, col in fields.items()
+            }
+        else:
+            # Bound the f32 accumulation span: per-chunk scatter partials,
+            # Kahan-combined across chunks (precision contract above).
+            def sc_partial(k_t, v_t, f_t):
+                return [seg(v_t, k_t, num_segments=num_groups + 1)] + [
+                    seg(f_t[i], k_t, num_segments=num_groups + 1)
+                    for i in range(f_t.shape[0])
+                ]
+
+            count, sums = _kahan_tiled_reduce(
+                safe_key,
+                validf,
+                {nm: col * validf for nm, col in fields.items()},
+                num_groups,
+                CHUNK,
+                sc_partial,
+            )
+    elif method == "pallas":
+        # Hand-tiled kernel: one pass computes count + ALL field sums
+        # (compiled on TPU, interpret elsewhere); min/max below still
+        # ride XLA scatter.
+        from banyandb_tpu.ops import pallas_kernels
+
+        interpret = jax.default_backend() != "tpu"
+        n = safe_key.shape[-1]
+        pad = (-n) % pallas_kernels.TILE
+        kp = jnp.pad(safe_key, (0, pad), constant_values=num_groups)
+        vp = jnp.pad(valid, (0, pad))
+        names = sorted(fields.keys())
+        vals = (
+            jnp.stack(
+                [
+                    jnp.pad(fields[nm].astype(jnp.float32), (0, pad))
+                    for nm in names
+                ]
+            )
+            if names
+            else jnp.zeros((0, kp.shape[0]), jnp.float32)
+        )
+        count, sums_arr = pallas_kernels.fused_group_multi(
+            kp,
+            jnp.ones_like(kp, dtype=bool),
+            vals,
+            vp,
+            num_groups=num_groups,
+            interpret=interpret,
+        )
+        sums = {nm: sums_arr[i] for i, nm in enumerate(names)}
     else:
         raise ValueError(f"unknown group_reduce method {method!r}")
 

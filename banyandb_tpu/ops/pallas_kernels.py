@@ -3,10 +3,17 @@
 The XLA path (ops.group_reduce) already fuses mask+reduce well; these
 hand-written kernels exist for the cases where explicit control of VMEM
 tiling wins: one pass over HBM-resident row tiles computing the
-filtered per-group sum/count without materializing the one-hot operand
-in HBM.  Grid = row tiles; the [G] accumulators live in the output block
-(revisited by every grid step — TPU grids execute sequentially, so
-read-modify-write accumulation across steps is sound).
+filtered per-group sums/count for ALL fields at once without
+materializing the one-hot operand in HBM.  Grid = row tiles; the
+accumulators live in the output blocks (revisited by every grid step —
+TPU grids execute sequentially, so read-modify-write accumulation
+across steps is sound).
+
+Precision contract (shared with ops.group_reduce): each row tile's
+partial is an f32 MXU contraction over TILE=2048 rows; tile partials are
+combined with Kahan-compensated f32 accumulation across grid steps, so
+the cross-tile error stays O(eps) independent of row count (instead of
+O(n_tiles * eps) for naive f32 accumulation).
 
 Runs in interpret mode on CPU for correctness tests; compiled mode on
 TPU (pallas_guide.md patterns: grid accumulation, @pl.when init).
@@ -23,27 +30,108 @@ from jax.experimental import pallas as pl
 TILE = 2048
 
 
-def _fused_kernel(codes_ref, pred_ref, vals_ref, valid_ref, count_ref, sum_ref):
+def _fused_kernel(
+    codes_ref,
+    pred_ref,
+    vals_ref,
+    valid_ref,
+    count_ref,
+    sum_ref,
+    ccomp_ref,
+    scomp_ref,
+):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
         count_ref[:] = jnp.zeros_like(count_ref)
         sum_ref[:] = jnp.zeros_like(sum_ref)
+        ccomp_ref[:] = jnp.zeros_like(ccomp_ref)
+        scomp_ref[:] = jnp.zeros_like(scomp_ref)
 
     codes = codes_ref[:]  # [1, TILE] int32 group codes
     pred = pred_ref[:]  # [1, TILE] int32 0/1 predicate flags
-    vals = vals_ref[:]  # [1, TILE] f32
+    vals = vals_ref[:]  # [F, TILE] f32
     valid = valid_ref[:]  # [1, TILE] f32 (1.0 valid)
 
     # predicate arrives as a per-row 0/1 flag; multiply is the AND
-    mask = valid * pred.astype(jnp.float32)
+    mask = valid * pred.astype(jnp.float32)  # [1, TILE]
 
     g = count_ref.shape[1]
     groups = jax.lax.broadcasted_iota(jnp.int32, (1, g), 1)
     onehot = (codes[0, :, None] == groups[0, None, :]).astype(jnp.float32)
-    count_ref[:] += (mask[0, :] @ onehot)[None, :]
-    sum_ref[:] += ((vals[0, :] * mask[0, :]) @ onehot)[None, :]
+    cnt_p = (mask[0, :] @ onehot)[None, :]  # [1, G]
+    sum_p = (vals * mask) @ onehot  # [F, G] — one contraction, all fields
+
+    # Kahan-compensated add of this tile's partials into the accumulators.
+    y = cnt_p - ccomp_ref[:]
+    t = count_ref[:] + y
+    ccomp_ref[:] = (t - count_ref[:]) - y
+    count_ref[:] = t
+
+    y = sum_p - scomp_ref[:]
+    t = sum_ref[:] + y
+    scomp_ref[:] = (t - sum_ref[:]) - y
+    sum_ref[:] = t
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def fused_group_multi(
+    codes: jax.Array,
+    pred_mask: jax.Array,
+    values: jax.Array,
+    valid: jax.Array,
+    *,
+    num_groups: int,
+    interpret: bool = False,
+):
+    """Filtered per-group (count, per-field sums) in one pass.
+
+    codes: int32 [N] group codes; pred_mask: bool [N] predicate;
+    values: f32 [F, N] stacked field columns; valid: bool [N].
+    N must be a TILE multiple. -> (count f32 [G], sums f32 [F, G])
+    """
+    n = codes.shape[0]
+    assert n % TILE == 0, f"N={n} must be a multiple of {TILE}"
+    nf = values.shape[0]
+    if nf == 0:
+        # zero-dim blocks don't lower; run a dummy field and drop it
+        count, _ = fused_group_multi(
+            codes,
+            pred_mask,
+            jnp.zeros((1, n), jnp.float32),
+            valid,
+            num_groups=num_groups,
+            interpret=interpret,
+        )
+        return count, jnp.zeros((0, num_groups), jnp.float32)
+    grid = (n // TILE,)
+
+    codes2 = codes.reshape(1, n)
+    pred2 = pred_mask.astype(jnp.int32).reshape(1, n)
+    valid2 = valid.astype(jnp.float32).reshape(1, n)
+
+    row_spec = pl.BlockSpec((1, TILE), lambda i: (0, i))
+    val_spec = pl.BlockSpec((nf, TILE), lambda i: (0, i))
+    cacc_spec = pl.BlockSpec((1, num_groups), lambda i: (0, 0))
+    sacc_spec = pl.BlockSpec((nf, num_groups), lambda i: (0, 0))
+
+    count, total, ccomp, scomp = pl.pallas_call(
+        _fused_kernel,
+        grid=grid,
+        in_specs=[row_spec, row_spec, val_spec, row_spec],
+        out_specs=(cacc_spec, sacc_spec, cacc_spec, sacc_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
+            jax.ShapeDtypeStruct((nf, num_groups), jnp.float32),
+            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
+            jax.ShapeDtypeStruct((nf, num_groups), jnp.float32),
+        ),
+        interpret=interpret,
+    )(codes2, pred2, values, valid2)
+    # Fold the residual compensation back in (classic Kahan final step;
+    # the compensation holds the negated running error).
+    return (count - ccomp)[0], total - scomp
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
@@ -56,33 +144,17 @@ def fused_group_sum(
     num_groups: int,
     interpret: bool = False,
 ):
-    """Filtered per-group (count, sum) in one pass.
+    """Single-field convenience wrapper around fused_group_multi.
 
-    codes: int32 [N] group codes; pred_mask: bool [N] predicate;
-    values: f32 [N]; valid: bool [N]. N must be a TILE multiple.
-    -> (count f32 [G], sum f32 [G])
+    codes: int32 [N]; pred_mask: bool [N]; values: f32 [N]; valid: bool
+    [N]. -> (count f32 [G], sum f32 [G])
     """
-    n = codes.shape[0]
-    assert n % TILE == 0, f"N={n} must be a multiple of {TILE}"
-    grid = (n // TILE,)
-
-    codes2 = codes.reshape(1, n)
-    pred2 = pred_mask.astype(jnp.int32).reshape(1, n)
-    vals2 = values.reshape(1, n)
-    valid2 = valid.astype(jnp.float32).reshape(1, n)
-
-    row_spec = pl.BlockSpec((1, TILE), lambda i: (0, i))
-    acc_spec = pl.BlockSpec((1, num_groups), lambda i: (0, 0))
-
-    count, total = pl.pallas_call(
-        _fused_kernel,
-        grid=grid,
-        in_specs=[row_spec, row_spec, row_spec, row_spec],
-        out_specs=(acc_spec, acc_spec),
-        out_shape=(
-            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
-            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
-        ),
+    count, sums = fused_group_multi(
+        codes,
+        pred_mask,
+        values.reshape(1, -1),
+        valid,
+        num_groups=num_groups,
         interpret=interpret,
-    )(codes2, pred2, vals2, valid2)
-    return count[0], total[0]
+    )
+    return count, sums[0]

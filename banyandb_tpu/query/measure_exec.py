@@ -12,6 +12,12 @@ Pipeline (SURVEY.md §3.3 data-node hot loop, rebuilt TPU-first):
 The jit cache is keyed by a static PlanSpec, so repeated queries with the
 same shape (the dashboard pattern) skip compilation entirely — predicate
 *values* are traced arguments, not compile-time constants.
+
+Precision contract: device kernels produce f32 partials whose f32
+accumulation span is bounded (Kahan-compensated across tiles — see
+ops/groupby.py); this host loop merges per-chunk partials in f64. Net
+effect: per-group sums stay within ~1e-5 relative of exact f64 at any
+row count (tests/test_precision.py).
 """
 
 from __future__ import annotations

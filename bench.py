@@ -1,6 +1,6 @@
 """Round benchmark: fused measure scan+aggregate throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Workload (BASELINE.json config #2/#3 analog): filter + group-by(service) +
 {count,sum,min,max,mean} + p50/p99 histogram + top-N over N_ROWS rows of a
@@ -12,22 +12,39 @@ same query on the same host arrays. NumPy is a *favorable* stand-in for
 the reference's Go row/vec executor (contiguous SIMD loops, no proto or
 iterator overhead), so this ratio is a conservative proxy for "vs the Go
 executor" (BASELINE.md north star: >=8x on TopN/percentile).
+
+Robustness contract (the driver runs this unattended at round end): the
+TPU tunnel on this host is flaky — a claim can fail fast (UNAVAILABLE) or
+hang for minutes.  The parent process therefore runs the real benchmark
+in killable child processes: up to TPU_ATTEMPTS tries on the ambient
+(TPU) environment with backoff, then a CPU fallback with a scrubbed
+environment, all under one hard wall-clock budget — and ALWAYS prints
+exactly one JSON line to stdout.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
-N_ROWS = 4 << 20  # 4Mi rows per device batch
-CHUNK = 8192
+N_ROWS = int(os.environ.get("BYDB_BENCH_ROWS", 4 << 20))  # rows per device batch
 N_SVC = 1024
 N_REGION = 8
 QS = (0.5, 0.99)
 HIST_BUCKETS = 512
+
+BUDGET_S = int(os.environ.get("BYDB_BENCH_BUDGET_S", 2100))
+TPU_ATTEMPTS = int(os.environ.get("BYDB_BENCH_TPU_ATTEMPTS", 3))
+TPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("BYDB_BENCH_TPU_TIMEOUT_S", 600))
+CPU_FALLBACK_ROWS = int(os.environ.get("BYDB_BENCH_ROWS_CPU", 1 << 20))
 
 
 def _host_data(n):
@@ -67,7 +84,8 @@ def numpy_executor(d, region_ne: int):
     return count, sums, mins, maxs, hist, top
 
 
-def main() -> None:
+def child_main() -> None:
+    """Run the actual benchmark on whatever backend this process gets."""
     import jax
     import jax.numpy as jnp
 
@@ -77,7 +95,9 @@ def main() -> None:
         _build_kernel,
     )
 
-    d = _host_data(N_ROWS)
+    backend = jax.default_backend()
+    n_rows = N_ROWS
+    d = _host_data(n_rows)
 
     def mk_spec(method: str) -> PlanSpec:
         return PlanSpec(
@@ -89,14 +109,14 @@ def main() -> None:
             num_groups=N_SVC,
             want_minmax=True,
             hist_field="latency",
-            nrows=N_ROWS,  # one resident mega-chunk: scan is HBM-bound
+            nrows=n_rows,  # one resident mega-chunk: scan is HBM-bound
             group_method=method,
         )
 
     chunk = {
-        "valid": jnp.asarray(np.ones(N_ROWS, dtype=bool)),
-        "series": jnp.zeros(N_ROWS, jnp.int32),
-        "ts": jnp.zeros(N_ROWS, jnp.int32),
+        "valid": jnp.asarray(np.ones(n_rows, dtype=bool)),
+        "series": jnp.zeros(n_rows, jnp.int32),
+        "ts": jnp.zeros(n_rows, jnp.int32),
         "tags_code": {
             "svc": jnp.asarray(d["svc"]),
             "region": jnp.asarray(d["region"]),
@@ -106,8 +126,10 @@ def main() -> None:
     pred_vals = {"p0": jnp.int32(3)}
     args = (chunk, pred_vals, jnp.float32(0.0), jnp.float32(1000.0))
 
-    # self-tune: the scatter path and the tiled-MXU path have very
-    # different profiles per backend; compile both, keep the faster.
+    # self-tune: the scatter, tiled-MXU, and pallas paths have very
+    # different profiles per backend; compile each, keep the fastest.
+    probe_iters, final_iters = (3, 10) if backend != "cpu" else (1, 3)
+
     def timed(kernel, iters):
         out = kernel(*args)
         jax.block_until_ready(out)  # compile + warm
@@ -117,14 +139,25 @@ def main() -> None:
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / iters
 
-    candidates = {
-        m: _build_kernel(mk_spec(m)) for m in ("scatter", "matmul_tiled")
-    }
-    probe = {m: timed(k, 3) for m, k in candidates.items()}
+    methods = ["scatter", "matmul_tiled"]
+    if backend == "tpu":
+        # compiled-mode pallas fused kernel (interpret mode would swamp CPU)
+        methods.append("pallas")
+    probe: dict[str, float] = {}
+    kernels: dict[str, object] = {}
+    for m in methods:
+        try:
+            k = _build_kernel(mk_spec(m))
+            probe[m] = timed(k, probe_iters)
+            kernels[m] = k
+        except Exception as e:  # a broken candidate must not kill the bench
+            print(f"# candidate {m} failed: {type(e).__name__}: {e}", file=sys.stderr)
+    if not probe:
+        raise RuntimeError("no group_reduce candidate compiled")
     best = min(probe, key=probe.get)
 
-    device_s = timed(candidates[best], 10)
-    points_per_sec = N_ROWS / device_s
+    device_s = timed(kernels[best], final_iters)
+    points_per_sec = n_rows / device_s
 
     # single-core NumPy baseline on the same query (1 iter is plenty)
     t0 = time.perf_counter()
@@ -138,9 +171,118 @@ def main() -> None:
                 "value": round(points_per_sec / 1e6, 3),
                 "unit": "Mpoints/s/chip",
                 "vs_baseline": round(numpy_s / device_s, 2),
+                "backend": backend,
+                "method": best,
+                "rows": n_rows,
+                "probe_ms": {m: round(s * 1e3, 2) for m, s in probe.items()},
             }
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Parent orchestration: retries, CPU fallback, hard budget, one JSON line.
+# ---------------------------------------------------------------------------
+
+
+def _cpu_env() -> dict:
+    """Scrubbed environment: no axon sitecustomize, CPU platform, reduced
+    row count so the 1-core fallback stays inside the budget."""
+    from _driver_env import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env()
+    env["BYDB_BENCH_ROWS"] = str(min(N_ROWS, CPU_FALLBACK_ROWS))
+    return env
+
+
+def _run_child(env: dict, timeout_s: float) -> dict | None:
+    """Run `bench.py` in child mode; return its parsed JSON line or None."""
+    env = dict(env)
+    env["_BYDB_BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            cwd=_REPO_DIR,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,  # killable as a group on timeout
+        )
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                proc.kill()
+            proc.wait()
+            print(f"# child timed out after {timeout_s:.0f}s", file=sys.stderr)
+            return None
+    except OSError as e:
+        print(f"# child spawn failed: {e}", file=sys.stderr)
+        return None
+    if err:
+        sys.stderr.write(err[-4000:])
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+                if "metric" in rec:
+                    return rec
+            except json.JSONDecodeError:
+                continue
+    print(f"# child rc={proc.returncode}, no JSON line", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    if os.environ.get("_BYDB_BENCH_CHILD") == "1":
+        child_main()
+        return
+
+    deadline = time.monotonic() + BUDGET_S
+    rec = None
+
+    ambient_is_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if ambient_is_cpu:
+        # Deliberate CPU run: honor the ambient env (incl. BYDB_BENCH_ROWS)
+        # verbatim — no TPU attempt happened, so no fallback labeling.
+        rec = _run_child(dict(os.environ), deadline - time.monotonic())
+    else:
+        # Phase 1: the ambient (normally TPU-tunnel) environment, with
+        # retries — a stuck claim is killed and retried; reserve time for
+        # the CPU fallback.
+        for attempt in range(TPU_ATTEMPTS):
+            remaining = deadline - time.monotonic()
+            reserve = 400.0  # leave room for the CPU fallback
+            budget = min(TPU_ATTEMPT_TIMEOUT_S, remaining - reserve)
+            if budget < 60:
+                break
+            rec = _run_child(dict(os.environ), budget)
+            if rec is not None:
+                break
+            backoff = 30 * (attempt + 1)
+            if deadline - time.monotonic() > reserve + backoff:
+                time.sleep(backoff)
+
+        # Phase 2: CPU fallback — an honest number beats no number.
+        if rec is None:
+            remaining = deadline - time.monotonic()
+            rec = _run_child(_cpu_env(), max(remaining, 120))
+            if rec is not None:
+                rec["note"] = "cpu-fallback: TPU claim unavailable"
+
+    if rec is None:
+        rec = {
+            "metric": "measure_scan_groupby_agg_p50p99_topk",
+            "value": 0.0,
+            "unit": "Mpoints/s/chip",
+            "vs_baseline": 0.0,
+            "error": "all backends failed within budget",
+        }
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":
