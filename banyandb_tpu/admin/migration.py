@@ -92,6 +92,7 @@ def copy(root: str | Path, dest: str | Path, migration_plan: dict) -> dict:
             tags=p.meta["tags"],
             fields=p.meta["fields"],
             want_payload=bool(p.meta.get("has_payload")),
+            cached=False,  # one-shot migration sweep
         )
         extra = {
             k: p.meta[k]
@@ -129,6 +130,7 @@ def _part_fingerprint(pd: Path) -> tuple[int, dict[str, str]]:
         tags=p.meta["tags"],
         fields=p.meta["fields"],
         want_payload=bool(p.meta.get("has_payload")),
+        cached=False,  # one-shot migration sweep
     )
     sums = {
         "ts": hashlib.blake2b(cols.ts.tobytes(), digest_size=8).hexdigest(),

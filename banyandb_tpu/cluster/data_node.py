@@ -227,7 +227,9 @@ class DataNode:
         entity_tags = [t for t in m.entity.tag_names if t in part.meta["tags"]]
         if len(entity_tags) != len(m.entity.tag_names):
             return
-        cols = part.read(range(len(part.blocks)), tags=entity_tags)
+        cols = part.read(
+            range(len(part.blocks)), tags=entity_tags, cached=False
+        )
         import numpy as np
 
         series, first_idx = np.unique(cols.series, return_index=True)

@@ -47,6 +47,17 @@ class MeasureEngine:
         self._tsdb_lock = threading.Lock()
         self._loops = None
         self.topn = TopNProcessorManager(self)
+        # Serving-cache companions: persistent dictionaries + remaps per
+        # measure (measure_exec.DictState), created lazily under the lock.
+        self._dict_states: dict[tuple[str, str], measure_exec.DictState] = {}
+
+    def _dict_state(self, group: str, name: str) -> "measure_exec.DictState":
+        key = (group, name)
+        with self._tsdb_lock:
+            st = self._dict_states.get(key)
+            if st is None:
+                st = self._dict_states[key] = measure_exec.DictState()
+            return st
 
     def start_lifecycle(self, **kw) -> None:
         """Start background flush/merge/retention (svc_standalone analog)."""
@@ -283,7 +294,9 @@ class MeasureEngine:
                         raise
         t_gather = time.perf_counter()
         if req.agg or req.group_by or req.top:
-            res = measure_exec.execute_aggregate(m, req, sources)
+            res = measure_exec.execute_aggregate(
+                m, req, sources, dict_state=self._dict_state(group, req.name)
+            )
         else:
             res = _raw_rows(m, req, sources)
         if req.trace:
@@ -313,7 +326,13 @@ class MeasureEngine:
             except FileNotFoundError:
                 if attempt == 2:
                     raise
-        return measure_exec.compute_partials(m, req, sources, hist_range=hist_range)
+        return measure_exec.compute_partials(
+            m,
+            req,
+            sources,
+            hist_range=hist_range,
+            dict_state=self._dict_state(group, req.name),
+        )
 
     def _index_sources(self, db, m, req, shard_ids):
         """Index-mode sources, optionally restricted to a shard subset
@@ -484,6 +503,8 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
 
 def _trace_spans(t_start, t_gather, sources, index_mode: bool) -> dict:
     """In-band query trace (pkg/query/tracer.go Span analog)."""
+    from banyandb_tpu.storage.cache import device_cache, global_cache
+
     t_end = time.perf_counter()
     rows = sum(int(s.ts.size) for s in sources)
     return {
@@ -500,6 +521,8 @@ def _trace_spans(t_start, t_gather, sources, index_mode: bool) -> dict:
                 "duration_ms": round((t_end - t_gather) * 1000, 3),
             },
         ],
+        "serving_cache": global_cache().stats(),
+        "device_cache": device_cache().stats(),
         "total_ms": round((t_end - t_start) * 1000, 3),
     }
 

@@ -1,0 +1,132 @@
+"""Serving cache: decoded columns for repeat queries.
+
+Analog of the reference's serving cache
+(banyand/internal/storage/cache.go:125), redesigned around this repo's
+query pipeline: the expensive host work on the read path is (1) reading
++ decoding part blocks into ColumnData and (2) gathering sources into
+one deduplicated global-code chunk for the device.  Both layers cache
+here, keyed on immutable identities (part directories never mutate —
+merges write NEW part dirs — so entries never go stale; deleted parts
+simply age out of the LRU).
+
+One process-global cache with a byte budget (BYDB_SERVING_CACHE_BYTES,
+default 256 MiB), LRU eviction, and hit/miss counters that the query
+trace spans and /metrics surface.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable
+
+import numpy as np
+
+DEFAULT_BUDGET = int(os.environ.get("BYDB_SERVING_CACHE_BYTES", 256 << 20))
+
+
+def _sizeof(obj) -> int:
+    """Approximate retained bytes of cached values (arrays dominate;
+    covers numpy and jax arrays via nbytes)."""
+    if isinstance(obj, np.ndarray) or hasattr(obj, "nbytes"):
+        return int(obj.nbytes)
+    if isinstance(obj, dict):
+        return 64 + sum(_sizeof(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return 64 + sum(_sizeof(v) for v in obj)
+    if isinstance(obj, (bytes, bytearray)):
+        return len(obj)
+    if hasattr(obj, "__dict__"):
+        return 64 + sum(_sizeof(v) for v in vars(obj).values())
+    return 64
+
+
+class ServingCache:
+    """LRU byte-budget cache; values must be treated as immutable."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET):
+        self.budget = budget_bytes
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_load(self, key: tuple, loader: Callable[[], object]):
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return hit[0]
+            self.misses += 1
+        # Load outside the lock (disk reads can be slow); racing loaders
+        # compute the same immutable value, last-insert wins harmlessly.
+        value = loader()
+        size = _sizeof(value)
+        if size > self.budget:
+            return value  # too large to retain; serve uncached
+        with self._lock:
+            prev = self._entries.pop(key, None)
+            if prev is not None:
+                self.bytes -= prev[1]
+            self._entries[key] = (value, size)
+            self.bytes += size
+            while self.bytes > self.budget and self._entries:
+                _, (_, evicted) = self._entries.popitem(last=False)
+                self.bytes -= evicted
+        return value
+
+    def invalidate_prefix(self, prefix: tuple) -> int:
+        """Drop entries whose key starts with `prefix` (rarely needed —
+        part identities are immutable — but retention tests use it)."""
+        with self._lock:
+            doomed = [
+                k for k in self._entries if k[: len(prefix)] == prefix
+            ]
+            for k in doomed:
+                _, size = self._entries.pop(k)
+                self.bytes -= size
+            return len(doomed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "budget": self.budget,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+_global = ServingCache()
+
+# Device-resident chunk cache (padded jnp arrays keyed by gather identity)
+# — its own budget so HBM residency is bounded independently of the host
+# cache (default 1 GiB: a deliberate slice of the chip's 16-32 GiB HBM,
+# since resident chunks save both decode AND host->device transfer).
+DEVICE_BUDGET = int(os.environ.get("BYDB_DEVICE_CACHE_BYTES", 1 << 30))
+_device = ServingCache(DEVICE_BUDGET)
+
+
+def global_cache() -> ServingCache:
+    return _global
+
+
+def device_cache() -> ServingCache:
+    return _device
+
+
+def reset_global_cache(budget_bytes: int = DEFAULT_BUDGET) -> ServingCache:
+    """Test hook / server reconfiguration."""
+    global _global, _device
+    _global = ServingCache(budget_bytes)
+    _device = ServingCache(DEVICE_BUDGET)
+    return _global

@@ -76,6 +76,7 @@ def merge_columns(parts: Sequence[Part]) -> tuple[ColumnData, dict]:
             tags=[t for t in all_tags if t in p.meta["tags"]],
             fields=[f for f in all_fields if f in p.meta["fields"]],
             want_payload=want_payload,
+            cached=False,  # one-shot merge sweep: keep the query working set
         )
         n = cols.ts.size
         if want_payload:

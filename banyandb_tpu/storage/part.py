@@ -53,6 +53,9 @@ class ColumnData:
     # opaque per-row payloads (stream element ids / trace span bytes,
     # spans.bin analog); None for measure parts
     payloads: "Optional[list[bytes]]" = None
+    # immutable identity for serving-cache layers (set for part-backed
+    # sources; None for memtable/index sources, which mutate)
+    cache_key: "Optional[tuple]" = None
 
 
 def _col_file(name: str) -> str:
@@ -214,8 +217,47 @@ class Part:
         tags: Iterable[str] = (),
         fields: Iterable[str] = (),
         want_payload: bool = False,
+        cached: bool = True,
     ) -> ColumnData:
-        """Decode the selected blocks' columns into host arrays."""
+        """Decode the selected blocks' columns into host arrays.
+
+        Served through the process serving cache
+        (banyand/internal/storage/cache.go:125 analog): parts are
+        immutable, so (part_dir, blocks, columns) fully identifies the
+        decoded result.  Callers must not mutate returned arrays.
+        One-shot bulk readers (merge, migration, sync) pass cached=False
+        so their full-part sweeps don't evict the query working set.
+        """
+        from banyandb_tpu.storage.cache import global_cache
+
+        key = (
+            "part_read",
+            str(self.dir),
+            tuple(block_ids),
+            tuple(tags),
+            tuple(fields),
+            bool(want_payload),
+        )
+        if not cached:
+            return self._read_uncached(
+                key, block_ids, tags=tags, fields=fields, want_payload=want_payload
+            )
+        return global_cache().get_or_load(
+            key,
+            lambda: self._read_uncached(
+                key, block_ids, tags=tags, fields=fields, want_payload=want_payload
+            ),
+        )
+
+    def _read_uncached(
+        self,
+        key: tuple,
+        block_ids: Sequence[int],
+        *,
+        tags: Iterable[str] = (),
+        fields: Iterable[str] = (),
+        want_payload: bool = False,
+    ) -> ColumnData:
         tags, fields = list(tags), list(fields)
         payloads: Optional[list[bytes]] = (
             [] if (want_payload and self.meta.get("has_payload")) else None
@@ -274,4 +316,5 @@ class Part:
             fields={fl: cat(f"field_{fl}", np.float64) for fl in fields},
             dicts={t: self.dict_for(t) for t in tags},
             payloads=payloads,
+            cache_key=key,
         )
