@@ -1,0 +1,185 @@
+"""HTTP/JSON gateway over the wire services.
+
+Analog of the reference's grpc-gateway liaison HTTP tier
+(banyand/liaison/http/server.go:105): the google.api.http annotations in
+the upstream protos define these routes; requests/responses are the same
+proto messages in protobuf-JSON form (google.protobuf.json_format, the
+encoding grpc-gateway itself uses).
+
+Routes (base path /api as upstream):
+    POST /api/v1/measure/data          MeasureService.Query
+    POST /api/v1/measure/topn          MeasureService.TopN
+    POST /api/v1/stream/data           StreamService.Query
+    POST /api/v1/bydbql/query          BydbQLService.Query
+    POST /api/v1/group/schema          GroupRegistryService.Create
+    GET  /api/v1/group/schema/{g}      GroupRegistryService.Get
+    GET  /api/v1/group/schema/lists    GroupRegistryService.List
+    POST /api/v1/measure/schema        MeasureRegistryService.Create
+    GET  /api/v1/measure/schema/{g}/{n}   MeasureRegistryService.Get
+    GET  /api/v1/measure/schema/lists/{g} MeasureRegistryService.List
+    POST /api/v1/stream/schema         StreamRegistryService.Create
+    GET  /api/v1/stream/schema/{g}/{n}    StreamRegistryService.Get
+    GET  /api/healthz
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from google.protobuf import json_format
+
+from banyandb_tpu.api import pb
+
+
+class _GatewayAbort(Exception):
+    def __init__(self, code, details: str):
+        self.code = code
+        self.details = details
+        super().__init__(details)
+
+
+class _HTTPContext:
+    """grpc.ServicerContext stand-in for gateway-invoked handlers."""
+
+    def abort(self, code, details):
+        raise _GatewayAbort(code, details)
+
+
+_GRPC_TO_HTTP = {
+    "NOT_FOUND": 404,
+    "INVALID_ARGUMENT": 400,
+    "UNIMPLEMENTED": 501,
+    "INTERNAL": 500,
+}
+
+
+class HttpGateway:
+    def __init__(self, services, host: str = "127.0.0.1", port: int = 17913):
+        self.services = services
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, status: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _dispatch(self, method: str):
+                try:
+                    route = gateway._route(method, self.path.rstrip("/"))
+                    if route is None:
+                        return self._send(404, {"error": "no such route"})
+                    handler, req_msg = route
+                    if method == "POST":
+                        n = int(self.headers.get("Content-Length") or 0)
+                        raw = self.rfile.read(n) if n else b"{}"
+                        json_format.Parse(raw, req_msg, ignore_unknown_fields=True)
+                    resp = handler(req_msg, _HTTPContext())
+                    self._send(
+                        200,
+                        json_format.MessageToDict(
+                            resp, preserving_proto_field_name=True
+                        ),
+                    )
+                except _GatewayAbort as e:
+                    self._send(
+                        _GRPC_TO_HTTP.get(e.code.name, 500), {"error": e.details}
+                    )
+                except json_format.ParseError as e:
+                    self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001
+                    self._send(500, {"error": str(e)})
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_GET(self):
+                if self.path == "/api/healthz":
+                    return self._send(200, {"status": "ok"})
+                self._dispatch("GET")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+        self._thread: threading.Thread | None = None
+
+        # static route tables (registry handler dicts are built once; the
+        # request message is instantiated per request at dispatch time)
+        s = services
+        rpc = pb.database_rpc_pb2
+        self._reg = {
+            kind: s._registry_handlers(kind)
+            for kind in ("group", "measure", "stream")
+        }
+        self._post = {
+            ("v1", "measure", "data"): (s.measure_query, pb.measure_query_pb2.QueryRequest),
+            ("v1", "measure", "topn"): (s.measure_topn, pb.measure_topn_pb2.TopNRequest),
+            ("v1", "stream", "data"): (s.stream_query, pb.stream_query_pb2.QueryRequest),
+            ("v1", "bydbql", "query"): (s.bydbql_query, pb.bydbql_query_pb2.QueryRequest),
+            ("v1", "group", "schema"): (
+                self._reg["group"]["Create"].unary_unary,
+                rpc.GroupRegistryServiceCreateRequest,
+            ),
+            ("v1", "measure", "schema"): (
+                self._reg["measure"]["Create"].unary_unary,
+                rpc.MeasureRegistryServiceCreateRequest,
+            ),
+            ("v1", "stream", "schema"): (
+                self._reg["stream"]["Create"].unary_unary,
+                rpc.StreamRegistryServiceCreateRequest,
+            ),
+        }
+
+    # -- routing -----------------------------------------------------------
+    def _route(self, method: str, path: str):
+        rpc = pb.database_rpc_pb2
+        parts = [p for p in path.split("/") if p]
+        if not parts or parts[0] != "api":
+            return None
+        parts = parts[1:]
+        if method == "POST":
+            hit = self._post.get(tuple(parts))
+            return (hit[0], hit[1]()) if hit else None
+        # GET routes with path params
+        if len(parts) == 4 and parts[:3] == ["v1", "group", "schema"]:
+            if parts[3] == "lists":
+                return (
+                    self._reg["group"]["List"].unary_unary,
+                    rpc.GroupRegistryServiceListRequest(),
+                )
+            return (
+                self._reg["group"]["Get"].unary_unary,
+                rpc.GroupRegistryServiceGetRequest(group=parts[3]),
+            )
+        for kind in ("measure", "stream"):
+            if len(parts) == 5 and parts[:3] == ["v1", kind, "schema"]:
+                P = f"{kind.capitalize()}RegistryService"
+                if parts[3] == "lists":
+                    return (
+                        self._reg[kind]["List"].unary_unary,
+                        getattr(rpc, f"{P}ListRequest")(group=parts[4]),
+                    )
+                req = getattr(rpc, f"{P}GetRequest")()
+                req.metadata.group, req.metadata.name = parts[3], parts[4]
+                return (self._reg[kind]["Get"].unary_unary, req)
+        return None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        # shutdown() blocks on serve_forever's loop flag; calling it when
+        # start() never ran would deadlock (partial StandaloneServer start)
+        if self._thread is not None:
+            self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,37 @@
+"""Wire-compatible protobuf modules (generated — see regen.py).
+
+The generated tree keeps the upstream package layout (banyandb.*.v1) so
+message descriptors carry the exact wire names reference clients
+expect; this package dir joins sys.path so those absolute imports
+resolve without shadowing our own package.
+
+    from banyandb_tpu.api import pb
+    pb.measure_query_pb2.QueryRequest()
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+
+def _load(mod_path: str):
+    import importlib
+
+    return importlib.import_module(mod_path)
+
+
+def __getattr__(name: str):
+    """Lazy aliases: pb.measure_query_pb2 -> banyandb.measure.v1.query_pb2."""
+    try:
+        family, rest = name.split("_", 1)
+        if rest.endswith("_pb2"):
+            stem = rest[: -len("_pb2")]
+            return _load(f"banyandb.{family}.v1.{stem}_pb2")
+    except (ValueError, ImportError):
+        pass
+    raise AttributeError(name)

@@ -106,6 +106,10 @@ class Measure:
     entity: Entity
     interval: str = ""  # data-point interval hint (e.g. "1m")
     index_mode: bool = False  # index-mode measures live in the series index
+    # wire-API family layout: ordered (family_name, tag_count) runs over
+    # the flat `tags` tuple (database/v1 TagFamilySpec); empty = one
+    # implicit "default" family
+    tag_families: tuple[tuple[str, int], ...] = ()
 
     def tag(self, name: str) -> TagSpec:
         for t in self.tags:
@@ -128,6 +132,7 @@ class Stream:
     name: str
     tags: tuple[TagSpec, ...]
     entity: tuple[str, ...]
+    tag_families: tuple[tuple[str, int], ...] = ()  # see Measure.tag_families
 
     def tag(self, name: str) -> TagSpec:
         for t in self.tags:
@@ -218,6 +223,7 @@ _FIELD_TYPES = {
     "tuple[TagSpec, ...]": (tuple, "TagSpec"),
     "tuple[FieldSpec, ...]": (tuple, "FieldSpec"),
     "tuple[str, ...]": (tuple, None),
+    "tuple[tuple[str, int], ...]": (tuple, "pair"),
     "Entity": Entity,
     "IntervalRule": IntervalRule,
     "ResourceOpts": ResourceOpts,
@@ -236,6 +242,8 @@ def _from_jsonable_field(type_str, value):
         _, inner = spec
         if inner is None:
             return tuple(value)
+        if inner == "pair":
+            return tuple(tuple(v) for v in value)
         return tuple(_from_jsonable(_CLASSES[inner], v) for v in value)
     if isinstance(spec, type) and issubclass(spec, enum.Enum):
         return spec(value)
@@ -353,6 +361,12 @@ class SchemaRegistry:
 
     def list_streams(self, group: str) -> list[Stream]:
         return [s for s in self._store["stream"].values() if s.group == group]
+
+    def delete_stream(self, group: str, name: str) -> None:
+        self._delete("stream", f"{group}/{name}")
+
+    def delete_trace(self, group: str, name: str) -> None:
+        self._delete("trace", f"{group}/{name}")
 
     def create_trace(self, t: Trace) -> int:
         self.get_group(t.group)

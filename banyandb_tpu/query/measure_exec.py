@@ -315,8 +315,13 @@ def compute_partials(
         fields.add(request.top.field_name)
 
     # --- global dictionaries + remapped concatenated columns --------------
+    # gd and token are captured atomically under the lock: a concurrent
+    # cap-triggered reset swaps dict_state.dicts/token together, and all
+    # cache writes below guard on `dict_state.dicts is gd` so an in-flight
+    # query can never poison the post-reset caches with old codes.
     if dict_state is None:
         gd = GlobalDicts(sorted(tags_code))
+        token = None
     else:
         with dict_state.lock:
             # Growth bound: reset bloated state (tag churn under
@@ -327,6 +332,7 @@ def compute_partials(
             if prod > _MAX_PERSISTENT_GROUPS:
                 dict_state._reset_locked()
             gd = dict_state.dicts
+            token = dict_state.token
             for t in tags_code:
                 gd.ensure(t)
 
@@ -336,7 +342,7 @@ def compute_partials(
     ):
         gather_key = (
             "gather",
-            dict_state.token,
+            token,
             tuple(s.cache_key for s in sources),
             request.time_range.begin_millis,
             request.time_range.end_millis,
@@ -541,6 +547,10 @@ def _source_lut(
             return gd.add_source(tag, list(src.dicts.get(tag, [])))
     rk = (src.cache_key[1], tag)  # part dir fully identifies the dict
     with dict_state.lock:
+        if dict_state.dicts is not gd:
+            # state was reset mid-query: codes from the old gd must not
+            # enter the new remap cache
+            return gd.add_source(tag, list(src.dicts.get(tag, [])))
         lut = dict_state.remaps.get(rk)
         if lut is None:
             lut = gd.add_source(tag, list(src.dicts.get(tag, [])))

@@ -76,7 +76,13 @@ def result_to_json(res: QueryResult) -> dict:
 
 
 class StandaloneServer:
-    def __init__(self, root: str | Path, port: int = 17912):
+    def __init__(
+        self,
+        root: str | Path,
+        port: int = 17912,
+        wire_port: int | None = None,
+        http_port: int | None = None,
+    ):
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
         self.measure = MeasureEngine(self.registry, self.root / "data")
@@ -90,6 +96,25 @@ class StandaloneServer:
         self.bus = LocalBus()
         self._register()
         self.grpc = GrpcBusServer(self.bus, port=port)
+        # reference-proto surfaces (banyandb.*.v1 gRPC + HTTP gateway);
+        # None disables a tier
+        self.wire = None
+        self.http = None
+        if wire_port is not None:
+            from banyandb_tpu.api.grpc_server import WireServer, WireServices
+
+            self._wire_services = WireServices(
+                self.registry, self.measure, self.stream
+            )
+            self.wire = WireServer(self._wire_services, port=wire_port)
+        if http_port is not None:
+            from banyandb_tpu.api.grpc_server import WireServices
+            from banyandb_tpu.api.http_gateway import HttpGateway
+
+            svcs = getattr(self, "_wire_services", None) or WireServices(
+                self.registry, self.measure, self.stream
+            )
+            self.http = HttpGateway(svcs, port=http_port)
 
     # -- wiring -------------------------------------------------------------
     def _register(self) -> None:
@@ -304,6 +329,10 @@ class StandaloneServer:
         # one lifecycle daemon drives storage loops AND property-lease GC
         self.measure.start_lifecycle(extra_tick=self._sweep_properties)
         self.grpc.start()
+        if self.wire is not None:
+            self.wire.start()
+        if self.http is not None:
+            self.http.start()
 
     def _sweep_properties(self) -> None:
         for g in self.registry.list_groups():
@@ -315,6 +344,10 @@ class StandaloneServer:
     def stop(self) -> None:
         self.measure.stop_lifecycle()
         self.grpc.stop()
+        if self.wire is not None:
+            self.wire.stop()
+        if self.http is not None:
+            self.http.stop()
         self.access_log.close()
 
     @property
@@ -326,10 +359,31 @@ def main(argv=None) -> None:
     ap = argparse.ArgumentParser("banyandb-tpu server")
     ap.add_argument("--root", required=True)
     ap.add_argument("--port", type=int, default=17912)
+    ap.add_argument(
+        "--wire-port",
+        type=int,
+        default=17914,
+        help="reference-proto gRPC surface (banyandb.*.v1); -1 disables",
+    )
+    ap.add_argument(
+        "--http-port",
+        type=int,
+        default=17913,
+        help="HTTP/JSON gateway; -1 disables",
+    )
     args = ap.parse_args(argv)
-    srv = StandaloneServer(args.root, args.port)
+    srv = StandaloneServer(
+        args.root,
+        args.port,
+        wire_port=None if args.wire_port < 0 else args.wire_port,
+        http_port=None if args.http_port < 0 else args.http_port,
+    )
     srv.start()
     print(f"banyandb-tpu standalone listening on {srv.addr}", flush=True)
+    if srv.wire is not None:
+        print(f"wire gRPC (banyandb.*.v1) on :{srv.wire.port}", flush=True)
+    if srv.http is not None:
+        print(f"HTTP gateway on :{srv.http.port}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())

