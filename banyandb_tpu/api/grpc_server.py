@@ -117,19 +117,16 @@ class WireServices:
             from banyandb_tpu.api.model import TimeRange
             from banyandb_tpu.models import topn as topn_mod
 
+            group = self._one_group(req)
             rule = next(
-                (
-                    r
-                    for r in self.registry.list_topn(req.groups[0])
-                    if r.name == req.name
-                ),
+                (r for r in self.registry.list_topn(group) if r.name == req.name),
                 None,
             )
             if rule is None:
                 raise KeyError(f"topn rule {req.name} not found")
             ranked = topn_mod.query_topn(
                 self.measure,
-                req.groups[0],
+                group,
                 req.name,
                 TimeRange(
                     wire.ts_to_millis(req.time_range.begin),

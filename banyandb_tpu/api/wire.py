@@ -208,7 +208,9 @@ def measure_query_to_internal(req) -> im.QueryRequest:
         time_range=im.TimeRange(
             ts_to_millis(req.time_range.begin),
             ts_to_millis(req.time_range.end),
-        ),
+        )
+        if req.HasField("time_range")
+        else im.TimeRange(0, 1 << 62),
         criteria=criteria_to_internal(req.criteria) if req.HasField("criteria") else None,
         tag_projection=_flatten_projection(req.tag_projection),
         field_projection=tuple(req.field_projection.names),

@@ -192,23 +192,116 @@ class DataNode:
             state = self._sync_sessions.pop(session)
             for fname, buf in state["files"].items():
                 fs.atomic_write(state["dir"] / fname, bytes(buf))
-            db = self.measure._tsdb(state["group"])
-            seg = db.segment_for(int(env["segment_start_millis"]))
-            shard = seg.shards[int(state["shard"].split("-")[1])]
-            import os
-
-            from banyandb_tpu.storage.part import Part
-
-            with shard._lock:
-                shard._epoch += 1
-                part_name = f"part-{shard._epoch:016x}"
-                final = shard.root / part_name
-                os.rename(state["dir"], final)
-                part = shard._parts[part_name] = Part(final)
-                shard._publish()
-            self._register_synced_series(seg, part)
+            part_name = self._introduce_part_dir(
+                state["dir"],
+                state["group"],
+                int(state["shard"].split("-")[1]),
+                int(env["segment_start_millis"]),
+            )
             return {"introduced": part_name}
         raise ValueError(f"bad sync phase {phase}")
+
+    def _introduce_part_dir(
+        self, staged_dir, group: str, shard_idx: int, segment_start_millis: int
+    ) -> str:
+        """Move a fully-staged part dir into the shard + publish + register
+        series (shared by the JSON path and streaming chunked sync)."""
+        import os
+
+        from banyandb_tpu.storage.part import Part
+
+        db = self.measure._tsdb(group)
+        seg = db.segment_for(segment_start_millis)
+        shard = seg.shards[shard_idx]
+        with shard._lock:
+            shard._epoch += 1
+            part_name = f"part-{shard._epoch:016x}"
+            final = shard.root / part_name
+            os.rename(staged_dir, final)
+            part = shard._parts[part_name] = Part(final)
+            shard._publish()
+        self._register_synced_series(seg, part)
+        return part_name
+
+    def install_synced_parts(self, meta, parts) -> None:
+        """Streaming ChunkedSyncService install callback
+        (cluster/chunked_sync.py): write each part's files to staging,
+        then introduce into the shard owning meta.shard_id.  The target
+        segment comes from each part's min timestamp (the reference's
+        receiver does the same: parts land in their time's segment)."""
+        import json as _json
+        import uuid as _uuid
+
+        for pi, files in parts:
+            if "metadata.json" not in files:
+                raise ValueError("part missing metadata.json")
+            pmeta = _json.loads(files["metadata.json"])
+            staged = self.root / ".sync-staging" / _uuid.uuid4().hex
+            staged.mkdir(parents=True, exist_ok=True)
+            for fname, blob in files.items():
+                fs.atomic_write(staged / fname, blob)
+            group = meta.group or pmeta.get("group")
+            min_ts = int(pmeta.get("min_ts", pi.min_timestamp))
+            part_name = self._introduce_part_dir(
+                staged, group, int(meta.shard_id), min_ts
+            )
+            self._observe_topn_part(group, pmeta, min_ts, int(meta.shard_id), part_name)
+
+    def _observe_topn_part(
+        self, group: str, pmeta: dict, min_ts: int, shard_idx: int, part_name: str
+    ) -> None:
+        """Feed an installed part's rows through TopN pre-aggregation —
+        the queued write path bypasses MeasureEngine.write, which is
+        where per-point topn.observe normally happens.  Only runs when a
+        TopN rule actually sources this measure."""
+        measure_name = pmeta.get("measure")
+        if not measure_name:
+            return
+        try:
+            m = self.registry.get_measure(group, measure_name)
+        except KeyError:
+            return
+        rules = [
+            r
+            for r in self.registry.list_topn(group)
+            if r.source_measure == measure_name
+        ]
+        if not rules:
+            return
+        from banyandb_tpu.api.model import DataPointValue
+        from banyandb_tpu.query.filter import decode_tag_value
+
+        db = self.measure._tsdb(group)
+        seg = db.segment_for(min_ts)
+        part = seg.shards[shard_idx]._parts.get(part_name)
+        if part is None:
+            return
+        need_tags = sorted(
+            {t for r in rules for t in r.group_by_tag_names}
+            | set(m.entity.tag_names)
+        )
+        need_fields = sorted({r.field_name for r in rules})
+        cols = part.read(
+            range(len(part.blocks)),
+            tags=[t for t in need_tags if t in part.meta["tags"]],
+            fields=[f for f in need_fields if f in part.meta["fields"]],
+            cached=False,
+        )
+        for i in range(cols.ts.size):
+            tags = {
+                t: decode_tag_value(cols.dicts[t][cols.tags[t][i]], m.tag(t).type)
+                for t in cols.tags
+            }
+            fields = {f: float(cols.fields[f][i]) for f in cols.fields}
+            self.measure.topn.observe(
+                m,
+                DataPointValue(
+                    ts_millis=int(cols.ts[i]),
+                    tags=tags,
+                    fields=fields,
+                    version=int(cols.version[i]),
+                ),
+            )
 
     def _register_synced_series(self, seg, part) -> None:
         """Entity-tag series registration for a shipped part — without it,

@@ -147,6 +147,50 @@ class Liaison:
                 return False
             _time.sleep(0.05)
 
+    # -- liaison write queue (wqueue.go:75 analog) --------------------------
+    def enable_write_queue(self, spool_root, **kw):
+        """Switch measure writes to the batching plane: buffered parts per
+        (group, shard), sealed + shipped over streaming chunked sync.
+        Requires a transport exposing .channel(addr) (GrpcTransport)."""
+        from banyandb_tpu.cluster import chunked_sync, wqueue
+
+        def shipper(group: str, shard: int, part_dir):
+            """Ship to the FULL replica set (same durability contract as
+            the synchronous path).  Any replica failure raises so the
+            sealed part stays spooled and re-ships next tick — re-shipping
+            duplicates rows on nodes that already received the part, which
+            query-time version dedup collapses (idempotent retries)."""
+            errors = []
+            delivered = 0
+            for node in self.selector.replica_set(shard):
+                if node.name not in self.alive:
+                    errors.append(f"{node.name} down")
+                    continue
+                try:
+                    chan = self.transport.channel(node.addr)
+                    chunked_sync.sync_part_dirs(
+                        chan, [part_dir], group=group, shard_id=shard
+                    )
+                    delivered += 1
+                except TransportError as e:
+                    self.alive.discard(node.name)
+                    errors.append(f"{node.name}: {e}")
+            if errors or delivered == 0:
+                raise TransportError(
+                    f"part ship incomplete ({delivered} delivered): {errors}"
+                )
+
+        self.wqueue = wqueue.WriteQueue(self.registry, spool_root, shipper, **kw)
+        self.wqueue.start()
+        return self.wqueue
+
+    def write_measure_queued(self, req: WriteRequest) -> int:
+        """Buffered write path: rows land in the liaison write queue and
+        reach data nodes as sealed parts on the next seal/ship tick."""
+        if getattr(self, "wqueue", None) is None:
+            raise RuntimeError("write queue not enabled (enable_write_queue)")
+        return self.wqueue.append(req)
+
     # -- writes -------------------------------------------------------------
     def write_measure(self, req: WriteRequest) -> int:
         """-> number of distinct points accepted (each counted once,

@@ -68,7 +68,10 @@ class GrpcBusServer:
         *,
         cert_file: Optional[str] = None,
         key_file: Optional[str] = None,
+        sync_install=None,
     ):
+        """sync_install: optional callback enabling the streaming
+        ChunkedSyncService on this server (cluster/chunked_sync.py)."""
         import grpc
 
         self.bus = bus
@@ -97,6 +100,12 @@ class GrpcBusServer:
                      ("grpc.max_send_message_length", 64 * 1024 * 1024)],
         )
         self._server.add_generic_rpc_handlers((handler,))
+        if sync_install is not None:
+            from banyandb_tpu.cluster import chunked_sync
+
+            self._server.add_generic_rpc_handlers(
+                (chunked_sync.generic_handler(sync_install),)
+            )
         if cert_file and key_file:
             creds = grpc.ssl_server_credentials(
                 [
@@ -168,6 +177,12 @@ class GrpcTransport:
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b,
             )
+
+    def channel(self, addr: str):
+        """Raw grpc channel for streaming services (chunked sync)."""
+        self._stub(addr)  # ensure the channel exists
+        with self._lock:
+            return self._channels[addr]
 
     def call(self, addr: str, topic: str, envelope: dict, timeout: float = 30.0) -> dict:
         import grpc

@@ -1,0 +1,246 @@
+"""Liaison-side write queue: buffer -> sealed parts -> chunked sync.
+
+Analog of the reference's wqueue architecture
+(banyand/internal/wqueue/wqueue.go:75 + banyand/measure/syncer.go:69):
+instead of fanning every row batch out synchronously, the liaison
+buffers writes per (group, measure, shard) in columnar memtables, seals
+them into real on-disk parts when a row threshold or flush interval
+hits, and ships sealed parts to the shard's data node over the
+streaming ChunkedSyncService (cluster/chunked_sync.py).  Data nodes
+introduce shipped parts directly — the write path and the inter-tier
+sync path are the same code.
+
+Failure contract: a sealed part that fails to ship stays spooled on
+disk and retries on the next tick (the spool is the liaison's handoff
+buffer for the part plane); seal+ship never loses acknowledged rows —
+rows are acknowledged only after landing in the spool-backed memtable
+of a seal group, and a liaison crash loses at most the unsealed buffer
+(same window as the reference's liaison wqueue).
+"""
+
+from __future__ import annotations
+
+import shutil
+import threading
+import time
+import uuid
+from pathlib import Path
+from typing import Callable, Optional
+
+from banyandb_tpu.api.model import WriteRequest
+from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.storage.memtable import MemTable
+from banyandb_tpu.storage.part import PartWriter
+from banyandb_tpu.utils import hashing
+
+
+class WriteQueue:
+    def __init__(
+        self,
+        registry: SchemaRegistry,
+        spool_root: str | Path,
+        shipper: Callable[[str, int, Path], None],
+        *,
+        max_rows: int = 65536,
+        flush_interval_s: float = 1.0,
+    ):
+        """shipper(group, shard_id, part_dir) ships one sealed part;
+        raises on failure (the part stays spooled and retries)."""
+        self.registry = registry
+        self.spool = Path(spool_root)
+        self.spool.mkdir(parents=True, exist_ok=True)
+        self.shipper = shipper
+        self.max_rows = max_rows
+        self.flush_interval_s = flush_interval_s
+        self._buffers: dict[tuple[str, str, int], MemTable] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # orphaned sealed parts from a previous process retry first
+        self._pending: list[tuple[str, int, Path]] = self._recover_spool()
+
+    # -- append path --------------------------------------------------------
+    def append(self, req: WriteRequest) -> int:
+        """Route points into per-(group, measure, shard) buffers; returns
+        the accepted count.  Same shard routing as the synchronous path
+        (entity hash -> seriesID -> shard).  The queue lock is held for
+        the whole batch so a concurrent seal can never orphan a buffer
+        between lookup and append (acknowledged rows must reach a seal)."""
+        m = self.registry.get_measure(req.group, req.name)
+        shard_num = self.registry.get_group(req.group).resource_opts.shard_num
+        tag_names = [t.name for t in m.tags]
+        field_names = [f.name for f in m.fields]
+        full = set()
+        with self._lock:
+            for p in req.points:
+                entity = [req.name.encode()] + [
+                    hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
+                ]
+                sid = hashing.series_id(entity)
+                shard = hashing.shard_id(sid, shard_num)
+                key = (req.group, req.name, shard)
+                buf = self._buffers.get(key)
+                if buf is None:
+                    buf = self._buffers[key] = MemTable(tag_names, field_names)
+                tag_bytes = {
+                    t: hashing.entity_bytes(p.tags[t])
+                    if p.tags.get(t) is not None
+                    else b""
+                    for t in tag_names
+                }
+                fields = {f: float(p.fields.get(f, 0)) for f in field_names}
+                version = p.version or int(time.time() * 1000)
+                buf.append(p.ts_millis, sid, version, tag_bytes, fields)
+                if len(buf) >= self.max_rows:
+                    full.add(key)
+        for key in full:
+            self._seal(key)
+        return len(req.points)
+
+    # -- seal + ship --------------------------------------------------------
+    def _seal(self, key: tuple[str, str, int]) -> None:
+        """Swap the buffer out and write its rows as sealed parts in the
+        spool — one part per storage segment (rows spanning a segment
+        boundary must not land in one part: the receiver installs a part
+        into a single segment, and rows outside it would be invisible to
+        time-pruned queries).  On write failure the buffer is restored so
+        acknowledged rows are never dropped."""
+        group, measure, shard = key
+        with self._lock:
+            buf = self._buffers.pop(key, None)
+        if buf is None or len(buf) == 0:
+            return
+        try:
+            cols = buf.snapshot_columns()
+            iv = self.registry.get_group(group).resource_opts.segment_interval.millis
+            seg_starts = cols.ts - (cols.ts % iv)
+            import numpy as np
+
+            sealed = []
+            for start in np.unique(seg_starts).tolist():
+                mask = seg_starts == start
+                session = uuid.uuid4().hex
+                part_dir = (
+                    self.spool / f"{group}@{measure}@{shard}@{session}" / "part-000000"
+                )
+                PartWriter.write(
+                    part_dir,
+                    ts=cols.ts[mask],
+                    series=cols.series[mask],
+                    version=cols.version[mask],
+                    tag_codes={t: v[mask] for t, v in cols.tags.items()},
+                    tag_dicts=dict(cols.dicts),
+                    fields={f: v[mask] for f, v in cols.fields.items()},
+                    extra_meta={"measure": measure, "group": group},
+                )
+                sealed.append((group, shard, part_dir))
+            with self._lock:
+                self._pending.extend(sealed)
+        except Exception:
+            # restore the rows: seal again next tick (merge into any new
+            # buffer created meanwhile)
+            with self._lock:
+                cur = self._buffers.get(key)
+                if cur is None or len(cur) == 0:
+                    self._buffers[key] = buf
+                else:
+                    snap = buf.snapshot_columns()
+                    cur.append_bulk(
+                        snap.ts,
+                        snap.series,
+                        snap.version,
+                        {
+                            t: [snap.dicts[t][c] for c in snap.tags[t]]
+                            for t in snap.tags
+                        },
+                        dict(snap.fields),
+                    )
+            raise
+
+    def seal_all(self) -> None:
+        with self._lock:
+            keys = list(self._buffers.keys())
+        errors = []
+        for key in keys:
+            try:
+                self._seal(key)
+            except Exception as e:  # noqa: BLE001 - other keys still seal
+                errors.append(e)
+        if errors:
+            raise errors[0]
+
+    def ship_pending(self) -> tuple[int, int]:
+        """Try to ship every sealed part; -> (shipped, failed)."""
+        with self._lock:
+            pending, self._pending = self._pending, []
+        shipped = failed = 0
+        still: list[tuple[str, int, Path]] = []
+        for group, shard, part_dir in pending:
+            try:
+                self.shipper(group, shard, part_dir)
+                shutil.rmtree(part_dir.parent, ignore_errors=True)
+                shipped += 1
+            except Exception:  # noqa: BLE001 - retried next tick
+                still.append((group, shard, part_dir))
+                failed += 1
+        with self._lock:
+            self._pending.extend(still)
+        return shipped, failed
+
+    def flush(self) -> tuple[int, int]:
+        """Seal everything and attempt shipping (one tick, also the test
+        hook)."""
+        self.seal_all()
+        return self.ship_pending()
+
+    def pending_parts(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def buffered_rows(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._buffers.values())
+
+    # -- lifecycle ----------------------------------------------------------
+    def _recover_spool(self) -> list[tuple[str, int, Path]]:
+        out = []
+        for d in sorted(self.spool.iterdir()) if self.spool.exists() else []:
+            if not d.is_dir() or "@" not in d.name:
+                continue
+            try:
+                group, _measure, shard, _session = d.name.split("@", 3)
+                part_dir = d / "part-000000"
+                if (part_dir / "metadata.json").exists():
+                    out.append((group, int(shard), part_dir))
+                else:  # crashed mid-write: the part is not durable yet
+                    shutil.rmtree(d, ignore_errors=True)
+            except (ValueError, OSError):
+                continue
+        return out
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        import logging
+
+        log = logging.getLogger("banyandb.wqueue")
+
+        def loop():
+            while not self._stop.wait(self.flush_interval_s):
+                try:
+                    self.flush()
+                except Exception:  # noqa: BLE001 - the loop must survive
+                    log.exception("wqueue flush tick failed (rows retained)")
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="wqueue")
+        self._thread.start()
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_flush:
+            self.flush()
