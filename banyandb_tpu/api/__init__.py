@@ -17,6 +17,8 @@ from banyandb_tpu.api.schema import (
     ResourceOpts,
     IntervalRule,
     Measure,
+    Stream,
+    Trace,
     IndexRule,
     TopNAggregation,
     SchemaRegistry,

@@ -49,86 +49,105 @@ def sync_method_handler(install_cb: Callable):
     rpcpb = pb.cluster_rpc_pb2
 
     def sync_part(request_iterator, context):
+        import os
+        import tempfile
+
         meta = None
-        buf = bytearray()
         expected = 0
+        total = 0
         t0 = time.monotonic()
-        for req in request_iterator:
-            if req.chunk_index != expected:
-                yield rpcpb.SyncPartResponse(
-                    session_id=req.session_id,
-                    chunk_index=req.chunk_index,
-                    status=3,  # SYNC_STATUS_CHUNK_OUT_OF_ORDER
-                    error=f"expected chunk {expected}, got {req.chunk_index}",
-                )
-                return
-            if req.chunk_data and _crc(req.chunk_data) != req.chunk_checksum:
-                yield rpcpb.SyncPartResponse(
-                    session_id=req.session_id,
-                    chunk_index=req.chunk_index,
-                    status=2,  # SYNC_STATUS_CHUNK_CHECKSUM_MISMATCH
-                    error="chunk CRC mismatch",
-                )
-                return
-            if req.WhichOneof("content") == "metadata":
-                meta = req.metadata
-            buf.extend(req.chunk_data)
-            expected += 1
-            if req.WhichOneof("content") == "completion":
-                if meta is None:
+        # chunks spool to disk as they arrive, so receiver memory stays
+        # O(chunk) regardless of part size; per-file slices are read back
+        # at install (peak = largest single column file, not the part)
+        spool = tempfile.NamedTemporaryFile(
+            prefix="bydb-sync-", suffix=".spool", delete=False
+        )
+        try:
+            for req in request_iterator:
+                if req.chunk_index != expected:
                     yield rpcpb.SyncPartResponse(
                         session_id=req.session_id,
                         chunk_index=req.chunk_index,
-                        status=4,  # SYNC_STATUS_SESSION_NOT_FOUND
-                        error="completion without metadata",
+                        status=3,  # SYNC_STATUS_CHUNK_OUT_OF_ORDER
+                        error=f"expected chunk {expected}, got {req.chunk_index}",
                     )
                     return
-                # split the stream into parts/files per the final layout
-                parts = []
-                offset = 0
-                for pi in req.parts_info:
-                    files = {}
-                    end = offset
-                    for fi in pi.files:
-                        files[fi.name] = bytes(
-                            buf[offset + fi.offset : offset + fi.offset + fi.size]
+                if req.chunk_data and _crc(req.chunk_data) != req.chunk_checksum:
+                    yield rpcpb.SyncPartResponse(
+                        session_id=req.session_id,
+                        chunk_index=req.chunk_index,
+                        status=2,  # SYNC_STATUS_CHUNK_CHECKSUM_MISMATCH
+                        error="chunk CRC mismatch",
+                    )
+                    return
+                if req.WhichOneof("content") == "metadata":
+                    meta = req.metadata
+                spool.write(req.chunk_data)
+                total += len(req.chunk_data)
+                expected += 1
+                if req.WhichOneof("content") == "completion":
+                    if meta is None:
+                        yield rpcpb.SyncPartResponse(
+                            session_id=req.session_id,
+                            chunk_index=req.chunk_index,
+                            status=4,  # SYNC_STATUS_SESSION_NOT_FOUND
+                            error="completion without metadata",
                         )
-                        end = max(end, offset + fi.offset + fi.size)
-                    parts.append((pi, files))
-                    offset = end
-                results = []
-                ok = True
-                try:
-                    install_cb(meta, parts)
-                    results = [
-                        rpcpb.PartResult(
-                            success=True, bytes_processed=sum(len(b) for b in f.values())
-                        )
-                        for _, f in parts
-                    ]
-                except Exception as e:  # noqa: BLE001 - reported in-band
-                    ok = False
-                    results = [rpcpb.PartResult(success=False, error=str(e))]
+                        return
+                    spool.flush()
+                    # split the stream into parts/files per the layout
+                    parts = []
+                    offset = 0
+                    with open(spool.name, "rb") as rd:
+                        for pi in req.parts_info:
+                            files = {}
+                            end = offset
+                            for fi in pi.files:
+                                rd.seek(offset + fi.offset)
+                                files[fi.name] = rd.read(fi.size)
+                                end = max(end, offset + fi.offset + fi.size)
+                            parts.append((pi, files))
+                            offset = end
+                    results = []
+                    ok = True
+                    try:
+                        install_cb(meta, parts)
+                        results = [
+                            rpcpb.PartResult(
+                                success=True,
+                                bytes_processed=sum(len(b) for b in f.values()),
+                            )
+                            for _, f in parts
+                        ]
+                    except Exception as e:  # noqa: BLE001 - reported in-band
+                        ok = False
+                        results = [rpcpb.PartResult(success=False, error=str(e))]
+                    yield rpcpb.SyncPartResponse(
+                        session_id=req.session_id,
+                        chunk_index=req.chunk_index,
+                        status=5 if ok else 4,  # COMPLETE | SESSION_NOT_FOUND
+                        error="" if ok else results[0].error,
+                        sync_result=rpcpb.SyncResult(
+                            success=ok,
+                            total_bytes_received=total,
+                            duration_ms=int((time.monotonic() - t0) * 1000),
+                            chunks_received=expected,
+                            parts_received=len(parts),
+                            parts_results=results,
+                        ),
+                    )
+                    return
                 yield rpcpb.SyncPartResponse(
                     session_id=req.session_id,
                     chunk_index=req.chunk_index,
-                    status=5 if ok else 4,  # COMPLETE | SESSION_NOT_FOUND
-                    error="" if ok else results[0].error,
-                    sync_result=rpcpb.SyncResult(
-                        success=ok,
-                        total_bytes_received=len(buf),
-                        duration_ms=int((time.monotonic() - t0) * 1000),
-                        chunks_received=expected,
-                        parts_received=len(parts),
-                        parts_results=results,
-                    ),
+                    status=1,  # SYNC_STATUS_CHUNK_RECEIVED
                 )
-                return
-            yield rpcpb.SyncPartResponse(
-                session_id=req.session_id,
-                chunk_index=req.chunk_index,
-                status=1,  # SYNC_STATUS_CHUNK_RECEIVED
-            )
+        finally:
+            spool.close()
+            try:
+                os.unlink(spool.name)
+            except OSError:
+                pass
 
     return grpc.stream_stream_rpc_method_handler(
         sync_part,

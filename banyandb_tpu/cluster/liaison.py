@@ -157,12 +157,24 @@ class Liaison:
         def shipper(group: str, shard: int, part_dir):
             """Ship to the FULL replica set (same durability contract as
             the synchronous path).  Any replica failure raises so the
-            sealed part stays spooled and re-ships next tick — re-shipping
-            duplicates rows on nodes that already received the part, which
-            query-time version dedup collapses (idempotent retries)."""
+            sealed part stays spooled and retries next tick.  Delivered
+            replicas are recorded in a sidecar next to the spooled part,
+            so a retry after partial delivery ships only to replicas
+            still missing the part — no duplicate installs (and no TopN
+            double-observation) on nodes that already have it."""
+            import json as _json
+
+            record = part_dir.parent / "delivered.json"
+            delivered: set[str] = set()
+            if record.exists():
+                try:
+                    delivered = set(_json.loads(record.read_text()))
+                except (OSError, ValueError):
+                    delivered = set()
             errors = []
-            delivered = 0
             for node in self.selector.replica_set(shard):
+                if node.name in delivered:
+                    continue
                 if node.name not in self.alive:
                     errors.append(f"{node.name} down")
                     continue
@@ -171,13 +183,14 @@ class Liaison:
                     chunked_sync.sync_part_dirs(
                         chan, [part_dir], group=group, shard_id=shard
                     )
-                    delivered += 1
+                    delivered.add(node.name)
+                    record.write_text(_json.dumps(sorted(delivered)))
                 except TransportError as e:
                     self.alive.discard(node.name)
                     errors.append(f"{node.name}: {e}")
-            if errors or delivered == 0:
+            if errors or not delivered:
                 raise TransportError(
-                    f"part ship incomplete ({delivered} delivered): {errors}"
+                    f"part ship incomplete (delivered to {sorted(delivered)}): {errors}"
                 )
 
         self.wqueue = wqueue.WriteQueue(self.registry, spool_root, shipper, **kw)

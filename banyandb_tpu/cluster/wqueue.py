@@ -110,21 +110,27 @@ class WriteQueue:
             buf = self._buffers.pop(key, None)
         if buf is None or len(buf) == 0:
             return
+        tmp_parents: list[Path] = []
+        sealed: list[tuple[str, int, Path]] = []
         try:
             cols = buf.snapshot_columns()
             iv = self.registry.get_group(group).resource_opts.segment_interval.millis
             seg_starts = cols.ts - (cols.ts % iv)
             import numpy as np
 
-            sealed = []
+            # All segment-split parts are written under .tmp dirs first and
+            # renamed only after EVERY one succeeds — a mid-seal failure
+            # must not leave a recoverable orphan part while the same rows
+            # are also restored to the buffer (double delivery).
+            staged: list[tuple[Path, Path]] = []
             for start in np.unique(seg_starts).tolist():
                 mask = seg_starts == start
                 session = uuid.uuid4().hex
-                part_dir = (
-                    self.spool / f"{group}@{measure}@{shard}@{session}" / "part-000000"
-                )
+                final_parent = self.spool / f"{group}@{measure}@{shard}@{session}"
+                tmp_parent = self.spool / f".tmp-{session}"
+                tmp_parents.append(tmp_parent)
                 PartWriter.write(
-                    part_dir,
+                    tmp_parent / "part-000000",
                     ts=cols.ts[mask],
                     series=cols.series[mask],
                     version=cols.version[mask],
@@ -133,10 +139,19 @@ class WriteQueue:
                     fields={f: v[mask] for f, v in cols.fields.items()},
                     extra_meta={"measure": measure, "group": group},
                 )
-                sealed.append((group, shard, part_dir))
+                staged.append((tmp_parent, final_parent))
+            for tmp_parent, final_parent in staged:
+                tmp_parent.rename(final_parent)
+                sealed.append((group, shard, final_parent / "part-000000"))
             with self._lock:
                 self._pending.extend(sealed)
         except Exception:
+            # undo everything (renamed-but-unregistered parts included):
+            # the restored rows below are the single surviving copy
+            for tmp_parent in tmp_parents:
+                shutil.rmtree(tmp_parent, ignore_errors=True)
+            for _g, _s, part_dir in sealed:
+                shutil.rmtree(part_dir.parent, ignore_errors=True)
             # restore the rows: seal again next tick (merge into any new
             # buffer created meanwhile)
             with self._lock:
@@ -205,6 +220,11 @@ class WriteQueue:
     def _recover_spool(self) -> list[tuple[str, int, Path]]:
         out = []
         for d in sorted(self.spool.iterdir()) if self.spool.exists() else []:
+            if d.is_dir() and d.name.startswith(".tmp"):
+                # crashed mid-seal: rows never left the (lost) buffer OR
+                # were restored and resealed — either way this is garbage
+                shutil.rmtree(d, ignore_errors=True)
+                continue
             if not d.is_dir() or "@" not in d.name:
                 continue
             try:

@@ -71,8 +71,40 @@ class StreamEngine:
                     g.resource_opts,
                     mem_factory=lambda: PayloadMemtable("stream"),
                 )
+                # element-index/bloom sidecars on every flushed/merged part
+                # (banyand/stream/index.go + .tff filter analog)
+                db.on_part_built = (
+                    lambda part_dir, meta, g=group: self._build_part_index(
+                        g, part_dir, meta
+                    )
+                )
                 self._tsdbs[group] = db
             return db
+
+    def _index_tags(self, group: str) -> tuple[set[str], set[str]]:
+        """(inverted tags, skipping tags) from the group's IndexRules.
+
+        Simplification vs the reference: rules apply to any stream in the
+        group carrying the tag (no IndexRuleBinding subject resolution) —
+        the binding layer routes the same way in the common case of one
+        rule set per group."""
+        inverted: set[str] = set()
+        skipping: set[str] = set()
+        for r in self.registry.list_index_rules(group):
+            if r.type == "inverted":
+                inverted.update(r.tags)
+            elif r.type == "skipping":
+                skipping.update(r.tags)
+        return inverted, skipping
+
+    def _build_part_index(self, group: str, part_dir, meta: dict) -> None:
+        if "stream" not in meta:
+            return
+        from banyandb_tpu.index import element
+
+        inverted, skipping = self._index_tags(group)
+        if inverted or skipping:
+            element.build_part_index(part_dir, inverted, skipping)
 
     def write(self, group: str, name: str, elements: list[ElementValue]) -> int:
         s = self.get_stream(group, name)
@@ -141,8 +173,12 @@ class StreamEngine:
     def _scan(
         self, db: TSDB, s: Stream, req: QueryRequest, conds, shard_ids=None
     ) -> list[tuple]:
+        from banyandb_tpu.index import element
+
         rows: list[tuple] = []
         tag_names = [t.name for t in s.tags]
+        inverted, skipping = self._index_tags(req.groups[0])
+        stats = {"blocks_selected": 0, "blocks_read": 0, "blocks_skipped": 0}
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
         ):
@@ -157,6 +193,14 @@ class StreamEngine:
                     blocks = part.select_blocks(
                         req.time_range.begin_millis, req.time_range.end_millis
                     )
+                    stats["blocks_selected"] += len(blocks)
+                    if blocks and conds and (inverted or skipping):
+                        allowed = element.prune_blocks(
+                            part, conds, inverted, skipping
+                        )
+                        if allowed is not None:
+                            blocks = [b for b in blocks if b in allowed]
+                    stats["blocks_read"] += len(blocks)
                     if blocks:
                         sources.append(
                             part.read(
@@ -167,10 +211,14 @@ class StreamEngine:
                         )
                 for src in sources:
                     rows.extend(self._filter_source(s, src, req, conds))
+        stats["blocks_skipped"] = stats["blocks_selected"] - stats["blocks_read"]
+        self.last_scan_stats = stats
         return rows
 
     def _filter_source(self, s: Stream, src: ColumnData, req: QueryRequest, conds):
-        mask = qfilter.row_mask(
+        from banyandb_tpu.query import stream_exec
+
+        mask = stream_exec.row_mask(
             src, conds, req.time_range.begin_millis, req.time_range.end_millis
         )
         out = []

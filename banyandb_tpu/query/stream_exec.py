@@ -1,0 +1,125 @@
+"""Device scan path for retrieval-shaped (stream/raw) tag filtering.
+
+The aggregate path fuses filtering into the reduce kernel
+(measure_exec); retrieval queries only need the boolean row mask — but
+at stream scale (millions of log elements) evaluating many tag
+predicates per row is still vector work the device does better than
+row-at-a-time host code.  This module jits one mask kernel per predicate
+signature (op kinds + padded row bucket), ships dictionary-code columns,
+and returns a host bool mask; the host keeps the cheap parts (time
+range, gather of the few selected rows).
+
+Semantics match query/filter.row_mask exactly (-1 = literal not in
+dictionary, -2 = column absent); tests/test_stream_index.py fuzzes the
+two against each other.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from banyandb_tpu.api.model import Condition
+from banyandb_tpu.query.filter import tag_value_bytes
+from banyandb_tpu.storage.part import ColumnData
+
+# below this, kernel-launch overhead beats the vector win — host numpy
+DEVICE_MIN_ROWS = 32_768
+
+
+def _pad_bucket(n: int) -> int:
+    """Next power-of-two row bucket (mask sources can be far larger than
+    the 8192-row aggregate chunk; ~log2 distinct kernel shapes total)."""
+    return 1 << max(n - 1, 1).bit_length()
+
+_SUPPORTED = {"eq", "ne", "in", "not_in"}
+
+
+@dataclass(frozen=True)
+class _MaskSpec:
+    preds: tuple[tuple[str, int], ...]  # (op, padded set size)
+    nrows: int
+
+
+_KERNEL_CACHE: dict[_MaskSpec, object] = {}
+
+
+def _build_kernel(spec: _MaskSpec):
+    import jax
+    import jax.numpy as jnp
+
+    from banyandb_tpu import ops
+
+    def kernel(cols, pred_vals):
+        mask = jnp.ones(spec.nrows, dtype=bool)
+        for i, (op, _nv) in enumerate(spec.preds):
+            col = cols[i]
+            v = pred_vals[i]
+            if op in ("in", "not_in"):
+                m = ops.in_set_mask(col, v)
+                mask &= ~m if op == "not_in" else m
+            else:
+                mask &= ops.cmp_mask(col, op, v)
+        return mask
+
+    return jax.jit(kernel)
+
+
+def device_tag_mask(src: ColumnData, conds: list[Condition]):
+    """bool[n] tag-predicate mask on device, or None when the predicate
+    set is unsupported (caller falls back to the host path)."""
+    import jax.numpy as jnp
+
+    n = src.ts.size
+    if not conds or any(c.op not in _SUPPORTED for c in conds):
+        return None
+    nrows = _pad_bucket(n)
+    cols = []
+    pred_vals = []
+    preds = []
+    for c in conds:
+        col = src.tags.get(c.name)
+        if col is None:
+            col = np.full(n, -2, dtype=np.int32)
+        d = src.dicts.get(c.name, [])
+        lut = {v: i for i, v in enumerate(d)}
+        if c.op in ("in", "not_in"):
+            codes = sorted({lut.get(tag_value_bytes(v), -1) for v in c.value})
+            arr = np.asarray(codes or [-1], dtype=np.int32)
+            preds.append((c.op, len(arr)))
+            pred_vals.append(jnp.asarray(arr))
+        else:
+            code = lut.get(tag_value_bytes(c.value), -1)
+            preds.append((c.op, 1))
+            pred_vals.append(jnp.int32(code))
+        # pad with a sentinel that matches nothing real; padded rows are
+        # discarded by the caller's slice anyway
+        padded = np.full(nrows, -3, dtype=np.int32)
+        padded[:n] = col
+        cols.append(jnp.asarray(padded))
+
+    spec = _MaskSpec(preds=tuple(preds), nrows=nrows)
+    kernel = _KERNEL_CACHE.get(spec)
+    if kernel is None:
+        kernel = _KERNEL_CACHE[spec] = _build_kernel(spec)
+    mask = np.asarray(kernel(tuple(cols), tuple(pred_vals)))
+    return mask[:n]
+
+
+def row_mask(
+    src: ColumnData,
+    conds: list[Condition],
+    begin_millis: int,
+    end_millis: int,
+) -> np.ndarray:
+    """Time+tag mask: device for big sources, host otherwise."""
+    from banyandb_tpu.query import filter as qfilter
+
+    if src.ts.size >= DEVICE_MIN_ROWS:
+        tag_mask = device_tag_mask(src, conds)
+        if tag_mask is not None:
+            return (
+                (src.ts >= begin_millis) & (src.ts < end_millis) & tag_mask
+            )
+    return qfilter.row_mask(src, conds, begin_millis, end_millis)

@@ -52,16 +52,36 @@ class Shard:
         root: Path,
         mem_factory: Callable[[], MemTable],
         merge_filter_provider: Optional[Callable] = None,
+        part_built_provider: Optional[Callable] = None,
     ):
         self.root = root
         self.root.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
         self._mem_factory = mem_factory
         self._merge_filter_provider = merge_filter_provider
+        self._part_built_provider = part_built_provider
         self.mem = mem_factory()
         self._epoch = 0
         self._parts: dict[str, Part] = {}
         self._load_snapshot()
+
+    def _notify_part_built(self, part_dir, extra_meta) -> None:
+        """Engine hook (element-index/bloom sidecar builder): sidecars
+        are a pruning optimization, so a failing builder must never fail
+        the flush/merge that produced the part."""
+        if self._part_built_provider is None:
+            return
+        cb = self._part_built_provider()
+        if cb is None:
+            return
+        try:
+            cb(part_dir, extra_meta)
+        except Exception:  # noqa: BLE001
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "part index build failed; part serves unpruned"
+            )
 
     FAILED_PARTS_DIR = "failed-parts"
     FAILED_PARTS_CAP = 16  # quarantined dirs kept (oldest evicted)
@@ -146,6 +166,7 @@ class Shard:
             drained = self.mem.drain()
             self.mem = self._mem_factory()
             names = []
+            built = []
             for _suffix, cols, extra_meta in drained:
                 if cols.ts.size == 0:
                     continue
@@ -164,8 +185,14 @@ class Shard:
                 )
                 self._parts[name] = Part(self.root / name)
                 names.append(name)
+                built.append((self.root / name, extra_meta))
             self._publish()
-            return names
+        # sidecar builds decode whole parts — outside the lock so appends
+        # and publishes don't stall (queries before sidecars exist simply
+        # scan unpruned; pruning is optional)
+        for part_dir, extra_meta in built:
+            self._notify_part_built(part_dir, extra_meta)
+        return names
 
     def merge(
         self,
@@ -246,6 +273,7 @@ class Shard:
             extra_meta=extra_meta,
             payloads=cols.payloads,
         )
+        self._notify_part_built(tmp_dir, extra_meta)
         with self._lock:
             if any(v.name not in self._parts for v in victims):
                 shutil.rmtree(tmp_dir, ignore_errors=True)
@@ -274,6 +302,7 @@ class Segment:
         shard_num: int,
         mem_factory: Callable[[], MemTable],
         merge_filter_provider: Optional[Callable] = None,
+        part_built_provider: Optional[Callable] = None,
     ):
         self.root = root
         self.start = start_millis
@@ -283,6 +312,7 @@ class Segment:
                 root / f"shard-{i}",
                 mem_factory,
                 merge_filter_provider=merge_filter_provider,
+                part_built_provider=part_built_provider,
             )
             for i in range(shard_num)
         ]
@@ -327,6 +357,10 @@ class TSDB:
         # pipeline hook (PIPELINE_EVENT_MERGE analog) — engines set it;
         # Shard.merge applies it after column combine.
         self.merge_filter = None
+        # Optional engine hook: fn(part_dir, extra_meta) called after any
+        # part is fully written (flush and merge) — the stream engine's
+        # element-index/bloom sidecar builder (index/element.py).
+        self.on_part_built = None
         self._reopen()
 
     def _reopen(self) -> None:
@@ -344,6 +378,7 @@ class TSDB:
             self._segments[start] = Segment(
                 seg_dir, start, iv.millis, self.opts.shard_num,
                 self.mem_factory, lambda: self.merge_filter,
+                lambda: self.on_part_built,
             )
 
     def segment_for(self, ts_millis: int, create: bool = True) -> Optional[Segment]:
@@ -359,6 +394,7 @@ class TSDB:
                     self.opts.shard_num,
                     self.mem_factory,
                     lambda: self.merge_filter,
+                    lambda: self.on_part_built,
                 )
                 self._segments[start] = seg
             return seg

@@ -1,0 +1,223 @@
+"""Stream element index + skipping blooms + device scan path
+(VERDICT r1 next #6): TYPE_INVERTED rules build per-part postings,
+TYPE_SKIPPING rules build per-block blooms, queries skip blocks, and
+the device mask kernel matches the host filter exactly."""
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api import (
+    Catalog,
+    Condition,
+    Entity,
+    Group,
+    IndexRule,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    Stream,
+    TagSpec,
+    TagType,
+    TimeRange,
+)
+from banyandb_tpu.models.stream import ElementValue, StreamEngine
+
+T0 = 1_700_000_000_000
+N = 20_000  # > 2 blocks at 8192 rows/block
+
+
+@pytest.fixture()
+def engine(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("sg", Catalog.STREAM, ResourceOpts(shard_num=1)))
+    reg.create_stream(
+        Stream(
+            group="sg",
+            name="logs",
+            tags=(
+                TagSpec("svc", TagType.STRING),
+                TagSpec("level", TagType.STRING),
+            ),
+            entity=("svc",),
+        )
+    )
+    reg.create_index_rule(
+        IndexRule(group="sg", name="svc_idx", tags=("svc",), type="inverted")
+    )
+    reg.create_index_rule(
+        IndexRule(group="sg", name="lvl_skip", tags=("level",), type="skipping")
+    )
+    eng = StreamEngine(reg, tmp_path / "data")
+    rng = np.random.default_rng(21)
+    # svc is the entity -> rows sort by series -> blocks cluster by svc,
+    # which is exactly the layout block-level pruning exploits
+    svc = rng.integers(0, 8, N)
+    elements = [
+        ElementValue(
+            element_id=f"e{i}",
+            ts_millis=T0 + i,
+            tags={
+                "svc": f"s{svc[i]}",
+                "level": "FATAL" if i == 1234 else ("ERROR" if i % 7 == 0 else "INFO"),
+            },
+        )
+        for i in range(N)
+    ]
+    eng.write("sg", "logs", elements)
+    eng.flush()
+    return eng, svc
+
+
+def _req(**kw):
+    d = dict(
+        groups=("sg",),
+        name="logs",
+        time_range=TimeRange(T0, T0 + N + 1),
+        limit=N,
+    )
+    d.update(kw)
+    return QueryRequest(**d)
+
+
+def test_inverted_rule_skips_blocks(engine):
+    eng, svc = engine
+    res = eng.query(_req(criteria=Condition("svc", "eq", "s3")))
+    assert len(res.data_points) == int((svc == 3).sum())
+    stats = eng.last_scan_stats
+    assert stats["blocks_skipped"] > 0, stats
+    assert stats["blocks_read"] < stats["blocks_selected"]
+
+
+def test_skipping_bloom_prunes_rare_value(engine):
+    eng, svc = engine
+    res = eng.query(_req(criteria=Condition("level", "eq", "FATAL")))
+    assert len(res.data_points) == 1
+    stats = eng.last_scan_stats
+    assert stats["blocks_skipped"] > 0, stats
+
+
+def test_pruned_results_match_unpruned(engine, tmp_path):
+    """Pruning is an optimization only: identical results to a rule-free
+    engine over the same data."""
+    eng, svc = engine
+    for cond in [
+        Condition("svc", "in", ["s1", "s5"]),
+        Condition("level", "eq", "ERROR"),
+        Condition("svc", "ne", "s0"),
+    ]:
+        res = eng.query(_req(criteria=cond))
+        # host oracle on raw rows
+        import banyandb_tpu.query.filter as qfilter  # noqa: F401
+
+        got = {dp["element_id"] for dp in res.data_points}
+        want = set()
+        rng = np.random.default_rng(21)
+        svc2 = rng.integers(0, 8, N)
+        for i in range(N):
+            tags = {
+                "svc": f"s{svc2[i]}",
+                "level": "FATAL" if i == 1234 else ("ERROR" if i % 7 == 0 else "INFO"),
+            }
+            if cond.op == "eq":
+                ok = tags[cond.name] == cond.value
+            elif cond.op == "ne":
+                ok = tags[cond.name] != cond.value
+            else:
+                ok = tags[cond.name] in cond.value
+            if ok:
+                want.add(f"e{i}")
+        assert got == want
+
+
+def test_merge_preserves_index(engine):
+    """Merged parts get fresh sidecars (hook fires on merge too)."""
+    eng, svc = engine
+    # second flush -> two parts -> force a merge
+    eng.write(
+        "sg",
+        "logs",
+        [
+            ElementValue(
+                element_id=f"m{i}", ts_millis=T0 + N + i, tags={"svc": "s1", "level": "INFO"}
+            )
+            for i in range(100)
+        ],
+    )
+    eng.flush()
+    db = eng._tsdb("sg")
+    seg = db.select_segments(T0, T0 + N + 200)[0]
+    merged = seg.shards[0].merge(min_merge=2, max_parts=2)
+    assert merged is not None
+    assert (seg.shards[0].root / merged / "eidx_svc.bin").exists()
+    assert (seg.shards[0].root / merged / "tff_level.bin").exists()
+    res = eng.query(_req(criteria=Condition("svc", "eq", "s3"),
+                         time_range=TimeRange(T0, T0 + N + 200)))
+    assert len(res.data_points) == int((svc == 3).sum())
+    assert eng.last_scan_stats["blocks_skipped"] > 0
+
+
+def test_device_path_handles_large_sources():
+    """Regression: sources >= DEVICE_MIN_ROWS (the only ones that take
+    the device branch) must not crash the padding logic."""
+    from banyandb_tpu.query import filter as qfilter
+    from banyandb_tpu.query import stream_exec
+    from banyandb_tpu.storage.part import ColumnData
+
+    n = stream_exec.DEVICE_MIN_ROWS + 1234
+    rng = np.random.default_rng(3)
+    src = ColumnData(
+        ts=np.arange(n, dtype=np.int64),
+        series=np.zeros(n, np.int64),
+        version=np.zeros(n, np.int64),
+        tags={"ta": rng.integers(0, 4, n).astype(np.int32)},
+        fields={},
+        dicts={"ta": [b"a0", b"a1", b"a2", b"a3"]},
+    )
+    conds = [Condition("ta", "eq", "a2")]
+    dev = stream_exec.row_mask(src, conds, 0, n)
+    host = qfilter.row_mask(src, conds, 0, n)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_device_mask_matches_host_fuzz():
+    """stream_exec device kernel == query/filter.row_mask on random data."""
+    from banyandb_tpu.query import filter as qfilter
+    from banyandb_tpu.query import stream_exec
+    from banyandb_tpu.storage.part import ColumnData
+
+    rng = np.random.default_rng(77)
+    for trial in range(10):
+        n = int(rng.integers(1, 5000))
+        dict_a = [f"a{i}".encode() for i in range(8)]
+        dict_b = [f"b{i}".encode() for i in range(4)]
+        src = ColumnData(
+            ts=np.sort(rng.integers(0, 10_000, n)).astype(np.int64),
+            series=np.zeros(n, np.int64),
+            version=np.zeros(n, np.int64),
+            tags={
+                "ta": rng.integers(0, 8, n).astype(np.int32),
+                "tb": rng.integers(0, 4, n).astype(np.int32),
+            },
+            fields={},
+            dicts={"ta": dict_a, "tb": dict_b},
+        )
+        conds = []
+        if rng.random() < 0.8:
+            conds.append(Condition("ta", rng.choice(["eq", "ne"]), f"a{rng.integers(0, 10)}"))
+        if rng.random() < 0.8:
+            conds.append(
+                Condition(
+                    "tb",
+                    rng.choice(["in", "not_in"]),
+                    [f"b{rng.integers(0, 6)}" for _ in range(int(rng.integers(1, 4)))],
+                )
+            )
+        begin, end = 100, 9000
+        host = qfilter.row_mask(src, conds, begin, end)
+        dev_tag = stream_exec.device_tag_mask(src, conds)
+        if conds:
+            assert dev_tag is not None
+            dev = (src.ts >= begin) & (src.ts < end) & dev_tag
+        else:
+            dev = host
+        np.testing.assert_array_equal(dev, host, err_msg=f"trial {trial}")
