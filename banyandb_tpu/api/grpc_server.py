@@ -76,11 +76,26 @@ class WireServices:
             raise ValueError("multi-group queries are not supported yet")
         return ireq.groups[0]
 
+    def _resolve_order(self, group: str, ireq):
+        """order_by from the wire names an INDEX RULE; resolve it to the
+        rule's tag (falling back to direct tag naming when no rule
+        matches — both forms order correctly)."""
+        if not ireq.order_by_tag:
+            return ireq
+        import dataclasses
+
+        for r in self.registry.list_index_rules(group):
+            if r.name == ireq.order_by_tag and r.tags:
+                return dataclasses.replace(ireq, order_by_tag=r.tags[0])
+        return ireq
+
     # -- MeasureService ----------------------------------------------------
     def measure_query(self, req, context):
         try:
             ireq = wire.measure_query_to_internal(req)
-            m = self.registry.get_measure(self._one_group(ireq), ireq.name)
+            group = self._one_group(ireq)
+            m = self.registry.get_measure(group, ireq.name)
+            ireq = self._resolve_order(group, ireq)
             res = self.measure.query(ireq)
             return wire.measure_result_to_pb(m, ireq, res)
         except Exception as e:  # noqa: BLE001 - mapped to gRPC status
@@ -153,7 +168,7 @@ class WireServices:
     def stream_query(self, req, context):
         try:
             ireq = wire.stream_query_to_internal(req)
-            self._one_group(ireq)
+            ireq = self._resolve_order(self._one_group(ireq), ireq)
             res = self.stream.query(ireq)
             return wire.stream_result_to_pb(res)
         except Exception as e:  # noqa: BLE001

@@ -79,6 +79,11 @@ class QueryRequest:
     limit: int = 100
     offset: int = 0
     order_by_ts: str = ""  # "" | asc | desc
+    # order-by-index for retrieval paths (model/v1 QueryOrder with an
+    # index rule naming a tag): sort rows by this tag's value instead of
+    # the timestamp; direction in order_by_dir
+    order_by_tag: str = ""
+    order_by_dir: str = "asc"  # asc | desc (applies to order_by_tag)
     trace: bool = False  # in-band query tracing
     stages: tuple[str, ...] = ()
 

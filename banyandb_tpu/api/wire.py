@@ -200,8 +200,14 @@ def measure_query_to_internal(req) -> im.QueryRequest:
             field_value_sort=_SORT.get(req.top.field_value_sort, "desc"),
         )
     order_by_ts = ""
-    if req.HasField("order_by") and req.order_by.index_rule_name in ("", "timestamp"):
-        order_by_ts = _SORT.get(req.order_by.sort, "")
+    order_by_tag = ""
+    order_by_dir = "asc"
+    if req.HasField("order_by"):
+        if req.order_by.index_rule_name in ("", "timestamp"):
+            order_by_ts = _SORT.get(req.order_by.sort, "")
+        else:  # order-by-index: the rule names the tag to sort by
+            order_by_tag = req.order_by.index_rule_name
+            order_by_dir = _SORT.get(req.order_by.sort, "asc")
     return im.QueryRequest(
         groups=tuple(req.groups),
         name=req.name,
@@ -220,6 +226,8 @@ def measure_query_to_internal(req) -> im.QueryRequest:
         limit=int(req.limit) or 100,
         offset=int(req.offset),
         order_by_ts=order_by_ts,
+        order_by_tag=order_by_tag,
+        order_by_dir=order_by_dir,
         trace=req.trace,
         stages=tuple(req.stages),
     )
@@ -344,8 +352,14 @@ def _positional_tags(fams, tag_families) -> dict[str, object]:
 
 def stream_query_to_internal(req) -> im.QueryRequest:
     order_by_ts = ""
-    if req.HasField("order_by") and req.order_by.index_rule_name in ("", "timestamp"):
-        order_by_ts = _SORT.get(req.order_by.sort, "")
+    order_by_tag = ""
+    order_by_dir = "asc"
+    if req.HasField("order_by"):
+        if req.order_by.index_rule_name in ("", "timestamp"):
+            order_by_ts = _SORT.get(req.order_by.sort, "")
+        else:  # order-by-index: the rule names the tag to sort by
+            order_by_tag = req.order_by.index_rule_name
+            order_by_dir = _SORT.get(req.order_by.sort, "asc")
     return im.QueryRequest(
         groups=tuple(req.groups),
         name=req.name,
@@ -360,6 +374,8 @@ def stream_query_to_internal(req) -> im.QueryRequest:
         limit=int(req.limit) or 100,
         offset=int(req.offset),
         order_by_ts=order_by_ts,
+        order_by_tag=order_by_tag,
+        order_by_dir=order_by_dir,
         trace=req.trace,
         stages=tuple(req.stages),
     )

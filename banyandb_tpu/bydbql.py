@@ -118,7 +118,7 @@ def parse(text: str) -> QueryRequest:
 
 
 def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
-    """-> (catalog, request); catalog is "measure" | "stream"."""
+    """-> (catalog, request); catalog is measure|stream|trace|property."""
     p = _Parser(_tokenize(text))
     p.expect_word("select")
 
@@ -153,7 +153,7 @@ def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
             break
 
     p.expect_word("from")
-    catalog = p.expect_word("measure", "stream")
+    catalog = p.expect_word("measure", "stream", "trace", "property")
     name = p.next()[1]
     p.expect_word("in")
     group = p.next()[1]
@@ -164,6 +164,7 @@ def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
     top = None
     limit, offset = 100, 0
     order_by_ts = ""
+    order_by_tag, order_by_dir = "", "asc"
 
     def add_cond(c: Condition):
         nonlocal criteria
@@ -197,7 +198,13 @@ def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
             else:
                 raise QLError(f"bad TIME operator {op!r}")
         elif kw == "where":
-            while True:
+            # full boolean grammar: OR < AND < ( ... ) < condition
+            def parse_cond():
+                if p.peek() == ("op", "("):
+                    p.next()
+                    e = parse_or()
+                    p.expect_op(")")
+                    return e
                 tag = p.next()[1]
                 neg = p.accept_word("not")
                 if neg and not (p.peek()[0] == "word" and p.peek()[1].lower() == "in"):
@@ -209,15 +216,26 @@ def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
                         p.next()
                         vals.append(p.literal())
                     p.expect_op(")")
-                    add_cond(Condition(tag, "not_in" if neg else "in", vals))
-                else:
-                    kind, op = p.next()
-                    opmap = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
-                    if op not in opmap:
-                        raise QLError(f"bad operator {op!r}")
-                    add_cond(Condition(tag, opmap[op], p.literal()))
-                if not p.accept_word("and"):
-                    break
+                    return Condition(tag, "not_in" if neg else "in", vals)
+                kind, op = p.next()
+                opmap = {"=": "eq", "!=": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}
+                if op not in opmap:
+                    raise QLError(f"bad operator {op!r}")
+                return Condition(tag, opmap[op], p.literal())
+
+            def parse_and():
+                left = parse_cond()
+                while p.accept_word("and"):
+                    left = LogicalExpression("and", left, parse_cond())
+                return left
+
+            def parse_or():
+                left = parse_and()
+                while p.accept_word("or"):
+                    left = LogicalExpression("or", left, parse_and())
+                return left
+
+            add_cond(parse_or())
         elif kw == "group":
             p.expect_word("by")
             tags = [p.next()[1]]
@@ -233,8 +251,13 @@ def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
             top = Top(n, field, sort)
         elif kw == "order":
             p.expect_word("by")
-            p.expect_word("time")
-            order_by_ts = p.accept_word("asc", "desc") or "asc"
+            target = p.next()[1]
+            direction = p.accept_word("asc", "desc") or "asc"
+            if target.lower() == "time":
+                order_by_ts = direction
+            else:  # order-by-index: sort rows by this tag's value
+                order_by_tag = target
+                order_by_dir = direction
         elif kw == "limit":
             limit = int(p.next()[1])
         elif kw == "offset":
@@ -253,4 +276,6 @@ def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
         limit=limit,
         offset=offset,
         order_by_ts=order_by_ts,
+        order_by_tag=order_by_tag,
+        order_by_dir=order_by_dir,
     )

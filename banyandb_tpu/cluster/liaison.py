@@ -28,6 +28,27 @@ from banyandb_tpu.query import measure_exec
 from banyandb_tpu.utils import hashing
 
 
+def _sort_merged_rows(rows: list, req) -> None:
+    """Order scattered rows at the liaison merge: by tag value when the
+    query orders by an indexed tag (rows missing the tag always sort
+    last, regardless of direction), else by timestamp."""
+    if req.order_by_tag:
+        tag = req.order_by_tag
+
+        def key(d):
+            v = d.get("tags", {}).get(tag)
+            # type-ranked key: numerics before strings, never cross-compare
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return (1, 0, str(v))
+            return (0, v, "")
+
+        rows.sort(key=key, reverse=(req.order_by_dir == "desc"))
+        # stable second pass: missing-tag rows to the tail either way
+        rows.sort(key=lambda d: d.get("tags", {}).get(tag, None) is None)
+    else:
+        rows.sort(key=lambda d: d["timestamp"], reverse=(req.order_by_ts != "asc"))
+
+
 class Liaison:
     def __init__(
         self,
@@ -357,9 +378,7 @@ class Liaison:
                     },
                 )
                 rows.extend(r["data_points"])
-            rows.sort(
-                key=lambda d: d["timestamp"], reverse=(req.order_by_ts != "asc")
-            )
+            _sort_merged_rows(rows, req)
             res = QueryResult()
             res.data_points = rows[off : off + limit]
             return res
@@ -445,7 +464,7 @@ class Liaison:
                 {"request": serde.query_request_to_json(node_req), "shards": shards},
             )
             rows.extend(r["data_points"])
-        rows.sort(key=lambda d: d["timestamp"], reverse=(req.order_by_ts != "asc"))
+        _sort_merged_rows(rows, req)
         res = QueryResult()
         # decode back to the native engine contract (body/tags as bytes):
         # cluster and standalone callers see identical shapes

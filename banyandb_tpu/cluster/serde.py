@@ -95,6 +95,8 @@ def query_request_to_json(r: QueryRequest) -> dict:
         "limit": r.limit,
         "offset": r.offset,
         "order_by_ts": r.order_by_ts,
+        "order_by_tag": r.order_by_tag,
+        "order_by_dir": r.order_by_dir,
         "trace": r.trace,
         "stages": list(r.stages),
     }
@@ -120,6 +122,8 @@ def query_request_from_json(d: dict) -> QueryRequest:
         limit=d.get("limit", 100),
         offset=d.get("offset", 0),
         order_by_ts=d.get("order_by_ts", ""),
+        order_by_tag=d.get("order_by_tag", ""),
+        order_by_dir=d.get("order_by_dir", "asc"),
         trace=d.get("trace", False),
         stages=tuple(d.get("stages", ())),
     )

@@ -83,18 +83,45 @@ def build_part_index(
     return built
 
 
+def _sidecar_cache(part: Part) -> dict:
+    """Decoded sidecars cached on the (immutable, long-lived) Part —
+    postings/blooms/LUTs decode once per part, not once per query.
+    A missing sidecar is NOT cached: flush builds sidecars after publish,
+    so an early query must not pin 'absent' forever."""
+    return part.__dict__.setdefault("_element_sidecars", {})
+
+
 def _load_postings(part: Part, tag: str) -> Optional[dict[int, list[int]]]:
+    cache = _sidecar_cache(part)
+    key = ("eidx", tag)
+    if key in cache:
+        return cache[key]
     path = part.dir / f"eidx_{tag}.bin"
     if not path.exists():
         return None
     try:
         raw = json.loads(zst.decompress(path.read_bytes()))
-        return {int(k): v for k, v in raw.items()}
+        out = {int(k): v for k, v in raw.items()}
     except (OSError, ValueError):
         return None
+    cache[key] = out
+    return out
+
+
+def _value_lut(part: Part, tag: str) -> dict[bytes, int]:
+    cache = _sidecar_cache(part)
+    key = ("lut", tag)
+    lut = cache.get(key)
+    if lut is None:
+        lut = cache[key] = {v: i for i, v in enumerate(part.dict_for(tag))}
+    return lut
 
 
 def _load_blooms(part: Part, tag: str) -> Optional[list[Bloom]]:
+    cache = _sidecar_cache(part)
+    key = ("tff", tag)
+    if key in cache:
+        return cache[key]
     path = part.dir / f"tff_{tag}.bin"
     if not path.exists():
         return None
@@ -108,9 +135,10 @@ def _load_blooms(part: Part, tag: str) -> Optional[list[Bloom]]:
             off += 4
             out.append(Bloom.from_bytes(blob[off : off + size]))
             off += size
-        return out
     except (OSError, ValueError, struct.error):
         return None
+    cache[key] = out
+    return out
 
 
 def prune_blocks(
@@ -137,8 +165,7 @@ def prune_blocks(
         if c.name in inverted_tags:
             postings = _load_postings(part, c.name)
             if postings is not None:
-                d = part.dict_for(c.name)
-                lut = {v: i for i, v in enumerate(d)}
+                lut = _value_lut(part, c.name)
                 cand = set()
                 for v in want:
                     code = lut.get(v)

@@ -467,7 +467,7 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
     and stays on host (the reference's row iterator, query.go:594).
     """
     res = QueryResult()
-    conds = measure_exec._collect_conditions(req.criteria)
+    conds, _expr = measure_exec._lower_criteria(req.criteria)
     for c in conds:
         m.tag(c.name)  # schema validation: typo'd tag -> KeyError, matching
         # the aggregate path instead of silently returning unfiltered rows
@@ -475,8 +475,8 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
     for src in sources:
         if src.ts.size == 0:
             continue
-        mask = qfilter.row_mask(
-            src, conds, req.time_range.begin_millis, req.time_range.end_millis
+        mask = qfilter.criteria_mask(
+            src, req.criteria, req.time_range.begin_millis, req.time_range.end_millis
         )
         for i in np.nonzero(mask)[0]:
             tags = {
@@ -488,13 +488,25 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
             fields = {f: float(src.fields[f][i]) for f in src.fields}
             rows.append((int(src.ts[i]), int(src.version[i]), tags, fields))
 
-    # Version dedup then ts ordering, newest-first by default.
+    # Version dedup then ordering: by an indexed tag's value when
+    # order_by_tag is set (order-by-index analog), else by ts.
     best: dict[tuple, tuple] = {}
     for row in rows:
         key = (row[0], tuple(sorted(row[2].items())))
         if key not in best or best[key][1] < row[1]:
             best[key] = row
-    ordered = sorted(best.values(), key=lambda r: r[0], reverse=(req.order_by_ts != "asc"))
+    if req.order_by_tag:
+        have = [r for r in best.values() if r[2].get(req.order_by_tag) is not None]
+        miss = [r for r in best.values() if r[2].get(req.order_by_tag) is None]
+        have.sort(
+            key=lambda r: r[2][req.order_by_tag],
+            reverse=(req.order_by_dir == "desc"),
+        )
+        ordered = have + miss  # missing-tag rows last under either order
+    else:
+        ordered = sorted(
+            best.values(), key=lambda r: r[0], reverse=(req.order_by_ts != "asc")
+        )
     off = req.offset or 0
     for ts, _ver, tags, fields in ordered[off : off + (req.limit or 100)]:
         res.data_points.append({"timestamp": ts, "tags": tags, "fields": fields})

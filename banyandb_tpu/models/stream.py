@@ -145,9 +145,14 @@ class StreamEngine:
         group = req.groups[0]
         s = self.get_stream(group, req.name)
         db = self._tsdb(group)
-        conds = measure_exec._collect_conditions(req.criteria)
-        for c in conds:
+        # leaves validate against the schema; flat AND trees additionally
+        # drive block pruning + the device mask (OR trees evaluate via
+        # the host criteria-tree mask — pruning by AND-intersection would
+        # be wrong under OR)
+        leaves, expr = measure_exec._lower_criteria(req.criteria)
+        for c in leaves:
             s.tag(c.name)
+        conds = leaves if not expr else None
         res = QueryResult()
         rows: list[tuple] = []
         for attempt in range(3):
@@ -157,7 +162,16 @@ class StreamEngine:
             except FileNotFoundError:
                 if attempt == 2:
                     raise
-        rows.sort(key=lambda r: r[0], reverse=(req.order_by_ts != "asc"))
+        if req.order_by_tag:
+            have = [r for r in rows if r[3].get(req.order_by_tag) is not None]
+            miss = [r for r in rows if r[3].get(req.order_by_tag) is None]
+            have.sort(
+                key=lambda r: r[3][req.order_by_tag],
+                reverse=(req.order_by_dir == "desc"),
+            )
+            rows = have + miss  # missing-tag rows last under either order
+        else:
+            rows.sort(key=lambda r: r[0], reverse=(req.order_by_ts != "asc"))
         off = req.offset or 0
         for ts, elem_id, body, tags in rows[off : off + (req.limit or 100)]:
             res.data_points.append(
@@ -218,9 +232,15 @@ class StreamEngine:
     def _filter_source(self, s: Stream, src: ColumnData, req: QueryRequest, conds):
         from banyandb_tpu.query import stream_exec
 
-        mask = stream_exec.row_mask(
-            src, conds, req.time_range.begin_millis, req.time_range.end_millis
-        )
+        if conds is None:  # OR criteria tree: host tree-mask evaluation
+            mask = qfilter.criteria_mask(
+                src, req.criteria,
+                req.time_range.begin_millis, req.time_range.end_millis,
+            )
+        else:
+            mask = stream_exec.row_mask(
+                src, conds, req.time_range.begin_millis, req.time_range.end_millis
+            )
         out = []
         for i in np.nonzero(mask)[0]:
             payload = src.payloads[i] if src.payloads else b"\x00"

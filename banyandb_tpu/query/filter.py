@@ -34,30 +34,58 @@ def decode_tag_value(raw: bytes, tag_type: TagType):
     return raw
 
 
+def _cond_mask(src: ColumnData, c: Condition) -> np.ndarray:
+    """bool[n] mask for one condition over dictionary codes."""
+    col = src.tags.get(c.name)
+    if col is None:
+        # Source predates the tag: the "absent" sentinel (-2) misses
+        # both real codes and the -1 "literal unknown" code.
+        col = np.full(src.ts.shape, -2, dtype=np.int32)
+    d = src.dicts.get(c.name, [])
+    lut = {v: i for i, v in enumerate(d)}
+    if c.op == "eq":
+        return col == lut.get(tag_value_bytes(c.value), -1)
+    if c.op == "ne":
+        return col != lut.get(tag_value_bytes(c.value), -1)
+    if c.op in ("in", "not_in"):
+        codes = {lut.get(tag_value_bytes(v), -1) for v in c.value}
+        inmask = np.isin(col, list(codes))
+        return inmask if c.op == "in" else ~inmask
+    raise NotImplementedError(f"raw-path op {c.op}")
+
+
 def row_mask(
     src: ColumnData,
     conds: list[Condition],
     begin_millis: int,
     end_millis: int,
 ) -> np.ndarray:
-    """bool[n] time-range + tag-predicate mask over one source."""
+    """bool[n] time-range + AND'ed tag-predicate mask over one source."""
     mask = (src.ts >= begin_millis) & (src.ts < end_millis)
     for c in conds:
-        col = src.tags.get(c.name)
-        if col is None:
-            # Source predates the tag: the "absent" sentinel (-2) misses
-            # both real codes and the -1 "literal unknown" code.
-            col = np.full(src.ts.shape, -2, dtype=np.int32)
-        d = src.dicts.get(c.name, [])
-        lut = {v: i for i, v in enumerate(d)}
-        if c.op == "eq":
-            mask &= col == lut.get(tag_value_bytes(c.value), -1)
-        elif c.op == "ne":
-            mask &= col != lut.get(tag_value_bytes(c.value), -1)
-        elif c.op in ("in", "not_in"):
-            codes = {lut.get(tag_value_bytes(v), -1) for v in c.value}
-            inmask = np.isin(col, list(codes))
-            mask &= inmask if c.op == "in" else ~inmask
-        else:
-            raise NotImplementedError(f"raw-path op {c.op}")
+        mask &= _cond_mask(src, c)
     return mask
+
+
+def criteria_mask(
+    src: ColumnData,
+    criteria,
+    begin_millis: int,
+    end_millis: int,
+) -> np.ndarray:
+    """bool[n] time-range + FULL criteria-tree mask (AND/OR) — the host
+    twin of the device expr lowering (measure_exec._lower_criteria)."""
+    from banyandb_tpu.api.model import LogicalExpression
+
+    mask = (src.ts >= begin_millis) & (src.ts < end_millis)
+    if criteria is None:
+        return mask
+
+    def walk(node) -> np.ndarray:
+        if isinstance(node, Condition):
+            return _cond_mask(src, node)
+        assert isinstance(node, LogicalExpression), node
+        left, right = walk(node.left), walk(node.right)
+        return (left & right) if node.op == "and" else (left | right)
+
+    return mask & walk(criteria)

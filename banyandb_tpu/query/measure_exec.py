@@ -80,6 +80,11 @@ class PlanSpec:
     hist_field: str = ""  # non-empty -> also emit histogram partials
     nrows: int = CHUNK
     group_method: str = "auto"  # ops.group_reduce method override
+    # predicate expression tree over `preds`: ("p", i) leaves combined by
+    # ("and", l, r) / ("or", l, r) nodes — the device lowering of a full
+    # model/v1 Criteria tree (pkg/query/logical analog). () = AND of all
+    # preds (the common flat case keeps its original plan signature).
+    expr: tuple = ()
 
 
 _KERNEL_CACHE: dict[PlanSpec, object] = {}
@@ -90,18 +95,31 @@ def _build_kernel(spec: PlanSpec):
 
     def kernel(chunk: dict, pred_vals: dict, hist_lo, hist_span):
         valid = chunk["valid"]
-        masks = [valid]
-        for i, p in enumerate(spec.preds):
+
+        def pred_mask(i: int):
+            p = spec.preds[i]
             col = chunk["tags_code"][p.name]
             v = pred_vals[f"p{i}"]
             if p.kind == "lut":
-                masks.append(jnp.take(v, col, mode="clip"))
-            elif p.op in ("in", "not_in"):
+                return jnp.take(v, col, mode="clip")
+            if p.op in ("in", "not_in"):
                 m = ops.in_set_mask(col, v)
-                masks.append(~m if p.op == "not_in" else m)
-            else:
-                masks.append(ops.cmp_mask(col, p.op, v))
-        mask = ops.mask_and(*masks)
+                return ~m if p.op == "not_in" else m
+            return ops.cmp_mask(col, p.op, v)
+
+        def eval_expr(node):
+            if node[0] == "p":
+                return pred_mask(node[1])
+            left = eval_expr(node[1])
+            right = eval_expr(node[2])
+            return (left & right) if node[0] == "and" else (left | right)
+
+        if spec.expr:
+            mask = valid & eval_expr(spec.expr)
+        else:  # flat AND of all preds (original plan shape)
+            mask = ops.mask_and(
+                valid, *[pred_mask(i) for i in range(len(spec.preds))]
+            )
 
         key_cols = [chunk["tags_code"][t] for t in spec.group_tags]
         if key_cols:
@@ -232,15 +250,48 @@ def _tag_value_bytes(v) -> bytes:
 
 
 def _collect_conditions(c: Optional[Criteria]) -> list[Condition]:
-    """Flatten an AND-tree; OR is handled by the logical planner later."""
+    """Flatten an AND-tree; callers needing OR use _lower_criteria."""
     if c is None:
         return []
     if isinstance(c, Condition):
         return [c]
     assert isinstance(c, LogicalExpression)
     if c.op != "and":
-        raise NotImplementedError("OR criteria not yet supported on device")
+        raise NotImplementedError(
+            "AND-only path; OR criteria lower via _lower_criteria"
+        )
     return _collect_conditions(c.left) + _collect_conditions(c.right)
+
+
+def _lower_criteria(c: Optional[Criteria]) -> tuple[list[Condition], tuple]:
+    """Full Criteria tree -> (predicate leaves, index expression tree).
+
+    Pure-AND trees return expr=() so the common flat case keeps its
+    original plan signature (jit-cache stability); OR anywhere produces
+    a nested ("and"|"or", left, right) tree over ("p", i) leaves that
+    the kernel evaluates as mask algebra (union of in-set masks — the
+    device lowering of pkg/query/logical's OR nodes)."""
+    conds: list[Condition] = []
+
+    def walk(node):
+        if isinstance(node, Condition):
+            conds.append(node)
+            return ("p", len(conds) - 1)
+        assert isinstance(node, LogicalExpression), node
+        if node.op not in ("and", "or"):
+            raise ValueError(f"unknown logical op {node.op!r}")
+        return (node.op, walk(node.left), walk(node.right))
+
+    if c is None:
+        return [], ()
+    expr = walk(c)
+
+    def pure_and(n) -> bool:
+        return n[0] == "p" or (
+            n[0] == "and" and pure_and(n[1]) and pure_and(n[2])
+        )
+
+    return conds, (() if pure_and(expr) else expr)
 
 
 @dataclass
@@ -295,7 +346,7 @@ def compute_partials(
     gathered chunks keyed by part identities — repeat queries skip the
     whole host gather.
     """
-    conds = _collect_conditions(request.criteria)
+    conds, expr = _lower_criteria(request.criteria)
     group_tags = tuple(request.group_by.tag_names) if request.group_by else ()
     agg = request.agg
 
@@ -438,6 +489,7 @@ def compute_partials(
         want_minmax=want_minmax,
         hist_field=hist_field,
         nrows=nrows,
+        expr=expr,
     )
     kernel = _KERNEL_CACHE.get(spec)
     if kernel is None:
@@ -770,6 +822,11 @@ def finalize_partials(
             sel = np.argsort(-metric, kind="stable")[:k]
         group_ids = sel
 
+    # offset/limit paging over the (possibly top-N-ranked) group list —
+    # offset semantics match the reference's QueryRequest.offset
+    off = request.offset or 0
+    if off:
+        group_ids = group_ids[off:]
     group_ids = group_ids[: request.limit] if request.limit else group_ids
 
     # Decode group tuples (bytes) to client values via the schema types.
