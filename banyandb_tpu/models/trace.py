@@ -19,7 +19,9 @@ import numpy as np
 
 from banyandb_tpu.api.model import QueryRequest, QueryResult, TimeRange
 from banyandb_tpu.api.schema import SchemaRegistry, TagType
-from banyandb_tpu.index.inverted import Doc, InvertedIndex
+from banyandb_tpu.index.sidx import SidxStore
+from banyandb_tpu.index.sidx import decode_ref as sidx_decode_ref
+from banyandb_tpu.index.sidx import encode_ref as sidx_encode_ref
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.storage.memtable import PayloadMemtable
 from banyandb_tpu.storage.part import ColumnData
@@ -50,20 +52,15 @@ def trace_shard_id(trace_id: str, shard_num: int) -> int:
 
 class TraceEngine:
     def __init__(self, registry: SchemaRegistry, root: str | Path):
-        import os
-
         import threading
 
         self.registry = registry
         self.root = Path(root) / "trace"
         self._tsdbs: dict[str, TSDB] = {}
         self._tsdb_lock = threading.Lock()
-        # ordered-index instances per (group, segment-start, rule-tag)
-        self._sidx: dict[tuple, InvertedIndex] = {}
-        # doc-id uniqueness across spans sharing (trace, ts): monotonic seq
-        # salted per engine instance so restarts don't re-mint old ids
-        self._doc_salt = os.urandom(8)
-        self._doc_seq = 0
+        # ordered-index stores per (group, segment-start, rule-tag): the
+        # part-based sidx (index/sidx.py, interfaces.go:58 analog)
+        self._sidx: dict[tuple, SidxStore] = {}
         # tail-sampling pipeline (post-trace-pipeline analog)
         from banyandb_tpu.models.trace_pipeline import TracePipelineRegistry
 
@@ -89,12 +86,12 @@ class TraceEngine:
                 self._tsdbs[group] = db
             return db
 
-    def _ordered_index(self, group: str, seg, rule_tag: str) -> InvertedIndex:
+    def _ordered_index(self, group: str, seg, rule_tag: str) -> SidxStore:
         with self._tsdb_lock:
             key = (group, seg.start, rule_tag)
             idx = self._sidx.get(key)
             if idx is None:
-                idx = InvertedIndex(seg.root / f"sidx-{rule_tag}.idx")
+                idx = SidxStore(seg.root / f"sidx-{rule_tag}")
                 self._sidx[key] = idx
             return idx
 
@@ -135,24 +132,8 @@ class TraceEngine:
                 v = sp.tags.get(rt)
                 if v is None:
                     continue
-                idx = self._ordered_index(group, seg, rt)
-                self._doc_seq += 1
-                doc_id = hashing.series_id(
-                    [
-                        name.encode(),
-                        trace_id.encode(),
-                        sp.ts_millis.to_bytes(8, "little"),
-                        self._doc_salt + self._doc_seq.to_bytes(8, "little"),
-                    ]
-                )
-                idx.insert(
-                    [
-                        Doc(
-                            doc_id=doc_id,
-                            keywords={"@trace": trace_id.encode()},
-                            numerics={rt: int(v), "@ts": sp.ts_millis},
-                        )
-                    ]
+                self._ordered_index(group, seg, rt).insert(
+                    int(v), sidx_encode_ref(trace_id, sp.ts_millis)
                 )
             n += 1
         return n
@@ -163,8 +144,9 @@ class TraceEngine:
             if group is None or gname == group:
                 out.extend(db.flush_all())
                 self._write_blooms(db, gname)
-        for idx in self._sidx.values():
-            idx.persist()
+        for idx in list(self._sidx.values()):
+            idx.flush()
+            idx.merge()
         return out
 
     def _write_blooms(self, db: TSDB, group: str) -> None:
@@ -266,19 +248,37 @@ class TraceEngine:
         rewritten by merge gating); cost is one span lookup per
         candidate, bounded by `limit`.
         """
+        import heapq
+
         db = self._tsdb(group)
-        seen: list[str] = []
-        for seg in db.select_segments(time_range.begin_millis, time_range.end_millis):
-            idx = self._ordered_index(group, seg, order_tag)
-            ids = idx.range_ordered(order_tag, lo, hi, asc=asc)
-            for doc_id in ids.tolist():
-                d = idx.get(doc_id)
-                if d is None:
-                    continue
-                ts = d.numerics.get("@ts", 0)
+        # One key-ordered stream per overlapping segment, heap-merged so
+        # the global order holds across segment boundaries.  Per-segment
+        # fetch starts at 4x limit (headroom for duplicates / dead
+        # candidates) and grows adaptively: if fewer than `limit` live
+        # ids survive while some segment's stream was truncated at its
+        # cap, the fetch quadruples and the scan repeats — heavy
+        # tail-sampling kill rates never starve the result below what
+        # actually exists.  sidx block pruning keeps reads key-relevant.
+        segs = db.select_segments(time_range.begin_millis, time_range.end_millis)
+        fetch = max(limit, 1) * 4
+        while True:
+            self.last_sidx_blocks_read = 0
+            streams = []
+            truncated = False
+            for seg in segs:
+                st = self._ordered_index(group, seg, order_tag)
+                chunk = st.range_query(lo, hi, asc=asc, limit=fetch)
+                truncated = truncated or len(chunk) >= fetch
+                streams.append(iter(chunk))
+                self.last_sidx_blocks_read += st.last_blocks_read
+            merged = heapq.merge(
+                *streams, key=lambda kp: kp[0] if asc else -kp[0]
+            )
+            seen: list[str] = []
+            for _k, payload in merged:
+                tid, ts = sidx_decode_ref(payload)
                 if not (time_range.begin_millis <= ts < time_range.end_millis):
                     continue
-                tid = d.keywords["@trace"].decode()
                 if tid in seen:
                     continue
                 if verify_live and not self.query_by_trace_id(group, name, tid):
@@ -286,7 +286,9 @@ class TraceEngine:
                 seen.append(tid)
                 if len(seen) >= limit:
                     return seen
-        return seen
+            if not truncated:
+                return seen
+            fetch *= 4
 
     def _row_to_span(self, t: Trace, src: ColumnData, i: int) -> dict:
         from banyandb_tpu.query import filter as qfilter

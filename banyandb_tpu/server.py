@@ -290,14 +290,35 @@ class StandaloneServer:
         def span_matches(span: dict, conds) -> bool:
             for c in conds:
                 v = span.get("tags", {}).get(c.name)
-                if c.op == "eq" and v != c.value:
-                    return False
-                if c.op == "ne" and v == c.value:
-                    return False
-                if c.op == "in" and v not in c.value:
-                    return False
-                if c.op == "not_in" and v in c.value:
-                    return False
+                if c.op == "eq":
+                    if v != c.value:
+                        return False
+                elif c.op == "ne":
+                    if v == c.value:
+                        return False
+                elif c.op == "in":
+                    if v not in c.value:
+                        return False
+                elif c.op == "not_in":
+                    if v in c.value:
+                        return False
+                elif c.op in ("gt", "ge", "lt", "le"):
+                    if v is None:
+                        return False
+                    try:
+                        fv, fc = float(v), float(c.value)
+                    except (TypeError, ValueError):
+                        return False
+                    if c.op == "gt" and not fv > fc:
+                        return False
+                    if c.op == "ge" and not fv >= fc:
+                        return False
+                    if c.op == "lt" and not fv < fc:
+                        return False
+                    if c.op == "le" and not fv <= fc:
+                        return False
+                else:  # never silently match an op we can't evaluate
+                    raise ValueError(f"trace QL op {c.op!r} not supported")
             return True
 
         tid_conds = [c for c in leaves if c.name == "trace_id" and c.op == "eq"]
