@@ -264,6 +264,10 @@ class SchemaRegistry:
         self._root = Path(root) / "schema" if root else None
         self._revision = 0
         self._store: dict[str, dict[str, object]] = {k: {} for k in _KINDS}
+        # per-object local revisions (barrier freshness checks); NOT
+        # persisted — after restart objects report rev 0, forcing the
+        # barrier to match by content hash
+        self._obj_revs: dict[tuple[str, str], int] = {}
         self._watchers: list = []
         if self._root and self._root.exists():
             self._load()
@@ -296,6 +300,7 @@ class SchemaRegistry:
         with self._lock:
             self._revision += 1
             self._store[kind][self._key(obj)] = obj
+            self._obj_revs[(kind, self._key(obj))] = self._revision
             self._persist(kind)
             for w in self._watchers:
                 w(kind, obj, self._revision)
@@ -320,6 +325,29 @@ class SchemaRegistry:
     @property
     def revision(self) -> int:
         return self._revision
+
+    @staticmethod
+    def object_hash(obj) -> str:
+        """Content hash of one schema object (barrier ack verification —
+        revisions are per-node counters, so equality of numbers proves
+        nothing; equality of content does)."""
+        import hashlib
+        import json as _json
+
+        payload = _json.dumps(_to_jsonable(obj), sort_keys=True)
+        return hashlib.blake2b(payload.encode(), digest_size=16).hexdigest()
+
+    def stored_object_hash(self, kind: str, key: str) -> dict:
+        """-> {hash, rev}: rev is this node's LOCAL per-object revision
+        (0 after a restart — reloaded objects must then match by hash,
+        which is exactly the stale-restart case the barrier closes)."""
+        with self._lock:
+            obj = self._store[kind].get(key)
+            rev = self._obj_revs.get((kind, key), 0)
+        return {
+            "hash": None if obj is None else self.object_hash(obj),
+            "rev": rev,
+        }
 
     def watch(self, callback) -> None:
         """callback(kind, obj, revision) on every create/update."""

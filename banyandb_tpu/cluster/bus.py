@@ -27,6 +27,7 @@ class Topic(str, enum.Enum):
     PROPERTY_QUERY = "property-query"
     # schema + control plane
     SCHEMA_SYNC = "schema-sync"
+    SCHEMA_GET = "schema-get"  # barrier verification: per-object hash
     HEALTH = "health"
     # chunked part sync (cluster/v1/rpc.proto SyncPart analog)
     SYNC_PART = "sync-part"

@@ -32,6 +32,9 @@ class DataNode:
         self.stream = StreamEngine(registry, self.root)
         self.trace = TraceEngine(registry, self.root)
         self.bus = LocalBus()
+        from banyandb_tpu.admin.diskmonitor import DiskMonitor
+
+        self.disk = DiskMonitor(self.root)
         self._sync_sessions: dict[str, dict] = {}
         # abandoned chunked-sync sessions from a previous process die here
         shutil.rmtree(self.root / ".sync-staging", ignore_errors=True)
@@ -54,6 +57,12 @@ class DataNode:
             },
         )
         self.bus.subscribe(Topic.SCHEMA_SYNC, self._on_schema_sync)
+        self.bus.subscribe(
+            Topic.SCHEMA_GET,
+            lambda env: self.registry.stored_object_hash(
+                env["kind"], env["key"]
+            ),
+        )
         self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
 
     # -- stream plane (stream svc_data analog) ------------------------------
@@ -66,6 +75,7 @@ class DataNode:
                 self.stream.get_stream(item["group"], item["name"])
             except KeyError:
                 self.stream.create_stream(serde.stream_schema_from_json(item))
+        self.disk.check_write()
         n = self.stream.write(
             env["group"], env["name"], serde.elements_from_json(env["elements"])
         )
@@ -105,6 +115,7 @@ class DataNode:
                 self.trace.get_trace(item["group"], item["name"])
             except KeyError:
                 self.trace.create_trace(serde.trace_schema_from_json(item))
+        self.disk.check_write()
         n = self.trace.write(
             env["group"], env["name"], serde.spans_from_json(env["spans"]),
             ordered_tags=tuple(env.get("ordered_tags", ())),
@@ -126,6 +137,7 @@ class DataNode:
 
     # -- write plane --------------------------------------------------------
     def _on_measure_write(self, env: dict) -> dict:
+        self.disk.check_write()
         req = serde.write_request_from_json(env["request"])
         n = self.measure.write(req)
         return {"written": n}
@@ -153,8 +165,8 @@ class DataNode:
         kind = env["kind"]
         cls = schema_mod._KINDS[kind]
         obj = schema_mod._from_jsonable(cls, env["item"])
-        self.registry._put(kind, obj)
-        return {"revision": self.registry.revision}
+        rev = self.registry._put(kind, obj)
+        return {"revision": self.registry.revision, "obj_rev": rev}
 
     # -- chunked part sync (sub/chunked_sync.go analog) ----------------------
     def _on_sync_part(self, env: dict) -> dict:
@@ -232,6 +244,7 @@ class DataNode:
         import json as _json
         import uuid as _uuid
 
+        self.disk.check_write()
         for pi, files in parts:
             if "metadata.json" not in files:
                 raise ValueError("part missing metadata.json")

@@ -111,20 +111,22 @@ class Liaison:
         return alive
 
     # -- schema push + barrier ---------------------------------------------
-    def sync_schema(self, kind: str, obj) -> dict[str, int]:
+    def sync_schema(self, kind: str, obj) -> dict[str, dict]:
         """Push one schema object to all nodes; down nodes get the sync
         spooled through hinted handoff (they catch up at recovery).
 
-        -> {node: that node's LOCAL registry revision after applying} —
-        the acks a later schema_barrier() verifies against.  Per-node
-        revisions are independent counters (there is no shared etcd
-        sequence here), so the barrier contract is ack-based, not a
-        global number.
+        -> {node: ack} where ack carries the node's LOCAL revision AND
+        the object's content hash + identity.  Revisions are per-node
+        counters (no shared etcd sequence), so a node that restarted
+        with an older registry can report a coincidentally-equal number
+        — the barrier therefore verifies CONTENT, not counters.
         """
-        from banyandb_tpu.api.schema import _to_jsonable
+        from banyandb_tpu.api.schema import SchemaRegistry, _to_jsonable
 
         env = {"kind": kind, "item": _to_jsonable(obj)}
-        acks: dict[str, int] = {}
+        want_hash = SchemaRegistry.object_hash(obj)
+        key = self.registry._key(obj)
+        acks: dict[str, dict] = {}
         for n in self.selector.nodes:
             if n.name not in self.alive:
                 if self.handoff is not None:
@@ -132,7 +134,13 @@ class Liaison:
                 continue
             try:
                 r = self.transport.call(n.addr, Topic.SCHEMA_SYNC.value, env)
-                acks[n.name] = r.get("revision", 0)
+                acks[n.name] = {
+                    "revision": r.get("revision", 0),
+                    "obj_rev": r.get("obj_rev", 0),
+                    "hash": want_hash,
+                    "kind": kind,
+                    "key": key,
+                }
             except TransportError:
                 self.alive.discard(n.name)
                 if self.handoff is not None:
@@ -141,24 +149,35 @@ class Liaison:
                     raise
         return acks
 
-    def schema_barrier(self, acks: dict[str, int], timeout_s: float = 10.0) -> bool:
-        """Block until every acked node still reports a registry revision
-        >= its ack (schema/v1/barrier.proto + barrier_cluster.go analog:
-        await cluster-wide application).  A node that stops answering
-        HEALTH counts as BEHIND — unreachable is exactly the window the
-        barrier exists to close.  Returns False on timeout."""
+    def schema_barrier(self, acks: dict[str, dict], timeout_s: float = 10.0) -> bool:
+        """Block until every acked node serves the synced object with the
+        EXPECTED CONTENT HASH (schema/v1/barrier.proto +
+        barrier_cluster.go analog).  A node that stops answering counts
+        as BEHIND — unreachable is exactly the window the barrier exists
+        to close.  Returns False on timeout."""
         import time as _time
 
         deadline = _time.monotonic() + timeout_s
         addr_of = {n.name: n.addr for n in self.selector.nodes}
         while True:
             behind = []
-            for name, want in acks.items():
+            for name, ack in acks.items():
                 try:
                     r = self.transport.call(
-                        addr_of[name], Topic.HEALTH.value, {}, timeout=5
+                        addr_of[name],
+                        Topic.SCHEMA_GET.value,
+                        {"kind": ack["kind"], "key": ack["key"]},
+                        timeout=5,
                     )
-                    if r.get("schema_revision", 0) < want:
+                    # Passed when the node serves OUR content, or a
+                    # strictly NEWER local revision of the same object (a
+                    # later sync already superseded this one — the node
+                    # is ahead, not behind).  A stale restart reports
+                    # obj rev 0, so it can only pass by content match.
+                    fresh = r.get("hash") == ack["hash"] or (
+                        r.get("rev", 0) > ack["obj_rev"]
+                    )
+                    if not fresh:
                         behind.append(name)
                 except TransportError:
                     behind.append(name)
@@ -273,19 +292,32 @@ class Liaison:
         """Shared write-plane delivery contract (all three models):
         - in-flight TransportError marks the node dead + spools (ordering
           preserved via the handoff spool);
+        - a node SHEDDING LOAD (DiskFull / ServerBusy rejection) is NOT
+          dead: it stays alive, nothing is spooled for it (replaying
+          into a full disk just grows the spool), and the retryable
+          rejection propagates to the caller when no replica accepted;
         - zero successful wire deliveries -> raise (a spool alone is a
           bounded cache, not durable storage);
         - known-down replica copies (spool_env) land in the spool so a
           recovered node replays the whole outage window."""
         delivered_to: set[str] = set()
         failed: dict[str, dict] = {}
+        shed: list[str] = []
+        first_shed: Optional[TransportError] = None
         for name, env in by_node_env.items():
             try:
                 self.transport.call(addr_of[name], topic, env)
                 delivered_to.add(name)
-            except TransportError:
+            except TransportError as e:
+                # the bus serializes remote errors as "<Type>: <msg>"
+                if "DiskFull" in str(e) or "ServerBusy" in str(e):
+                    shed.append(name)
+                    first_shed = first_shed or e
+                    continue
                 self.alive.discard(name)
                 failed[name] = env
+        if not delivered_to and first_shed is not None and not failed:
+            raise first_shed
         if not delivered_to and failed:
             raise TransportError(
                 f"write reached no replica (failed: {sorted(failed)})"

@@ -38,6 +38,9 @@ class SidxStore:
         self.root.mkdir(parents=True, exist_ok=True)
         self._lock = threading.Lock()
         self._merge_lock = threading.Lock()  # one merge at a time
+        self._flush_lock = threading.Lock()  # one flush at a time (two
+        # concurrent flushes would duplicate the mem prefix, then the
+        # double trim deletes elements that reached no part)
         self._mem_keys: list[int] = []
         self._mem_payloads: list[bytes] = []
         self._epoch = 0
@@ -74,6 +77,10 @@ class SidxStore:
         return n + sum(p.total_count for p in self._parts.values())
 
     def flush(self) -> Optional[str]:
+        with self._flush_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> Optional[str]:
         # mem is only TRIMMED after the part registers (same lock), so a
         # concurrent range_query always sees every element in exactly one
         # of (mem prefix, new part) — no invisible window mid-flush.

@@ -92,6 +92,9 @@ class StandaloneServer:
         self.meter = Meter("banyandb")
         self.self_metrics = SelfMeasureSink(self.meter, self.measure)
         self.protector = MemoryProtector()
+        from banyandb_tpu.admin.diskmonitor import DiskMonitor
+
+        self.disk = DiskMonitor(self.root)
         self.access_log = AccessLog(self.root / "logs" / "access.log")
         self.bus = LocalBus()
         self._register()
@@ -139,8 +142,10 @@ class StandaloneServer:
     def _measure_write(self, env):
         req = serde.write_request_from_json(env["request"])
         size = len(req.points) * _POINT_BYTES
-        # write-side admission control (protector.AcquireResource analog):
-        # shed load with ServerBusy instead of OOMing under pressure
+        # write-side admission control (protector.AcquireResource +
+        # disk_monitor.go:86 analogs): shed load with ServerBusy /
+        # DiskFull instead of OOMing or filling the data filesystem
+        self.disk.check_write()
         self.protector.acquire(size)
         t0 = time.perf_counter()
         try:
@@ -203,6 +208,7 @@ class StandaloneServer:
         )
 
     def _stream_write(self, env):
+        self.disk.check_write()
         n = self.stream.write(
             env["group"], env["name"], serde.elements_from_json(env["elements"])
         )
@@ -213,6 +219,7 @@ class StandaloneServer:
         return {"result": result_to_json(self.stream.query(req))}
 
     def _trace_write(self, env):
+        self.disk.check_write()
         n = self.trace.write(
             env["group"], env["name"], serde.spans_from_json(env["spans"]),
             ordered_tags=tuple(env.get("ordered_tags", ())),
@@ -226,6 +233,7 @@ class StandaloneServer:
         return {"spans": serde.spans_to_json(spans)}
 
     def _property_apply(self, env):
+        self.disk.check_write()
         p = self.property.apply(
             Property(
                 group=env["group"], name=env["name"], id=env["id"],
@@ -338,10 +346,13 @@ class StandaloneServer:
             residual = []
             for c in leaves:
                 if c.name == req.order_by_tag and c.op in ("gt", "ge", "lt", "le"):
+                    # duplicate bounds INTERSECT (AND semantics)
                     if c.op in ("gt", "ge"):
-                        lo = int(c.value) + (1 if c.op == "gt" else 0)
+                        b = int(c.value) + (1 if c.op == "gt" else 0)
+                        lo = b if lo is None else max(lo, b)
                     else:
-                        hi = int(c.value) - (1 if c.op == "lt" else 0)
+                        b = int(c.value) - (1 if c.op == "lt" else 0)
+                        hi = b if hi is None else min(hi, b)
                 else:
                     residual.append(c)
             tr = TimeRange(req.time_range.begin_millis, req.time_range.end_millis)
