@@ -60,11 +60,21 @@ class WireServices:
     """Service handlers bound to the engines (StandaloneServer-compatible:
     any object exposing .registry/.measure/.stream works)."""
 
-    def __init__(self, registry, measure_engine, stream_engine, bydbql_fn=None):
+    def __init__(
+        self,
+        registry,
+        measure_engine,
+        stream_engine,
+        bydbql_fn=None,
+        property_engine=None,
+        trace_engine=None,
+    ):
         self.registry = registry
         self.measure = measure_engine
         self.stream = stream_engine
         self.bydbql_fn = bydbql_fn
+        self.property = property_engine
+        self.trace = trace_engine
 
     @staticmethod
     def _one_group(ireq) -> str:
@@ -188,6 +198,205 @@ class WireServices:
                 resp.status = "STATUS_NOT_FOUND"
             except Exception:  # noqa: BLE001
                 log.exception("stream write failed")
+                resp.status = "STATUS_INTERNAL_ERROR"
+            resp.metadata.CopyFrom(wreq.metadata)
+            yield resp
+
+    # -- PropertyService ---------------------------------------------------
+    def property_apply(self, req, context):
+        try:
+            if self.property is None:
+                raise ValueError("property engine not wired")
+            from banyandb_tpu.models.property import Property
+
+            p = req.property
+            tags = {t.key: wire.tag_value_to_py(t.value) for t in p.tags}
+            stored = self.property.apply(
+                Property(
+                    group=p.metadata.group,
+                    name=p.metadata.name,
+                    id=p.id,
+                    tags=tags,
+                ),
+                strategy="replace" if req.strategy == 2 else "merge",
+            )
+            return pb.property_rpc_pb2.ApplyResponse(
+                created=stored.create_revision == stored.mod_revision,
+                tags_num=len(stored.tags),
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def property_delete(self, req, context):
+        try:
+            if self.property is None:
+                raise ValueError("property engine not wired")
+            ok = self.property.delete(req.group, req.name, req.id)
+            return pb.property_rpc_pb2.DeleteResponse(deleted=ok)
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def property_query(self, req, context):
+        try:
+            if self.property is None:
+                raise ValueError("property engine not wired")
+            self._one_group(req)
+            tag_filters = {}
+            if req.HasField("criteria"):
+                crit = wire.criteria_to_internal(req.criteria)
+                from banyandb_tpu.query.measure_exec import _lower_criteria
+
+                leaves, expr = _lower_criteria(crit)
+                if expr:
+                    raise ValueError("property queries take AND criteria only")
+                for c in leaves:
+                    if c.op != "eq":
+                        raise ValueError("property criteria support eq only")
+                    tag_filters[c.name] = c.value
+            props = self.property.query(
+                req.groups[0],
+                req.name,
+                tag_filters=tag_filters or None,
+                ids=list(req.ids) or None,
+                limit=int(req.limit) or 100,
+            )
+            out = pb.property_rpc_pb2.QueryResponse()
+            proj = set(req.tag_projection)
+            for p in props:
+                m = out.properties.add()
+                m.metadata.group = p.group
+                m.metadata.name = p.name
+                m.metadata.mod_revision = p.mod_revision
+                m.id = p.id
+                for k, v in p.tags.items():
+                    if proj and k not in proj:
+                        continue
+                    t = m.tags.add(key=k)
+                    t.value.CopyFrom(wire.py_to_tag_value(v))
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    # -- TraceService ------------------------------------------------------
+    def trace_query(self, req, context):
+        """trace/v1 Query: trace_id equality fetches that trace's spans
+        (the span-store lookup; broader criteria land with the sidx
+        order-by surface)."""
+        try:
+            if self.trace is None:
+                raise ValueError("trace engine not wired")
+            group = self._one_group(req)
+            crit = (
+                wire.criteria_to_internal(req.criteria)
+                if req.HasField("criteria")
+                else None
+            )
+            from banyandb_tpu.query.measure_exec import _lower_criteria
+
+            leaves, expr = _lower_criteria(crit)
+            if expr:
+                raise ValueError("trace queries take AND criteria only")
+            t_schema = self.registry.get_trace(group, req.name)
+            tid_conds = [
+                c
+                for c in leaves
+                if c.name == t_schema.trace_id_tag and c.op == "eq"
+            ]
+            if not tid_conds:
+                raise ValueError(
+                    f"trace query needs {t_schema.trace_id_tag} = <id>"
+                )
+            spans = self.trace.query_by_trace_id(
+                group, req.name, str(tid_conds[0].value)
+            )
+            out = pb.trace_query_pb2.QueryResponse()
+            if spans:
+                tr = out.traces.add()
+                tr.trace_id = str(tid_conds[0].value)
+                proj = set(req.tag_projection)
+                for s in spans[: int(req.limit) or 100]:
+                    sp = tr.spans.add()
+                    sp.span = s.get("span", b"")
+                    for k, v in s.get("tags", {}).items():
+                        if proj and k not in proj:
+                            continue
+                        t = sp.tags.add(key=k)
+                        try:
+                            ttype = t_schema.tag(k).type
+                        except KeyError:
+                            ttype = None
+                        t.value.CopyFrom(wire.py_to_tag_value(v, ttype))
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def _ordered_tags(self, group: str, t_schema) -> tuple[str, ...]:
+        """Tree-rule tags applying to this trace schema, cached per
+        (group, trace) and invalidated by registry revision — the rule
+        scan must not run once per streamed span write."""
+        cache = getattr(self, "_ordered_tags_cache", None)
+        if cache is None:
+            cache = self._ordered_tags_cache = {}
+        key = (group, t_schema.name)
+        rev = self.registry.revision
+        hit = cache.get(key)
+        if hit is not None and hit[0] == rev:
+            return hit[1]
+        schema_tags = {t.name for t in t_schema.tags}
+        ordered = tuple(
+            tag
+            for r in self.registry.list_index_rules(group)
+            if r.type == "tree"
+            for tag in r.tags
+            if tag in schema_tags
+        )
+        cache[key] = (rev, ordered)
+        return ordered
+
+    def trace_write(self, request_iterator, context):
+        """Bidi stream: tag values ride positionally per tag_spec (or the
+        schema's tag order)."""
+        from banyandb_tpu.models.trace import SpanValue
+
+        for wreq in request_iterator:
+            resp = pb.trace_write_pb2.WriteResponse(version=wreq.version)
+            try:
+                if self.trace is None:
+                    raise ValueError("trace engine not wired")
+                t_schema = self.registry.get_trace(
+                    wreq.metadata.group, wreq.metadata.name
+                )
+                names = (
+                    list(wreq.tag_spec.tag_names)
+                    if wreq.HasField("tag_spec") and wreq.tag_spec.tag_names
+                    else [t.name for t in t_schema.tags]
+                )
+                if len(wreq.tags) > len(names):
+                    raise ValueError(
+                        f"write carries {len(wreq.tags)} tags, spec has {len(names)}"
+                    )
+                tags = {
+                    n: wire.tag_value_to_py(tv)
+                    for n, tv in zip(names, wreq.tags)
+                }
+                ts_tag = t_schema.timestamp_tag
+                ts_millis = int(tags.get(ts_tag, 0)) if ts_tag else 0
+                if not ts_millis:
+                    import time as _time
+
+                    ts_millis = int(_time.time() * 1000)
+                ordered = self._ordered_tags(wreq.metadata.group, t_schema)
+                self.trace.write(
+                    wreq.metadata.group,
+                    wreq.metadata.name,
+                    [SpanValue(ts_millis=ts_millis, tags=tags, span=wreq.span)],
+                    ordered_tags=ordered,
+                )
+                resp.status = "STATUS_SUCCEED"
+            except KeyError:
+                resp.status = "STATUS_NOT_FOUND"
+            except Exception:  # noqa: BLE001
+                log.exception("trace write failed")
                 resp.status = "STATUS_INTERNAL_ERROR"
             resp.metadata.CopyFrom(wreq.metadata)
             yield resp
@@ -410,6 +619,30 @@ class WireServer:
                 {"Query": _unary(s.bydbql_query, pb.bydbql_query_pb2.QueryRequest)},
             )
         )
+        if s.property is not None:
+            pr = pb.property_rpc_pb2
+            generic.append(
+                (
+                    "banyandb.property.v1.PropertyService",
+                    {
+                        "Apply": _unary(s.property_apply, pr.ApplyRequest),
+                        "Delete": _unary(s.property_delete, pr.DeleteRequest),
+                        "Query": _unary(s.property_query, pr.QueryRequest),
+                    },
+                )
+            )
+        if s.trace is not None:
+            generic.append(
+                (
+                    "banyandb.trace.v1.TraceService",
+                    {
+                        "Query": _unary(s.trace_query, pb.trace_query_pb2.QueryRequest),
+                        "Write": _stream_stream(
+                            s.trace_write, pb.trace_write_pb2.WriteRequest
+                        ),
+                    },
+                )
+            )
         self.server.add_generic_rpc_handlers(
             tuple(
                 grpc.method_handlers_generic_handler(name, hs)

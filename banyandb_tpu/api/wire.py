@@ -93,6 +93,10 @@ def py_to_tag_value(v, tag_type: Optional[isch.TagType] = None):
             m.str.value = v.decode("utf-8", "replace")
         elif tag_type == isch.TagType.INT and len(v) == 8:
             m.int.value = int.from_bytes(v, "little", signed=True)
+        elif tag_type == isch.TagType.TIMESTAMP and len(v) == 8:
+            m.timestamp.CopyFrom(
+                millis_to_ts(int.from_bytes(v, "little", signed=True))
+            )
         else:
             m.binary_data = v
     elif isinstance(v, str):

@@ -75,9 +75,9 @@ class LocalTransport:
 class GrpcBusServer:
     """Serves a LocalBus over gRPC generic handlers (sub.NewServer analog).
 
-    TLS: pass cert_file+key_file for server TLS (pkg/tls analog; the
-    reference hot-reloads via fsnotify — restart-to-rotate here, reload
-    hook tracked for a later round)."""
+    TLS: pass cert_file+key_file for server TLS with HOT RELOAD
+    (pkg/tls/reloader.go analog) — rotated PEM files take effect on the
+    next handshake via utils/tls_reloader.CertReloader."""
 
     def __init__(
         self,
@@ -134,16 +134,16 @@ class GrpcBusServer:
             self._server.add_generic_rpc_handlers(
                 (chunked_sync.generic_handler(sync_install),)
             )
+        self.tls_reloader = None
         if cert_file and key_file:
-            creds = grpc.ssl_server_credentials(
-                [
-                    (
-                        Path(key_file).read_bytes(),
-                        Path(cert_file).read_bytes(),
-                    )
-                ]
+            # hot-reloading credentials (pkg/tls/reloader.go:55 analog):
+            # rotated PEMs take effect on the next handshake, no restart
+            from banyandb_tpu.utils.tls_reloader import CertReloader
+
+            self.tls_reloader = CertReloader(cert_file, key_file)
+            self.port = self._server.add_secure_port(
+                f"{host}:{port}", self.tls_reloader.server_credentials()
             )
-            self.port = self._server.add_secure_port(f"{host}:{port}", creds)
         else:
             self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.addr = f"{host}:{self.port}"

@@ -255,8 +255,18 @@ def partials_to_json(p: Partials) -> dict:
     envelope carries two base64 strings + tiny metadata instead of
     K*(3F+1) JSON floats, so envelope encode/parse is O(1) JSON tokens
     in the group count.
+
+    Rolling upgrades: receivers accept v1 AND v2, but senders emit v2 by
+    default — upgrade liaisons (the receivers of partials) before data
+    nodes, or set BYDB_PARTIALS_FRAME_V1=1 on not-yet-upgraded-peer
+    senders to emit the legacy shape during the transition.
     """
+    import os
+
     from banyandb_tpu.utils import encoding as enc
+
+    if os.environ.get("BYDB_PARTIALS_FRAME_V1") == "1":
+        return _partials_to_json_v1(p)
 
     fields = sorted(p.sums.keys())
     arrays = [np.asarray(p.count, dtype="<f8")]
@@ -331,6 +341,23 @@ def partials_from_json(d: dict) -> Partials:
         hist_span=d["hist_span"],
         field_stats={f: tuple(v) for f, v in d.get("field_stats", {}).items()},
     )
+
+
+def _partials_to_json_v1(p: Partials) -> dict:
+    """Legacy (round-1) envelope for mixed-version transitions."""
+    return {
+        "group_tags": list(p.group_tags),
+        "groups": [[_b64(v) for v in g] for g in p.groups],
+        "count": p.count.tolist(),
+        "sums": {f: a.tolist() for f, a in p.sums.items()},
+        "mins": {f: a.tolist() for f, a in p.mins.items()},
+        "maxs": {f: a.tolist() for f, a in p.maxs.items()},
+        "hist": _b64(p.hist.astype(np.float64).tobytes()) if p.hist is not None else None,
+        "hist_shape": list(p.hist.shape) if p.hist is not None else None,
+        "hist_lo": p.hist_lo,
+        "hist_span": p.hist_span,
+        "field_stats": {f: list(v) for f, v in p.field_stats.items()},
+    }
 
 
 def _partials_from_json_v1(d: dict) -> Partials:

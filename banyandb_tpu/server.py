@@ -82,6 +82,7 @@ class StandaloneServer:
         port: int = 17912,
         wire_port: int | None = None,
         http_port: int | None = None,
+        pprof_port: int | None = None,
     ):
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
@@ -107,7 +108,11 @@ class StandaloneServer:
             from banyandb_tpu.api.grpc_server import WireServer, WireServices
 
             self._wire_services = WireServices(
-                self.registry, self.measure, self.stream
+                self.registry,
+                self.measure,
+                self.stream,
+                property_engine=self.property,
+                trace_engine=self.trace,
             )
             self.wire = WireServer(self._wire_services, port=wire_port)
         if http_port is not None:
@@ -118,6 +123,11 @@ class StandaloneServer:
                 self.registry, self.measure, self.stream
             )
             self.http = HttpGateway(svcs, port=http_port)
+        self.pprof = None
+        if pprof_port is not None:
+            from banyandb_tpu.admin.profiling import ProfilingServer
+
+            self.pprof = ProfilingServer(port=pprof_port)
 
     # -- wiring -------------------------------------------------------------
     def _register(self) -> None:
@@ -488,6 +498,8 @@ class StandaloneServer:
             self.wire.start()
         if self.http is not None:
             self.http.start()
+        if self.pprof is not None:
+            self.pprof.start()
 
     def _sweep_properties(self) -> None:
         for g in self.registry.list_groups():
@@ -503,6 +515,8 @@ class StandaloneServer:
             self.wire.stop()
         if self.http is not None:
             self.http.stop()
+        if self.pprof is not None:
+            self.pprof.stop()
         self.access_log.close()
 
     @property

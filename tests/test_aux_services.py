@@ -1,0 +1,218 @@
+"""TLS hot-reload, pprof-analog profiling endpoints, and the Property/
+Trace wire services (VERDICT r1 missing #11 + §2.5 coverage)."""
+
+import json
+import shutil
+import subprocess
+import urllib.request
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from banyandb_tpu.api import pb  # noqa: E402
+from banyandb_tpu.api.grpc_server import WireServer, WireServices  # noqa: E402
+from banyandb_tpu.api.schema import (  # noqa: E402
+    Catalog,
+    Group,
+    IndexRule,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    Trace,
+)
+from banyandb_tpu.models.measure import MeasureEngine  # noqa: E402
+from banyandb_tpu.models.property import PropertyEngine  # noqa: E402
+from banyandb_tpu.models.stream import StreamEngine  # noqa: E402
+from banyandb_tpu.models.trace import TraceEngine  # noqa: E402
+
+T0 = 1_700_000_000_000
+
+
+def _mk_cert(path, cn):
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", str(path / "key.pem"), "-out", str(path / "cert.pem"),
+            "-days", "1", "-subj", f"/CN={cn}",
+            "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1",
+        ],
+        check=True,
+        capture_output=True,
+    )
+
+
+@pytest.mark.skipif(shutil.which("openssl") is None, reason="needs openssl")
+def test_tls_hot_reload(tmp_path):
+    """Rotating the PEM files takes effect without restarting the server:
+    a client trusting only the NEW cert connects after rotation."""
+    from banyandb_tpu.cluster.bus import LocalBus, Topic
+    from banyandb_tpu.cluster.rpc import GrpcBusServer, GrpcTransport
+
+    old_dir, new_dir, live = tmp_path / "old", tmp_path / "new", tmp_path / "live"
+    for d in (old_dir, new_dir, live):
+        d.mkdir()
+    _mk_cert(old_dir, "localhost")
+    _mk_cert(new_dir, "localhost")
+    shutil.copy(old_dir / "cert.pem", live / "cert.pem")
+    shutil.copy(old_dir / "key.pem", live / "key.pem")
+
+    bus = LocalBus()
+    bus.subscribe(Topic.HEALTH, lambda env: {"status": "ok"})
+    srv = GrpcBusServer(
+        bus, port=0, cert_file=live / "cert.pem", key_file=live / "key.pem"
+    )
+    srv.start()
+    try:
+        t_old = GrpcTransport(ca_file=str(old_dir / "cert.pem"))
+        assert t_old.call(srv.addr, Topic.HEALTH.value, {})["status"] == "ok"
+        t_old.close()
+
+        # rotate the serving PEMs in place — NO server restart
+        shutil.copy(new_dir / "cert.pem", live / "cert.pem")
+        shutil.copy(new_dir / "key.pem", live / "key.pem")
+
+        t_new = GrpcTransport(ca_file=str(new_dir / "cert.pem"))
+        assert t_new.call(srv.addr, Topic.HEALTH.value, {})["status"] == "ok"
+        t_new.close()
+        assert srv.tls_reloader.reloads >= 1
+    finally:
+        srv.stop()
+
+
+def test_profiling_endpoints():
+    from banyandb_tpu.admin.profiling import ProfilingServer
+
+    srv = ProfilingServer(port=0).start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}"
+            ) as r:
+                return r.status, r.read().decode()
+
+        st, body = get("/debug/threads")
+        assert st == 200 and "--- thread" in body
+        st, body = get("/debug/vars")
+        assert st == 200 and "rss_bytes" in body
+        st, body = get("/debug/tracemalloc?top=5")
+        assert st == 200
+        st, body = get("/debug/profile?seconds=0.2")
+        assert st == 200 and "top leaf frames" in body
+        # the sampler must see OTHER threads (this HTTP server's own
+        # serve_forever thread at minimum), not just itself
+        assert "samples" in body.splitlines()[0]
+    finally:
+        srv.stop()
+
+
+@pytest.fixture()
+def wire(tmp_path):
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("pg", Catalog.PROPERTY, ResourceOpts(shard_num=1)))
+    reg.create_group(Group("tg", Catalog.TRACE, ResourceOpts(shard_num=1)))
+    reg.create_trace(
+        Trace(
+            group="tg",
+            name="sw",
+            tags=(
+                TagSpec("trace_id", TagType.STRING),
+                TagSpec("ts", TagType.TIMESTAMP),
+                TagSpec("dur", TagType.INT),
+            ),
+            trace_id_tag="trace_id",
+            timestamp_tag="ts",
+        )
+    )
+    reg.create_index_rule(
+        IndexRule(group="tg", name="dur_tree", tags=("dur",), type="tree")
+    )
+    svcs = WireServices(
+        reg,
+        MeasureEngine(reg, tmp_path / "data"),
+        StreamEngine(reg, tmp_path / "data"),
+        property_engine=PropertyEngine(reg, tmp_path / "data"),
+        trace_engine=TraceEngine(reg, tmp_path / "data"),
+    )
+    srv = WireServer(svcs, port=0).start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+    yield chan
+    chan.close()
+    srv.stop()
+
+
+def _m(chan, service, name, req_cls, resp_cls, kind="unary"):
+    path = f"/{service}/{name}"
+    if kind == "unary":
+        return chan.unary_unary(
+            path,
+            request_serializer=req_cls.SerializeToString,
+            response_deserializer=resp_cls.FromString,
+        )
+    return chan.stream_stream(
+        path,
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+
+def test_property_service_wire(wire):
+    pr = pb.property_rpc_pb2
+    apply = _m(wire, "banyandb.property.v1.PropertyService", "Apply",
+               pr.ApplyRequest, pr.ApplyResponse)
+    req = pr.ApplyRequest()
+    req.property.metadata.group = "pg"
+    req.property.metadata.name = "conf"
+    req.property.id = "x1"
+    t = req.property.tags.add(key="k")
+    t.value.str.value = "v1"
+    resp = apply(req)
+    assert resp.tags_num == 1
+
+    query = _m(wire, "banyandb.property.v1.PropertyService", "Query",
+               pr.QueryRequest, pr.QueryResponse)
+    q = pr.QueryRequest(groups=["pg"], name="conf", ids=["x1"])
+    got = query(q)
+    assert len(got.properties) == 1
+    assert got.properties[0].tags[0].value.str.value == "v1"
+
+    delete = _m(wire, "banyandb.property.v1.PropertyService", "Delete",
+                pr.DeleteRequest, pr.DeleteResponse)
+    assert delete(pr.DeleteRequest(group="pg", name="conf", id="x1")).deleted
+    assert len(query(q).properties) == 0
+
+
+def test_trace_service_wire(wire):
+    tw = pb.trace_write_pb2
+    write = _m(wire, "banyandb.trace.v1.TraceService", "Write",
+               tw.WriteRequest, tw.WriteResponse, kind="stream")
+
+    def gen():
+        for i in range(10):
+            w = tw.WriteRequest()
+            w.metadata.group, w.metadata.name = "tg", "sw"
+            w.version = i + 1
+            w.span = f"span-{i}".encode()
+            # positional per schema order: trace_id, ts, dur
+            w.tags.add().str.value = f"t{i % 3}"
+            ts = w.tags.add()
+            ts.timestamp.seconds = (T0 + i) // 1000
+            ts.timestamp.nanos = ((T0 + i) % 1000) * 1_000_000
+            w.tags.add().int.value = 10 * i
+            yield w
+
+    resps = list(write(gen()))
+    assert all(r.status == "STATUS_SUCCEED" for r in resps)
+
+    tq = pb.trace_query_pb2
+    query = _m(wire, "banyandb.trace.v1.TraceService", "Query",
+               tq.QueryRequest, tq.QueryResponse)
+    q = tq.QueryRequest(groups=["tg"], name="sw")
+    cond = q.criteria.condition
+    cond.name, cond.op = "trace_id", 1
+    cond.value.str.value = "t1"
+    got = query(q)
+    assert len(got.traces) == 1
+    assert got.traces[0].trace_id == "t1"
+    assert len(got.traces[0].spans) == 3  # i in {1, 4, 7}
