@@ -104,11 +104,32 @@ class HttpGateway:
             def do_GET(self):
                 if self.path == "/api/healthz":
                     return self._send(200, {"status": "ok"})
+                if self.path in ("/", "/console"):
+                    page = gateway._console_page
+                    if page is None:
+                        return self._send(500, {"error": "console.html missing"})
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(page)))
+                    self.end_headers()
+                    self.wfile.write(page)
+                    return
                 self._dispatch("GET")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_port
         self._thread: threading.Thread | None = None
+        # console page read once at startup (missing file -> 500, not a
+        # per-request OSError escaping the handler)
+        try:
+            import os
+
+            with open(
+                os.path.join(os.path.dirname(__file__), "console.html"), "rb"
+            ) as f:
+                self._console_page: bytes | None = f.read()
+        except OSError:
+            self._console_page = None
 
         # static route tables (registry handler dicts are built once; the
         # request message is instantiated per request at dispatch time)
@@ -136,6 +157,16 @@ class HttpGateway:
                 rpc.StreamRegistryServiceCreateRequest,
             ),
         }
+        if getattr(s, "property", None) is not None:
+            self._post[("v1", "property", "data", "query")] = (
+                s.property_query,
+                pb.property_rpc_pb2.QueryRequest,
+            )
+        if getattr(s, "trace", None) is not None:
+            self._post[("v1", "trace", "data")] = (
+                s.trace_query,
+                pb.trace_query_pb2.QueryRequest,
+            )
 
     # -- routing -----------------------------------------------------------
     def _route(self, method: str, path: str):

@@ -13,7 +13,6 @@ from __future__ import annotations
 import json
 import threading
 from concurrent import futures
-from pathlib import Path
 from typing import Optional
 
 from banyandb_tpu.cluster.bus import LocalBus

@@ -11,10 +11,7 @@ health, snapshot.
 
 from __future__ import annotations
 
-import argparse
 import base64
-import signal
-import threading
 import time
 from pathlib import Path
 
@@ -120,7 +117,11 @@ class StandaloneServer:
             from banyandb_tpu.api.http_gateway import HttpGateway
 
             svcs = getattr(self, "_wire_services", None) or WireServices(
-                self.registry, self.measure, self.stream
+                self.registry,
+                self.measure,
+                self.stream,
+                property_engine=self.property,
+                trace_engine=self.trace,
             )
             self.http = HttpGateway(svcs, port=http_port)
         self.pprof = None
@@ -524,40 +525,48 @@ class StandaloneServer:
         return self.grpc.addr
 
 
+def build_config():
+    """Flag registry (pkg/config analog: CLI > BYDB_* env > --config
+    JSON file > default)."""
+    from banyandb_tpu.config import Config
+
+    cfg = Config("banyandb-tpu server")
+    cfg.register("root", None, "data root directory", str, required=True)
+    cfg.register("port", 17912, "bus gRPC port", int)
+    cfg.register(
+        "wire-port", 17914,
+        "reference-proto gRPC surface (banyandb.*.v1); -1 disables", int,
+    )
+    cfg.register("http-port", 17913, "HTTP/JSON gateway; -1 disables", int)
+    cfg.register("pprof-port", -1, "profiling endpoints; -1 disables", int)
+    return cfg
+
+
 def main(argv=None) -> None:
-    ap = argparse.ArgumentParser("banyandb-tpu server")
-    ap.add_argument("--root", required=True)
-    ap.add_argument("--port", type=int, default=17912)
-    ap.add_argument(
-        "--wire-port",
-        type=int,
-        default=17914,
-        help="reference-proto gRPC surface (banyandb.*.v1); -1 disables",
-    )
-    ap.add_argument(
-        "--http-port",
-        type=int,
-        default=17913,
-        help="HTTP/JSON gateway; -1 disables",
-    )
-    args = ap.parse_args(argv)
+    from banyandb_tpu.run import FuncUnit, Group
+
+    s = build_config().load(argv)
     srv = StandaloneServer(
-        args.root,
-        args.port,
-        wire_port=None if args.wire_port < 0 else args.wire_port,
-        http_port=None if args.http_port < 0 else args.http_port,
+        s.root,
+        s.port,
+        wire_port=None if s.wire_port < 0 else s.wire_port,
+        http_port=None if s.http_port < 0 else s.http_port,
+        pprof_port=None if s.pprof_port < 0 else s.pprof_port,
     )
-    srv.start()
-    print(f"banyandb-tpu standalone listening on {srv.addr}", flush=True)
-    if srv.wire is not None:
-        print(f"wire gRPC (banyandb.*.v1) on :{srv.wire.port}", flush=True)
-    if srv.http is not None:
-        print(f"HTTP gateway on :{srv.http.port}", flush=True)
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    stop.wait()
-    srv.stop()
+
+    def announce():
+        srv.start()
+        print(f"banyandb-tpu standalone listening on {srv.addr}", flush=True)
+        if srv.wire is not None:
+            print(f"wire gRPC (banyandb.*.v1) on :{srv.wire.port}", flush=True)
+        if srv.http is not None:
+            print(f"HTTP gateway + console on :{srv.http.port}", flush=True)
+        if srv.pprof is not None:
+            print(f"profiling endpoints on :{srv.pprof.port}", flush=True)
+
+    group = Group("standalone")
+    group.add(FuncUnit("server", serve=announce, stop=srv.stop))
+    group.run()
     # grpc's worker threads are non-daemon; an in-flight slow handler
     # (e.g. a TPU compile) must not wedge process exit after SIGTERM.
     import os

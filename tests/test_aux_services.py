@@ -105,6 +105,11 @@ def test_profiling_endpoints():
         assert "samples" in body.splitlines()[0]
     finally:
         srv.stop()
+        import tracemalloc
+
+        # the endpoint opts the process INTO tracing; leaving it on
+        # would slow every later test in this pytest process ~2x
+        tracemalloc.stop()
 
 
 @pytest.fixture()

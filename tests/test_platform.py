@@ -1,0 +1,229 @@
+"""Platform plumbing: config/flag resolution, phased run lifecycle,
+MCP server tools, web console route, thread-leak check (gleak analog)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from banyandb_tpu.config import Config
+from banyandb_tpu.run import FuncUnit, Group
+
+T0 = 1_700_000_000_000
+
+
+# -- config -----------------------------------------------------------------
+
+
+def test_config_resolution_order(tmp_path, monkeypatch):
+    cfgfile = tmp_path / "c.json"
+    cfgfile.write_text(json.dumps({"port": 1111, "root": "/from-file"}))
+
+    cfg = Config()
+    cfg.register("root", None, "data root", str, required=True)
+    cfg.register("port", 17912, "port", int)
+    cfg.register("verbose", False, "chatty")
+
+    # file < env < CLI
+    monkeypatch.setenv("BYDB_PORT", "2222")
+    s = cfg.load(["--config", str(cfgfile), "--port", "3333"])
+    assert s.port == 3333 and s.root == "/from-file"
+    s = cfg.load(["--config", str(cfgfile)])
+    assert s.port == 2222
+    monkeypatch.delenv("BYDB_PORT")
+    s = cfg.load(["--config", str(cfgfile)])
+    assert s.port == 1111
+    s = cfg.load(["--root", "/cli"])
+    assert s.port == 17912 and s.root == "/cli"
+
+    monkeypatch.setenv("BYDB_VERBOSE", "true")
+    assert cfg.load(["--root", "x"]).verbose is True
+
+    with pytest.raises(SystemExit):  # required flag missing
+        cfg.load([])
+
+
+def test_run_group_phases_and_unwind():
+    events = []
+
+    def unit(name, fail_serve=False):
+        def serve():
+            events.append(f"serve:{name}")
+            if fail_serve:
+                raise RuntimeError("boom")
+
+        return FuncUnit(
+            name,
+            pre_run=lambda: events.append(f"pre:{name}"),
+            serve=serve,
+            stop=lambda: events.append(f"stop:{name}"),
+        )
+
+    g = Group()
+    g.add(unit("a"))
+    g.add(unit("b"))
+    g.start()
+    g.trigger_stop()
+    assert g.wait(1)
+    g.stop()
+    assert events == ["pre:a", "pre:b", "serve:a", "serve:b", "stop:b", "stop:a"]
+
+    # failure mid-startup unwinds only the started units, reverse order
+    events.clear()
+    g2 = Group()
+    g2.add(unit("a"))
+    g2.add(unit("bad", fail_serve=True))
+    with pytest.raises(RuntimeError):
+        g2.start()
+    assert events == ["pre:a", "pre:bad", "serve:a", "serve:bad", "stop:a"]
+
+
+# -- MCP server -------------------------------------------------------------
+
+
+@pytest.fixture()
+def mcp(tmp_path):
+    from banyandb_tpu.api import (
+        Catalog,
+        DataPointValue,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group as SGroup,
+        Measure,
+        ResourceOpts,
+        TagSpec,
+        TagType,
+        WriteRequest,
+    )
+    from banyandb_tpu.mcp_server import McpServer
+
+    srv = McpServer(tmp_path)
+    srv.registry.create_group(SGroup("g", Catalog.MEASURE, ResourceOpts()))
+    srv.registry.create_measure(
+        Measure(
+            group="g",
+            name="m",
+            tags=(TagSpec("svc", TagType.STRING),),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+    srv.measure.write(
+        WriteRequest(
+            "g",
+            "m",
+            tuple(
+                DataPointValue(T0 + i, {"svc": f"s{i % 3}"}, {"v": 1.0 + i}, version=1)
+                for i in range(30)
+            ),
+        )
+    )
+    return srv
+
+
+def _call(srv, method, params=None, mid=1):
+    return srv.handle(
+        {"jsonrpc": "2.0", "id": mid, "method": method, "params": params or {}}
+    )
+
+
+def test_mcp_protocol_and_tools(mcp):
+    init = _call(mcp, "initialize")
+    assert init["result"]["serverInfo"]["name"] == "banyandb-tpu-mcp"
+    assert _call(mcp, "notifications/initialized") is None
+
+    tools = _call(mcp, "tools/list")["result"]["tools"]
+    assert {t["name"] for t in tools} >= {
+        "list_groups_schemas",
+        "list_resources",
+        "validate_bydbql",
+        "execute_bydbql",
+        "topn_query",
+    }
+
+    r = _call(mcp, "tools/call", {"name": "list_groups_schemas", "arguments": {}})
+    payload = json.loads(r["result"]["content"][0]["text"])
+    assert payload["g"]["measures"] == ["m"]
+
+    r = _call(
+        mcp,
+        "tools/call",
+        {"name": "validate_bydbql", "arguments": {"query": "SELECT bogus FROM"}},
+    )
+    assert json.loads(r["result"]["content"][0]["text"])["valid"] is False
+
+    r = _call(
+        mcp,
+        "tools/call",
+        {
+            "name": "execute_bydbql",
+            "arguments": {
+                "query": (
+                    "SELECT sum(v) FROM MEASURE m IN g "
+                    f"TIME >= {T0} AND TIME < {T0 + 100} GROUP BY svc"
+                )
+            },
+        },
+    )
+    payload = json.loads(r["result"]["content"][0]["text"])
+    assert len(payload["result"]["groups"]) == 3
+
+    err = _call(mcp, "tools/call", {"name": "nope", "arguments": {}})
+    assert "error" in err
+    assert _call(mcp, "no/such/method")["error"]["code"] == -32601
+
+
+def test_mcp_stdio_loop(mcp):
+    import io
+
+    lines = "\n".join(
+        json.dumps(m)
+        for m in [
+            {"jsonrpc": "2.0", "id": 1, "method": "initialize", "params": {}},
+            {"jsonrpc": "2.0", "method": "notifications/initialized"},
+            {"jsonrpc": "2.0", "id": 2, "method": "tools/list"},
+        ]
+    )
+    out = io.StringIO()
+    mcp.serve_stdio(stdin=io.StringIO(lines), stdout=out)
+    resps = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert [r["id"] for r in resps] == [1, 2]
+
+
+# -- console + leak check ---------------------------------------------------
+
+
+def test_console_served_and_no_thread_leaks(tmp_path):
+    """The gateway serves the console page, and a full standalone server
+    start/stop leaves no lingering non-daemon threads (gleak analog)."""
+    from banyandb_tpu.server import StandaloneServer
+
+    before = {
+        t.ident for t in threading.enumerate() if not t.daemon
+    }
+    srv = StandaloneServer(tmp_path, port=0, wire_port=0, http_port=0, pprof_port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.http.port}/console"
+        ) as r:
+            body = r.read().decode()
+        assert "BydbQL console" in body and "banyandb-tpu" in body
+    finally:
+        srv.stop()
+    import time
+
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        after = {t.ident for t in threading.enumerate() if not t.daemon}
+        if after <= before:
+            break
+        time.sleep(0.05)
+    leaked = [
+        t.name
+        for t in threading.enumerate()
+        if not t.daemon and t.ident not in before
+    ]
+    assert not leaked, f"non-daemon threads leaked: {leaked}"
