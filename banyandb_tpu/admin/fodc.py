@@ -1,0 +1,145 @@
+"""FODC proxy: cluster-wide first-occurrence data capture.
+
+Analog of the reference's fodc proxy tier (/root/reference/fodc/proxy —
+the aggregation layer above per-node fodc agents): the proxy polls
+every cluster node's diagnostics topic, assembles one timestamped
+bundle per capture, persists bundles to disk with a retention cap, and
+can run trigger rules (capture automatically when a node reports a
+pressure signal).  The per-node agent half is admin/diagnostics.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from banyandb_tpu.cluster.rpc import TransportError
+
+DIAG_TOPIC = "diagnostics"
+
+
+class FodcProxy:
+    def __init__(
+        self,
+        transport,
+        nodes,  # list[NodeInfo]
+        bundle_root: str | Path,
+        *,
+        max_bundles: int = 16,
+    ):
+        self.transport = transport
+        self.nodes = list(nodes)
+        self.root = Path(bundle_root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.max_bundles = max_bundles
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.triggered = 0
+
+    # -- capture -------------------------------------------------------------
+    def capture(self, reason: str = "manual", include_threads: bool = False) -> Path:
+        """Collect diagnostics from every node into one bundle dir."""
+        import uuid
+
+        stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+        # uuid suffix: two captures in the same wall-clock second (manual
+        # + trigger racing) must not overwrite each other's evidence
+        bundle = self.root / f"fodc-{stamp}-{reason}-{uuid.uuid4().hex[:8]}"
+        bundle.mkdir(parents=True, exist_ok=False)
+        summary = {"reason": reason, "captured_at": stamp, "nodes": {}}
+        for n in self.nodes:
+            try:
+                diag = self.transport.call(
+                    n.addr,
+                    DIAG_TOPIC,
+                    {"include_threads": include_threads},
+                    timeout=10,
+                )
+                status = "ok"
+            except TransportError as e:
+                diag = {"error": str(e)}
+                status = "unreachable"
+            except Exception as e:  # noqa: BLE001 - a faulty collector on
+                # one node must not abort the whole bundle (incidents are
+                # exactly when collectors fail)
+                diag = {"error": f"{type(e).__name__}: {e}"}
+                status = "collector-error"
+            (bundle / f"{n.name}.json").write_text(
+                json.dumps(diag, indent=1, default=str)
+            )
+            summary["nodes"][n.name] = status
+        (bundle / "summary.json").write_text(json.dumps(summary, indent=1))
+        self._enforce_retention()
+        return bundle
+
+    def _enforce_retention(self) -> None:
+        import shutil
+
+        with self._lock:
+            bundles = sorted(
+                d for d in self.root.iterdir() if d.is_dir() and d.name.startswith("fodc-")
+            )
+            for old in bundles[: max(0, len(bundles) - self.max_bundles)]:
+                shutil.rmtree(old, ignore_errors=True)
+
+    def list_bundles(self) -> list[str]:
+        return sorted(
+            d.name
+            for d in self.root.iterdir()
+            if d.is_dir() and d.name.startswith("fodc-")
+        )
+
+    # -- trigger rules --------------------------------------------------------
+    def check_triggers(
+        self,
+        *,
+        rss_limit_bytes: Optional[int] = None,
+        min_interval_s: float = 300.0,
+    ) -> Optional[Path]:
+        """One trigger evaluation: capture when any node reports RSS over
+        the limit (the first-OCCURRENCE contract: one bundle per episode,
+        rate-limited by min_interval_s).  With no rule configured this is
+        a no-op — no wasted per-node diagnostics RPCs."""
+        if rss_limit_bytes is None:
+            return None
+        now = time.monotonic()
+        last = getattr(self, "_last_trigger", -1e18)
+        if now - last < min_interval_s:
+            return None
+        for n in self.nodes:
+            try:
+                diag = self.transport.call(n.addr, DIAG_TOPIC, {}, timeout=5)
+            except Exception:  # noqa: BLE001 - probe failures skip the node
+                continue
+            rss = (diag.get("process") or {}).get("rss_bytes", 0)
+            if rss > rss_limit_bytes:
+                self._last_trigger = now
+                self.triggered += 1
+                return self.capture(reason=f"rss-{n.name}", include_threads=True)
+        return None
+
+    # -- background loop ------------------------------------------------------
+    def start(self, interval_s: float = 30.0, **trigger_kw) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.check_triggers(**trigger_kw)
+                except Exception:  # noqa: BLE001 - the watchdog survives
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="fodc-proxy")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -64,6 +64,15 @@ class DataNode:
             ),
         )
         self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
+        # per-node FODC agent surface polled by the proxy (admin/fodc.py)
+        self.bus.subscribe("diagnostics", self._on_diagnostics)
+
+    def _on_diagnostics(self, env: dict) -> dict:
+        from banyandb_tpu.admin.diagnostics import DiagnosticsCollector
+
+        return DiagnosticsCollector(self.root).collect(
+            include_threads=bool(env.get("include_threads"))
+        )
 
     # -- stream plane (stream svc_data analog) ------------------------------
     def _on_stream_write(self, env: dict) -> dict:

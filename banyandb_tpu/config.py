@@ -100,8 +100,15 @@ class Config:
                 # config keys may use either the CLI (kebab) or attribute
                 # (snake) spelling, matching the viper/pflag convention
                 v = file_vals.get(f.attr, file_vals.get(f.name))
-                if v is not None and f.type is not bool:
-                    v = f.type(v)
+                if v is not None:
+                    if f.type is bool:
+                        # normalize string bools ("false") like env vars do
+                        if isinstance(v, str):
+                            v = v.lower() in ("1", "true", "yes", "on")
+                        else:
+                            v = bool(v)
+                    else:
+                        v = f.type(v)
             if v is None:
                 v = f.default
             if v is None and f.required:

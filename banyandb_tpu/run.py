@@ -71,14 +71,17 @@ class Group:
         self._units.append(unit)
 
     def start(self) -> None:
-        """PreRun then Serve, forward order; on any failure stop what
-        already started (reverse) and re-raise."""
+        """PreRun then Serve, forward order; on any failure stop every
+        unit whose serve() RAN — including the failing one, which may
+        have bound listeners before raising (graceful_stop must
+        therefore tolerate partial starts) — in reverse, and re-raise."""
         try:
             for u in self._units:
                 u.pre_run()
             for u in self._units:
+                self._started.append(u)  # before serve: partial starts
+                # (a listener bound, then a later bind fails) still unwind
                 u.serve()
-                self._started.append(u)
         except Exception:
             log.exception("startup failed; unwinding started units")
             self.stop()

@@ -1,0 +1,135 @@
+"""Streaming dataflow (pkg/flow analog) + FODC proxy tier."""
+
+import json
+
+import pytest
+
+from banyandb_tpu.flow import (
+    Element,
+    Flow,
+    SlidingEventTimeWindow,
+    TumblingEventTimeWindow,
+)
+
+T0 = 1_700_000_000_000
+
+
+def test_tumbling_window_counts():
+    out = []
+    f = (
+        Flow("t")
+        .key_by(lambda e: e.tags["svc"])
+        .window(TumblingEventTimeWindow(1000))
+        .aggregate("count")
+        .to(out.append)
+    )
+    f.feed(
+        Element(T0 + i * 100, 1.0, {"svc": "a" if i % 2 else "b"})
+        for i in range(20)  # spans [T0, T0+2000)
+    )
+    f.advance_watermark(T0 + 1000)  # first window closes
+    assert {(r.key, r.value) for r in out} == {("a", 5.0), ("b", 5.0)}
+    out.clear()
+    f.advance_watermark(T0 + 2000)
+    assert {(r.key, r.value) for r in out} == {("a", 5.0), ("b", 5.0)}
+
+
+def test_sliding_windows_overlap():
+    out = []
+    f = (
+        Flow("s")
+        .window(SlidingEventTimeWindow(size_ms=2000, slide_ms=1000))
+        .aggregate("sum")
+        .to(out.append)
+    )
+    # one element per second, value = second index
+    f.feed(Element(T0 + s * 1000, float(s)) for s in range(4))
+    f.advance_watermark(T0 + 4000)
+    sums = {(r.start_ms - T0): r.value for r in out}
+    # window [-1000,1000) sees s=0; [0,2000) sees 0+1; [1000,3000) 1+2; [2000,4000) 2+3
+    assert sums[-1000] == 0.0
+    assert sums[0] == 1.0
+    assert sums[1000] == 3.0
+    assert sums[2000] == 5.0
+
+
+def test_filter_map_and_lateness():
+    out = []
+    f = (
+        Flow("fl")
+        .filter(lambda e: e.value >= 0)
+        .map(lambda e: e._replace(value=e.value * 10))
+        .window(TumblingEventTimeWindow(1000))
+        .aggregate("sum")
+        .allowed_lateness(500)
+        .to(out.append)
+    )
+    f.feed([Element(T0 + 100, 1.0), Element(T0 + 200, -5.0)])
+    f.advance_watermark(T0 + 1000)  # lateness holds the window open
+    assert out == []
+    f.feed([Element(T0 + 300, 2.0)])  # within lateness: still accepted
+    f.advance_watermark(T0 + 1500)  # now end+lateness passed -> fires
+    assert len(out) == 1 and out[0].value == 30.0
+    # element for the fired window is dropped, not re-fired
+    assert f.feed([Element(T0 + 400, 9.0)]) == 0
+
+
+def test_topn_operator():
+    out = []
+    f = (
+        Flow("top")
+        .key_by(lambda e: e.tags["svc"])
+        .window(TumblingEventTimeWindow(1000))
+        .aggregate("sum")
+        .top_n(2)
+        .to(out.append)
+    )
+    f.feed(
+        [
+            Element(T0 + 1, 10.0, {"svc": "a"}),
+            Element(T0 + 2, 30.0, {"svc": "b"}),
+            Element(T0 + 3, 20.0, {"svc": "c"}),
+            Element(T0 + 4, 5.0, {"svc": "b"}),
+        ]
+    )
+    f.advance_watermark(T0 + 1000)
+    assert len(out) == 1
+    assert out[0].value == [("b", 35.0), ("c", 20.0)]
+
+
+def test_fodc_proxy_capture_and_trigger(tmp_path):
+    from banyandb_tpu.admin.fodc import FodcProxy
+    from banyandb_tpu.api import Catalog, Group, ResourceOpts, SchemaRegistry
+    from banyandb_tpu.cluster.data_node import DataNode
+    from banyandb_tpu.cluster.node import NodeInfo
+    from banyandb_tpu.cluster.rpc import LocalTransport
+
+    transport = LocalTransport()
+    nodes = []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts()))
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+
+    proxy = FodcProxy(transport, nodes, tmp_path / "bundles", max_bundles=2)
+    bundle = proxy.capture(reason="test")
+    summary = json.loads((bundle / "summary.json").read_text())
+    assert summary["nodes"] == {"d0": "ok", "d1": "ok"}
+    d0 = json.loads((bundle / "d0.json").read_text())
+    assert "process" in d0 and "runtime" in d0
+
+    # unreachable node recorded, not fatal
+    transport.unregister("d1")
+    b2 = proxy.capture(reason="degraded")
+    s2 = json.loads((b2 / "summary.json").read_text())
+    assert s2["nodes"]["d1"] == "unreachable"
+
+    # retention cap
+    proxy.capture(reason="third")
+    assert len(proxy.list_bundles()) == 2
+
+    # trigger: tiny rss limit -> fires once, then rate-limited
+    got = proxy.check_triggers(rss_limit_bytes=1, min_interval_s=300)
+    assert got is not None and proxy.triggered == 1
+    assert proxy.check_triggers(rss_limit_bytes=1, min_interval_s=300) is None

@@ -69,14 +69,18 @@ def test_run_group_phases_and_unwind():
     g.stop()
     assert events == ["pre:a", "pre:b", "serve:a", "serve:b", "stop:b", "stop:a"]
 
-    # failure mid-startup unwinds only the started units, reverse order
+    # failure mid-startup unwinds every unit whose serve RAN (including
+    # the failing one — it may have bound a listener before raising),
+    # reverse order
     events.clear()
     g2 = Group()
     g2.add(unit("a"))
     g2.add(unit("bad", fail_serve=True))
     with pytest.raises(RuntimeError):
         g2.start()
-    assert events == ["pre:a", "pre:bad", "serve:a", "serve:bad", "stop:a"]
+    assert events == [
+        "pre:a", "pre:bad", "serve:a", "serve:bad", "stop:bad", "stop:a",
+    ]
 
 
 # -- MCP server -------------------------------------------------------------
