@@ -19,6 +19,11 @@ from pathlib import Path
 from typing import Optional
 
 
+# the one definition of the diagnostics bus topic (fodc proxy polls it;
+# standalone server + data nodes subscribe it)
+DIAG_TOPIC = "diagnostics"
+
+
 def runtime_params() -> dict:
     import jax
 
@@ -108,11 +113,16 @@ class DiagnosticsCollector:
         return snap
 
     def write_crash_artifact(self, reason: str, dest: Optional[str | Path] = None) -> Path:
-        """Persist a full snapshot incl. stacks (pkg/panicdiag analog)."""
+        """Persist a full snapshot incl. stacks (pkg/panicdiag analog).
+        Filenames carry a uuid suffix: two crashes in the same
+        millisecond (e.g. a shared resource breaking several threads at
+        once) must not overwrite each other's evidence."""
+        import uuid
+
         dest = Path(dest) if dest else self.root / "diagnostics"
         dest.mkdir(parents=True, exist_ok=True)
         snap = self.collect(include_threads=True)
         snap["reason"] = reason
-        path = dest / f"crash-{snap['ts_millis']}.json"
+        path = dest / f"crash-{snap['ts_millis']}-{uuid.uuid4().hex[:8]}.json"
         path.write_text(json.dumps(snap, indent=1, default=str))
         return path

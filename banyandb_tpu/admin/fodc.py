@@ -18,7 +18,7 @@ from typing import Optional
 
 from banyandb_tpu.cluster.rpc import TransportError
 
-DIAG_TOPIC = "diagnostics"
+from banyandb_tpu.admin.diagnostics import DIAG_TOPIC  # noqa: E402
 
 
 class FodcProxy:
@@ -36,43 +36,74 @@ class FodcProxy:
         self.root.mkdir(parents=True, exist_ok=True)
         self.max_bundles = max_bundles
         self._lock = threading.Lock()
+        self._active: set[Path] = set()  # bundles mid-write: retention-immune
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.triggered = 0
 
+    def _poll_node(self, n, include_threads: bool) -> tuple[dict, str]:
+        try:
+            return (
+                self.transport.call(
+                    n.addr,
+                    DIAG_TOPIC,
+                    {"include_threads": include_threads},
+                    timeout=10,
+                ),
+                "ok",
+            )
+        except TransportError as e:
+            return {"error": str(e)}, "unreachable"
+        except Exception as e:  # noqa: BLE001 - a faulty collector on one
+            # node must not abort the whole bundle (incidents are exactly
+            # when collectors fail)
+            return {"error": f"{type(e).__name__}: {e}"}, "collector-error"
+
     # -- capture -------------------------------------------------------------
-    def capture(self, reason: str = "manual", include_threads: bool = False) -> Path:
-        """Collect diagnostics from every node into one bundle dir."""
+    def capture(
+        self,
+        reason: str = "manual",
+        include_threads: bool = False,
+        preset: Optional[dict] = None,
+    ) -> Path:
+        """Collect diagnostics from every node into one bundle dir.
+
+        Nodes poll IN PARALLEL (serial 10s timeouts on a degraded
+        cluster would block the capture for minutes — exactly when it
+        must be fast).  `preset` supplies already-collected diagnostics
+        per node name (the trigger path reuses its probe responses)."""
         import uuid
+        from concurrent.futures import ThreadPoolExecutor
 
         stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
         # uuid suffix: two captures in the same wall-clock second (manual
         # + trigger racing) must not overwrite each other's evidence
         bundle = self.root / f"fodc-{stamp}-{reason}-{uuid.uuid4().hex[:8]}"
         bundle.mkdir(parents=True, exist_ok=False)
-        summary = {"reason": reason, "captured_at": stamp, "nodes": {}}
-        for n in self.nodes:
-            try:
-                diag = self.transport.call(
-                    n.addr,
-                    DIAG_TOPIC,
-                    {"include_threads": include_threads},
-                    timeout=10,
+        with self._lock:
+            self._active.add(bundle)
+        try:
+            summary = {"reason": reason, "captured_at": stamp, "nodes": {}}
+            preset = preset or {}
+            to_poll = [n for n in self.nodes if n.name not in preset]
+            results = {name: (diag, "ok") for name, diag in preset.items()}
+            if to_poll:
+                with ThreadPoolExecutor(max_workers=min(8, len(to_poll))) as ex:
+                    for n, res in zip(
+                        to_poll,
+                        ex.map(lambda n: self._poll_node(n, include_threads), to_poll),
+                    ):
+                        results[n.name] = res
+            for n in self.nodes:
+                diag, status = results[n.name]
+                (bundle / f"{n.name}.json").write_text(
+                    json.dumps(diag, indent=1, default=str)
                 )
-                status = "ok"
-            except TransportError as e:
-                diag = {"error": str(e)}
-                status = "unreachable"
-            except Exception as e:  # noqa: BLE001 - a faulty collector on
-                # one node must not abort the whole bundle (incidents are
-                # exactly when collectors fail)
-                diag = {"error": f"{type(e).__name__}: {e}"}
-                status = "collector-error"
-            (bundle / f"{n.name}.json").write_text(
-                json.dumps(diag, indent=1, default=str)
-            )
-            summary["nodes"][n.name] = status
-        (bundle / "summary.json").write_text(json.dumps(summary, indent=1))
+                summary["nodes"][n.name] = status
+            (bundle / "summary.json").write_text(json.dumps(summary, indent=1))
+        finally:
+            with self._lock:
+                self._active.discard(bundle)
         self._enforce_retention()
         return bundle
 
@@ -81,7 +112,11 @@ class FodcProxy:
 
         with self._lock:
             bundles = sorted(
-                d for d in self.root.iterdir() if d.is_dir() and d.name.startswith("fodc-")
+                d
+                for d in self.root.iterdir()
+                if d.is_dir()
+                and d.name.startswith("fodc-")
+                and d not in self._active  # never GC a bundle mid-write
             )
             for old in bundles[: max(0, len(bundles) - self.max_bundles)]:
                 shutil.rmtree(old, ignore_errors=True)
@@ -110,16 +145,29 @@ class FodcProxy:
         last = getattr(self, "_last_trigger", -1e18)
         if now - last < min_interval_s:
             return None
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(self.nodes) or 1)) as ex:
+            probes = dict(
+                zip(
+                    (n.name for n in self.nodes),
+                    ex.map(lambda n: self._poll_node(n, False), self.nodes),
+                )
+            )
         for n in self.nodes:
-            try:
-                diag = self.transport.call(n.addr, DIAG_TOPIC, {}, timeout=5)
-            except Exception:  # noqa: BLE001 - probe failures skip the node
+            diag, status = probes[n.name]
+            if status != "ok":
                 continue
             rss = (diag.get("process") or {}).get("rss_bytes", 0)
             if rss > rss_limit_bytes:
                 self._last_trigger = now
                 self.triggered += 1
-                return self.capture(reason=f"rss-{n.name}", include_threads=True)
+                # reuse the probe snapshots; they ARE the evidence
+                return self.capture(
+                    reason=f"rss-{n.name}",
+                    include_threads=True,
+                    preset={k: d for k, (d, st) in probes.items() if st == "ok"},
+                )
         return None
 
     # -- background loop ------------------------------------------------------

@@ -50,6 +50,8 @@ def _stream_stream(fn: Callable, req_cls):
 def _abort(context, e: Exception):
     if isinstance(e, KeyError):
         context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+    if isinstance(e, NotImplementedError):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
     if isinstance(e, (ValueError, TypeError)):
         context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
     log.exception("internal error")
@@ -538,7 +540,8 @@ class WireServices:
         try:
             from banyandb_tpu import bydbql
 
-            catalog, ireq = bydbql.parse_with_catalog(req.query)
+            params = [wire.tag_value_to_py(tv) for tv in req.params]
+            catalog, ireq = bydbql.parse_with_catalog(req.query, params)
             out = pb.bydbql_query_pb2.QueryResponse()
             if catalog == "measure":
                 m = self.registry.get_measure(ireq.groups[0], ireq.name)
@@ -550,9 +553,11 @@ class WireServices:
                 res = self.stream.query(ireq)
                 out.stream_result.CopyFrom(wire.stream_result_to_pb(res))
             else:
-                context.abort(
-                    grpc.StatusCode.UNIMPLEMENTED,
-                    f"BydbQL catalog {catalog} not yet wired",
+                # NotImplementedError maps to UNIMPLEMENTED in _abort;
+                # aborting inside the try would be re-caught and
+                # re-aborted as INTERNAL with a spurious stack trace
+                raise NotImplementedError(
+                    f"BydbQL catalog {catalog} not yet wired"
                 )
             return out
         except Exception as e:  # noqa: BLE001

@@ -16,6 +16,8 @@ pkg/bydbql/grammar.go, parser.go:67):
     fn         := SUM | COUNT | MIN | MAX | MEAN | AVG
     cond       := name op literal | name IN (lit, ...) | name NOT IN (...)
     op         := = | != | < | <= | > | >=
+    literal    := number | 'string' | $N   ($N binds params[N-1] —
+                  prepared statements)
 
 Hand-written tokenizer + recursive descent -> api.model.QueryRequest.
 """
@@ -39,6 +41,7 @@ _TOKEN = re.compile(
     r"""\s*(?:
         (?P<num>-?\d+(?:\.\d+)?)
       | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+      | (?P<param>\$\d+)
       | (?P<op><=|>=|!=|=|<|>|\(|\)|,|\*)
       | (?P<word>[A-Za-z_][A-Za-z0-9_.\-]*)
     )""",
@@ -62,7 +65,7 @@ def _tokenize(text: str) -> list[tuple[str, str]]:
                 raise QLError(f"bad token at: {text[pos:pos+20]!r}")
             break
         pos = m.end()
-        for kind in ("num", "str", "op", "word"):
+        for kind in ("num", "str", "param", "op", "word"):
             v = m.group(kind)
             if v is not None:
                 out.append((kind, v))
@@ -72,9 +75,10 @@ def _tokenize(text: str) -> list[tuple[str, str]]:
 
 
 class _Parser:
-    def __init__(self, tokens):
+    def __init__(self, tokens, params=()):
         self.toks = tokens
         self.i = 0
+        self.params = list(params)
 
     def peek(self):
         return self.toks[self.i]
@@ -108,18 +112,27 @@ class _Parser:
             return float(v) if "." in v else int(v)
         if kind == "str":
             return v[1:-1].replace("\\'", "'").replace('\\"', '"')
+        if kind == "param":
+            # prepared-statement placeholder: $1-based index into params
+            # (bydbql/v1 QueryRequest.params analog)
+            idx = int(v[1:]) - 1
+            if not (0 <= idx < len(self.params)):
+                raise QLError(f"parameter {v} not bound ({len(self.params)} given)")
+            return self.params[idx]
         if kind == "word":
             return v  # bare identifier treated as string literal
         raise QLError(f"expected literal, got {v!r}")
 
 
-def parse(text: str) -> QueryRequest:
-    return parse_with_catalog(text)[1]
+def parse(text: str, params=()) -> QueryRequest:
+    return parse_with_catalog(text, params)[1]
 
 
-def parse_with_catalog(text: str) -> tuple[str, QueryRequest]:
-    """-> (catalog, request); catalog is measure|stream|trace|property."""
-    p = _Parser(_tokenize(text))
+def parse_with_catalog(text: str, params=()) -> tuple[str, QueryRequest]:
+    """-> (catalog, request); catalog is measure|stream|trace|property.
+    `params` bind $1..$n prepared-statement placeholders in literal
+    positions (pkg/bydbql prepared statements analog)."""
+    p = _Parser(_tokenize(text), params)
     p.expect_word("select")
 
     # ---- projection ----

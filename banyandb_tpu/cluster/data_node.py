@@ -65,7 +65,9 @@ class DataNode:
         )
         self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
         # per-node FODC agent surface polled by the proxy (admin/fodc.py)
-        self.bus.subscribe("diagnostics", self._on_diagnostics)
+        from banyandb_tpu.admin.diagnostics import DIAG_TOPIC
+
+        self.bus.subscribe(DIAG_TOPIC, self._on_diagnostics)
 
     def _on_diagnostics(self, env: dict) -> dict:
         from banyandb_tpu.admin.diagnostics import DiagnosticsCollector

@@ -36,7 +36,7 @@ TOPIC_REGISTRY = "registry"
 TOPIC_STREAM_QUERY = "stream-query-user"
 TOPIC_SNAPSHOT = "snapshot"
 TOPIC_METRICS = "metrics"
-TOPIC_DIAGNOSTICS = "diagnostics"
+from banyandb_tpu.admin.diagnostics import DIAG_TOPIC as TOPIC_DIAGNOSTICS  # noqa: E402
 TOPIC_TOPN = "topn"
 
 # conservative per-point admission estimate for the memory protector
@@ -267,7 +267,9 @@ class StandaloneServer:
         return {"properties": [{"id": p.id, "tags": p.tags} for p in props]}
 
     def _ql(self, env):
-        catalog, req = bydbql.parse_with_catalog(env["ql"])
+        catalog, req = bydbql.parse_with_catalog(
+            env["ql"], env.get("params", ())
+        )
         t0 = time.perf_counter()
         if catalog == "stream":
             res = self.stream.query(req)
@@ -566,6 +568,11 @@ def main(argv=None) -> None:
 
     group = Group("standalone")
     group.add(FuncUnit("server", serve=announce, stop=srv.stop))
+    # panic supervisor: uncaught exceptions on any thread write a crash
+    # artifact and trigger orderly teardown (supervisor.go analog)
+    from banyandb_tpu.admin.supervisor import Supervisor
+
+    Supervisor(srv.root, on_crash=group.trigger_stop).install()
     group.run()
     # grpc's worker threads are non-daemon; an in-flight slow handler
     # (e.g. a TPU compile) must not wedge process exit after SIGTERM.

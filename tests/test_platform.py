@@ -231,3 +231,53 @@ def test_console_served_and_no_thread_leaks(tmp_path):
         if not t.daemon and t.ident not in before
     ]
     assert not leaked, f"non-daemon threads leaked: {leaked}"
+
+# -- supervisor + prepared statements ---------------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_supervisor_captures_thread_crash(tmp_path):
+    import time
+
+    from banyandb_tpu.admin.supervisor import Supervisor
+
+    stops = []
+    sup = Supervisor(tmp_path, on_crash=lambda: stops.append(1)).install()
+    try:
+        t = threading.Thread(
+            target=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+            name="crasher",
+        )
+        t.start()
+        t.join()
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and sup.crashes == 0:
+            time.sleep(0.02)
+        assert sup.crashes == 1 and stops == [1]
+        artifacts = list((tmp_path / "crash").glob("*")) or list(
+            tmp_path.rglob("crash*")
+        )
+        assert artifacts, "crash artifact not written"
+    finally:
+        sup.uninstall()
+
+
+def test_ql_prepared_statement_params():
+    from banyandb_tpu import bydbql
+
+    cat, req = bydbql.parse_with_catalog(
+        "SELECT sum(v) FROM MEASURE m IN g WHERE svc = $1 AND lat > $2 "
+        "GROUP BY svc",
+        params=["checkout", 250],
+    )
+    assert cat == "measure"
+    from banyandb_tpu.api.model import Condition, LogicalExpression
+
+    assert isinstance(req.criteria, LogicalExpression)
+    assert req.criteria.left == Condition("svc", "eq", "checkout")
+    assert req.criteria.right == Condition("lat", "gt", 250)
+
+    with pytest.raises(bydbql.QLError, match="not bound"):
+        bydbql.parse("SELECT * FROM MEASURE m IN g WHERE svc = $3", params=["a"])
