@@ -162,11 +162,11 @@ class FodcProxy:
             if rss > rss_limit_bytes:
                 self._last_trigger = now
                 self.triggered += 1
-                # reuse the probe snapshots; they ARE the evidence
+                # no preset here: the probes were collected WITHOUT thread
+                # dumps, and an RSS bundle without stacks is useless —
+                # re-poll (in parallel) with include_threads=True
                 return self.capture(
-                    reason=f"rss-{n.name}",
-                    include_threads=True,
-                    preset={k: d for k, (d, st) in probes.items() if st == "ok"},
+                    reason=f"rss-{n.name}", include_threads=True
                 )
         return None
 

@@ -406,23 +406,25 @@ class WireServices:
     # -- registries --------------------------------------------------------
     def _registry_handlers(self, kind: str):
         """CRUD handlers for one registry service; kind in
-        {group, measure, stream}."""
+        {group, measure, stream}.  Non-group kinds ride the shared
+        spec-registry generator (same shapes); group has its own request
+        forms (string-keyed, SchemaInfo delete response, has_group-only
+        exist)."""
+        if kind != "group":
+            return self._spec_registry_handlers(
+                f"{kind.capitalize()}RegistryService",
+                kind,
+                kind,
+                getattr(wire, f"{kind}_to_internal"),
+                getattr(wire, f"{kind}_to_pb"),
+            )
         rpcpb = pb.database_rpc_pb2
-        P = f"{kind.capitalize()}RegistryService"
+        P = "GroupRegistryService"
 
         def create(req, context):
             try:
-                if kind == "group":
-                    rev = self.registry.create_group(wire.group_to_internal(req.group))
-                elif kind == "measure":
-                    rev = self.registry.create_measure(
-                        wire.measure_to_internal(req.measure)
-                    )
-                else:
-                    rev = self.registry.create_stream(
-                        wire.stream_to_internal(req.stream)
-                    )
-                return getattr(rpcpb, f"{P}CreateResponse")(mod_revision=rev or 1)
+                rev = self.registry.create_group(wire.group_to_internal(req.group))
+                return rpcpb.GroupRegistryServiceCreateResponse(mod_revision=rev or 1)
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
 
@@ -430,87 +432,45 @@ class WireServices:
             # registry _put is an upsert with mod-revision bump, matching
             # the reference's Update semantics
             try:
-                if kind == "group":
-                    rev = self.registry.create_group(wire.group_to_internal(req.group))
-                elif kind == "measure":
-                    rev = self.registry.create_measure(
-                        wire.measure_to_internal(req.measure)
-                    )
-                else:
-                    rev = self.registry.create_stream(
-                        wire.stream_to_internal(req.stream)
-                    )
-                return getattr(rpcpb, f"{P}UpdateResponse")(mod_revision=rev or 1)
+                rev = self.registry.create_group(wire.group_to_internal(req.group))
+                return rpcpb.GroupRegistryServiceUpdateResponse(mod_revision=rev or 1)
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
 
         def delete(req, context):
             try:
-                if kind == "group":
-                    self.registry.delete_group(req.group)
-                    return getattr(rpcpb, f"{P}DeleteResponse")()
-                getattr(self.registry, f"delete_{kind}")(
-                    req.metadata.group, req.metadata.name
-                )
-                return getattr(rpcpb, f"{P}DeleteResponse")(deleted=True)
+                self.registry.delete_group(req.group)
+                return rpcpb.GroupRegistryServiceDeleteResponse()
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
 
         def get(req, context):
             try:
-                if kind == "group":
-                    g = self.registry.get_group(req.group)
-                    return getattr(rpcpb, f"{P}GetResponse")(group=wire.group_to_pb(g))
-                obj = getattr(self.registry, f"get_{kind}")(
-                    req.metadata.group, req.metadata.name
-                )
-                to_pb = getattr(wire, f"{kind}_to_pb")
-                return getattr(rpcpb, f"{P}GetResponse")(**{kind: to_pb(obj)})
+                g = self.registry.get_group(req.group)
+                return rpcpb.GroupRegistryServiceGetResponse(group=wire.group_to_pb(g))
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
 
         def list_(req, context):
             try:
-                if kind == "group":
-                    gs = self.registry.list_groups()
-                    return getattr(rpcpb, f"{P}ListResponse")(
-                        group=[wire.group_to_pb(g) for g in gs]
-                    )
-                objs = getattr(self.registry, f"list_{kind}s")(req.group)
-                to_pb = getattr(wire, f"{kind}_to_pb")
-                return getattr(rpcpb, f"{P}ListResponse")(
-                    **{kind: [to_pb(o) for o in objs]}
+                gs = self.registry.list_groups()
+                return rpcpb.GroupRegistryServiceListResponse(
+                    group=[wire.group_to_pb(g) for g in gs]
                 )
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
 
         def exist(req, context):
             try:
-                if kind == "group":
-                    try:
-                        self.registry.get_group(req.group)
-                        return rpcpb.GroupRegistryServiceExistResponse(has_group=True)
-                    except KeyError:
-                        return rpcpb.GroupRegistryServiceExistResponse(has_group=False)
-                has_group = True
                 try:
-                    self.registry.get_group(req.metadata.group)
+                    self.registry.get_group(req.group)
+                    return rpcpb.GroupRegistryServiceExistResponse(has_group=True)
                 except KeyError:
-                    has_group = False
-                has = True
-                try:
-                    getattr(self.registry, f"get_{kind}")(
-                        req.metadata.group, req.metadata.name
-                    )
-                except KeyError:
-                    has = False
-                return getattr(rpcpb, f"{P}ExistResponse")(
-                    has_group=has_group, **{f"has_{kind}": has}
-                )
+                    return rpcpb.GroupRegistryServiceExistResponse(has_group=False)
             except Exception as e:  # noqa: BLE001
                 _abort(context, e)
 
-        hs = {
+        return {
             "Create": _unary(create, getattr(rpcpb, f"{P}CreateRequest")),
             "Update": _unary(update, getattr(rpcpb, f"{P}UpdateRequest")),
             "Delete": _unary(delete, getattr(rpcpb, f"{P}DeleteRequest")),
@@ -518,7 +478,101 @@ class WireServices:
             "List": _unary(list_, getattr(rpcpb, f"{P}ListRequest")),
             "Exist": _unary(exist, getattr(rpcpb, f"{P}ExistRequest")),
         }
-        return hs
+
+    def _spec_registry_handlers(
+        self,
+        service: str,
+        pb_field: str,
+        reg_suffix: str,
+        to_internal,
+        to_pb,
+        reg_list: str = "",
+    ):
+        """CRUD handlers for the spec registries (IndexRule / Binding /
+        TopNAggregation) — same shapes as the resource registries but
+        keyed by metadata and named by their proto field."""
+        rpcpb = pb.database_rpc_pb2
+        reg = self.registry
+
+        def create(req, context):
+            try:
+                rev = getattr(reg, f"create_{reg_suffix}")(
+                    to_internal(getattr(req, pb_field))
+                )
+                return getattr(rpcpb, f"{service}CreateResponse")(
+                    mod_revision=rev or 1
+                )
+            except Exception as e:  # noqa: BLE001
+                _abort(context, e)
+
+        def update(req, context):
+            try:
+                rev = getattr(reg, f"create_{reg_suffix}")(
+                    to_internal(getattr(req, pb_field))
+                )
+                return getattr(rpcpb, f"{service}UpdateResponse")(
+                    mod_revision=rev or 1
+                )
+            except Exception as e:  # noqa: BLE001
+                _abort(context, e)
+
+        def delete(req, context):
+            try:
+                getattr(reg, f"delete_{reg_suffix}")(
+                    req.metadata.group, req.metadata.name
+                )
+                return getattr(rpcpb, f"{service}DeleteResponse")(deleted=True)
+            except Exception as e:  # noqa: BLE001
+                _abort(context, e)
+
+        def get(req, context):
+            try:
+                obj = getattr(reg, f"get_{reg_suffix}")(
+                    req.metadata.group, req.metadata.name
+                )
+                return getattr(rpcpb, f"{service}GetResponse")(
+                    **{pb_field: to_pb(obj)}
+                )
+            except Exception as e:  # noqa: BLE001
+                _abort(context, e)
+
+        def list_(req, context):
+            try:
+                objs = getattr(reg, reg_list or f"list_{reg_suffix}s")(req.group)
+                return getattr(rpcpb, f"{service}ListResponse")(
+                    **{pb_field: [to_pb(o) for o in objs]}
+                )
+            except Exception as e:  # noqa: BLE001
+                _abort(context, e)
+
+        def exist(req, context):
+            try:
+                has_group = True
+                try:
+                    reg.get_group(req.metadata.group)
+                except KeyError:
+                    has_group = False
+                has = True
+                try:
+                    getattr(reg, f"get_{reg_suffix}")(
+                        req.metadata.group, req.metadata.name
+                    )
+                except KeyError:
+                    has = False
+                return getattr(rpcpb, f"{service}ExistResponse")(
+                    has_group=has_group, **{f"has_{pb_field}": has}
+                )
+            except Exception as e:  # noqa: BLE001
+                _abort(context, e)
+
+        return {
+            "Create": _unary(create, getattr(rpcpb, f"{service}CreateRequest")),
+            "Update": _unary(update, getattr(rpcpb, f"{service}UpdateRequest")),
+            "Delete": _unary(delete, getattr(rpcpb, f"{service}DeleteRequest")),
+            "Get": _unary(get, getattr(rpcpb, f"{service}GetRequest")),
+            "List": _unary(list_, getattr(rpcpb, f"{service}ListRequest")),
+            "Exist": _unary(exist, getattr(rpcpb, f"{service}ExistRequest")),
+        }
 
     # -- misc services -----------------------------------------------------
     def snapshot(self, req, context):
@@ -609,6 +663,37 @@ class WireServer:
             (
                 "banyandb.database.v1.StreamRegistryService",
                 s._registry_handlers("stream"),
+            ),
+            (
+                "banyandb.database.v1.IndexRuleRegistryService",
+                s._spec_registry_handlers(
+                    "IndexRuleRegistryService",
+                    "index_rule",
+                    "index_rule",
+                    wire.index_rule_to_internal,
+                    wire.index_rule_to_pb,
+                ),
+            ),
+            (
+                "banyandb.database.v1.IndexRuleBindingRegistryService",
+                s._spec_registry_handlers(
+                    "IndexRuleBindingRegistryService",
+                    "index_rule_binding",
+                    "index_rule_binding",
+                    wire.index_rule_binding_to_internal,
+                    wire.index_rule_binding_to_pb,
+                ),
+            ),
+            (
+                "banyandb.database.v1.TopNAggregationRegistryService",
+                s._spec_registry_handlers(
+                    "TopNAggregationRegistryService",
+                    "top_n_aggregation",
+                    "topn",
+                    wire.topn_to_internal,
+                    wire.topn_to_pb,
+                    reg_list="list_topn",
+                ),
             ),
         ]
         if hasattr(pb.database_rpc_pb2, "SnapshotRequest"):

@@ -170,6 +170,20 @@ class IndexRule:
 
 
 @dataclass(frozen=True)
+class IndexRuleBinding:
+    """database/v1 IndexRuleBinding: which rules apply to which subject
+    over a validity window."""
+
+    group: str
+    name: str
+    rules: tuple[str, ...]
+    subject_catalog: str  # stream | measure | trace
+    subject_name: str
+    begin_at_millis: int = 0
+    expire_at_millis: int = 0
+
+
+@dataclass(frozen=True)
 class TopNAggregation:
     """database/v1 TopNAggregation: ingest-time streaming top-N source."""
 
@@ -181,6 +195,9 @@ class TopNAggregation:
     group_by_tag_names: tuple[str, ...] = ()
     counters_number: int = 1000
     lru_size: int = 10
+    # group of the source measure when it differs from the rule's group
+    # ("" = same group); wire Get/List must round-trip this faithfully
+    source_group: str = ""
 
 
 _KINDS = {
@@ -189,6 +206,7 @@ _KINDS = {
     "stream": Stream,
     "trace": Trace,
     "index_rule": IndexRule,
+    "index_rule_binding": IndexRuleBinding,
     "topn": TopNAggregation,
 }
 
@@ -268,6 +286,16 @@ class SchemaRegistry:
         # persisted — after restart objects report rev 0, forcing the
         # barrier to match by content hash
         self._obj_revs: dict[tuple[str, str], int] = {}
+        # content hashes cached at put/load time (objects are frozen
+        # dataclasses) so digests() is a dict copy, not an O(n) hash
+        # pass under the lock
+        self._obj_hashes: dict[tuple[str, str], str] = {}
+        # delete tombstones (key -> buried content hash), PERSISTED:
+        # gossip must propagate deletions instead of resurrecting deleted
+        # objects from lagging peers; the hash scopes the grave to the
+        # EXACT deleted content, so a recreate with different content
+        # gossips normally
+        self._tombstones: dict[str, dict[str, str]] = {k: {} for k in _KINDS}
         self._watchers: list = []
         if self._root and self._root.exists():
             self._load()
@@ -294,13 +322,32 @@ class SchemaRegistry:
             data = fs.read_json(path)
             self._revision = max(self._revision, data.get("revision", 0))
             for key, item in data.get("items", {}).items():
-                self._store[kind][key] = _from_jsonable(cls, item)
+                obj = _from_jsonable(cls, item)
+                self._store[kind][key] = obj
+                self._obj_hashes[(kind, key)] = self.object_hash(obj)
+        tpath = self._root / "tombstones.json"
+        if tpath.exists():
+            data = fs.read_json(tpath)
+            for kind, graves in data.items():
+                if kind in self._tombstones and isinstance(graves, dict):
+                    self._tombstones[kind] = dict(graves)
+
+    def _persist_tombstones(self) -> None:
+        if self._root:
+            fs.atomic_write_json(
+                self._root / "tombstones.json", self._tombstones
+            )
 
     def _put(self, kind: str, obj) -> int:
         with self._lock:
             self._revision += 1
-            self._store[kind][self._key(obj)] = obj
-            self._obj_revs[(kind, self._key(obj))] = self._revision
+            key = self._key(obj)
+            self._store[kind][key] = obj
+            self._obj_revs[(kind, key)] = self._revision
+            self._obj_hashes[(kind, key)] = self.object_hash(obj)
+            if self._tombstones[kind].pop(key, None) is not None:
+                # recreate clears the grave
+                self._persist_tombstones()
             self._persist(kind)
             for w in self._watchers:
                 w(kind, obj, self._revision)
@@ -318,8 +365,13 @@ class SchemaRegistry:
             if key not in self._store[kind]:
                 raise KeyError(f"{kind} {key} not found")
             self._revision += 1
+            buried = self._obj_hashes.pop((kind, key), None) or self.object_hash(
+                self._store[kind][key]
+            )
             del self._store[kind][key]
+            self._tombstones[kind][key] = buried
             self._persist(kind)
+            self._persist_tombstones()
 
     # -- public CRUD (parity with the 9 registry services) -----------------
     @property
@@ -337,17 +389,60 @@ class SchemaRegistry:
         payload = _json.dumps(_to_jsonable(obj), sort_keys=True)
         return hashlib.blake2b(payload.encode(), digest_size=16).hexdigest()
 
+    def digests(self) -> dict[str, dict[str, str]]:
+        """{kind: {key: content-hash}} over the whole store — the gossip
+        reconciliation unit (pkg/schema cache sync analog).  Hashes are
+        cached at put/load time, so this is a dict copy under the lock."""
+        with self._lock:
+            return {
+                kind: {
+                    k: self._obj_hashes.get((kind, k)) or self.object_hash(o)
+                    for k, o in objs.items()
+                }
+                for kind, objs in self._store.items()
+            }
+
+    def tombstones(self) -> dict[str, dict[str, str]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._tombstones.items()}
+
+    def apply_tombstone(self, kind: str, key: str, buried_hash: str) -> bool:
+        """Gossip-propagated deletion: remove the local object ONLY if
+        its content matches what the peer buried (differing content means
+        a newer create, which must survive); records the grave either
+        way so this node stops offering the dead content.  Returns True
+        when something was deleted."""
+        with self._lock:
+            local_hash = self._obj_hashes.get((kind, key))
+            existed = key in self._store[kind]
+            if existed and local_hash != buried_hash:
+                return False  # newer content under the same key: keep it
+            if existed:
+                self._revision += 1
+                del self._store[kind][key]
+                self._obj_hashes.pop((kind, key), None)
+                self._persist(kind)
+            self._tombstones[kind][key] = buried_hash
+            self._persist_tombstones()
+            return existed
+
+    def export_object(self, kind: str, key: str) -> Optional[dict]:
+        """JSON-able form of one stored object (gossip pull)."""
+        with self._lock:
+            obj = self._store[kind].get(key)
+        return None if obj is None else _to_jsonable(obj)
+
     def stored_object_hash(self, kind: str, key: str) -> dict:
         """-> {hash, rev}: rev is this node's LOCAL per-object revision
         (0 after a restart — reloaded objects must then match by hash,
         which is exactly the stale-restart case the barrier closes)."""
         with self._lock:
-            obj = self._store[kind].get(key)
+            present = key in self._store[kind]
+            h = self._obj_hashes.get((kind, key)) if present else None
+            if present and h is None:
+                h = self.object_hash(self._store[kind][key])
             rev = self._obj_revs.get((kind, key), 0)
-        return {
-            "hash": None if obj is None else self.object_hash(obj),
-            "rev": rev,
-        }
+        return {"hash": h, "rev": rev}
 
     def watch(self, callback) -> None:
         """callback(kind, obj, revision) on every create/update."""
@@ -409,13 +504,41 @@ class SchemaRegistry:
     def create_index_rule(self, r: IndexRule) -> int:
         return self._put("index_rule", r)
 
+    def get_index_rule(self, group: str, name: str) -> IndexRule:
+        return self._get("index_rule", f"{group}/{name}")
+
+    def delete_index_rule(self, group: str, name: str) -> None:
+        self._delete("index_rule", f"{group}/{name}")
+
     def list_index_rules(self, group: str) -> list[IndexRule]:
         return [
             r for r in self._store["index_rule"].values() if r.group == group
         ]
 
+    def create_index_rule_binding(self, b: IndexRuleBinding) -> int:
+        return self._put("index_rule_binding", b)
+
+    def get_index_rule_binding(self, group: str, name: str) -> IndexRuleBinding:
+        return self._get("index_rule_binding", f"{group}/{name}")
+
+    def delete_index_rule_binding(self, group: str, name: str) -> None:
+        self._delete("index_rule_binding", f"{group}/{name}")
+
+    def list_index_rule_bindings(self, group: str) -> list[IndexRuleBinding]:
+        return [
+            b
+            for b in self._store["index_rule_binding"].values()
+            if b.group == group
+        ]
+
     def create_topn(self, t: TopNAggregation) -> int:
         return self._put("topn", t)
+
+    def get_topn(self, group: str, name: str) -> TopNAggregation:
+        return self._get("topn", f"{group}/{name}")
+
+    def delete_topn(self, group: str, name: str) -> None:
+        self._delete("topn", f"{group}/{name}")
 
     def list_topn(self, group: str) -> list[TopNAggregation]:
         return [t for t in self._store["topn"].values() if t.group == group]

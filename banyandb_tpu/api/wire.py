@@ -525,3 +525,88 @@ def stream_to_pb(s: isch.Stream):
             fam.tags.add(name=t.name, type=_TAG_TYPE_INV[t.type])
     out.entity.tag_names.extend(s.entity)
     return out
+
+
+# -- index rules / bindings / topn (database/v1) ----------------------------
+
+_IDX_TYPE = {1: "inverted", 2: "skipping", 3: "tree"}
+_IDX_TYPE_INV = {v: k for k, v in _IDX_TYPE.items()}
+
+
+def index_rule_to_internal(r) -> isch.IndexRule:
+    return isch.IndexRule(
+        group=r.metadata.group,
+        name=r.metadata.name,
+        tags=tuple(r.tags),
+        type=_IDX_TYPE.get(r.type, "inverted"),
+        analyzer=r.analyzer,
+    )
+
+
+def index_rule_to_pb(r: isch.IndexRule):
+    out = pb.database_schema_pb2.IndexRule()
+    out.metadata.group = r.group
+    out.metadata.name = r.name
+    out.tags.extend(r.tags)
+    out.type = _IDX_TYPE_INV.get(r.type, 1)
+    out.analyzer = r.analyzer
+    return out
+
+
+def index_rule_binding_to_internal(b) -> isch.IndexRuleBinding:
+    return isch.IndexRuleBinding(
+        group=b.metadata.group,
+        name=b.metadata.name,
+        rules=tuple(b.rules),
+        subject_catalog=_CATALOG.get(
+            b.subject.catalog, isch.Catalog.MEASURE
+        ).value,
+        subject_name=b.subject.name,
+        begin_at_millis=ts_to_millis(b.begin_at),
+        expire_at_millis=ts_to_millis(b.expire_at),
+    )
+
+
+def index_rule_binding_to_pb(b: isch.IndexRuleBinding):
+    out = pb.database_schema_pb2.IndexRuleBinding()
+    out.metadata.group = b.group
+    out.metadata.name = b.name
+    out.rules.extend(b.rules)
+    out.subject.catalog = _CATALOG_INV.get(
+        isch.Catalog(b.subject_catalog), 2
+    )
+    out.subject.name = b.subject_name
+    if b.begin_at_millis:
+        out.begin_at.CopyFrom(millis_to_ts(b.begin_at_millis))
+    if b.expire_at_millis:
+        out.expire_at.CopyFrom(millis_to_ts(b.expire_at_millis))
+    return out
+
+
+def topn_to_internal(t) -> isch.TopNAggregation:
+    src_group = t.source_measure.group
+    return isch.TopNAggregation(
+        group=t.metadata.group,
+        name=t.metadata.name,
+        source_measure=t.source_measure.name,
+        field_name=t.field_name,
+        field_value_sort=_SORT.get(t.field_value_sort, "desc"),
+        group_by_tag_names=tuple(t.group_by_tag_names),
+        counters_number=t.counters_number or 1000,
+        lru_size=t.lru_size or 10,
+        source_group="" if src_group in ("", t.metadata.group) else src_group,
+    )
+
+
+def topn_to_pb(t: isch.TopNAggregation):
+    out = pb.database_schema_pb2.TopNAggregation()
+    out.metadata.group = t.group
+    out.metadata.name = t.name
+    out.source_measure.group = t.source_group or t.group
+    out.source_measure.name = t.source_measure
+    out.field_name = t.field_name
+    out.field_value_sort = 2 if t.field_value_sort == "asc" else 1
+    out.group_by_tag_names.extend(t.group_by_tag_names)
+    out.counters_number = t.counters_number
+    out.lru_size = t.lru_size
+    return out

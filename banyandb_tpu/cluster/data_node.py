@@ -68,6 +68,10 @@ class DataNode:
         from banyandb_tpu.admin.diagnostics import DIAG_TOPIC
 
         self.bus.subscribe(DIAG_TOPIC, self._on_diagnostics)
+        # schema anti-entropy gossip topics (cluster/schema_gossip.py)
+        from banyandb_tpu.cluster import schema_gossip
+
+        schema_gossip.register_handlers(self.bus, self.registry)
 
     def _on_diagnostics(self, env: dict) -> dict:
         from banyandb_tpu.admin.diagnostics import DiagnosticsCollector

@@ -315,3 +315,100 @@ def test_restart_mid_merge_keeps_data(tmp_path, monkeypatch):
         )
     )
     assert sum(res.values["count"]) == 200
+
+
+def test_schema_gossip_converges_missed_node(tmp_path):
+    """A node that missed every push AND lost its handoff spool converges
+    via anti-entropy gossip; content conflicts are surfaced, not
+    auto-resolved."""
+    from banyandb_tpu.cluster import schema_gossip
+    from banyandb_tpu.cluster.data_node import DataNode
+    from banyandb_tpu.cluster.node import NodeInfo
+    from banyandb_tpu.cluster.rpc import LocalTransport
+
+    transport = LocalTransport()
+    regs, nodes = [], []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts()))
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        regs.append(reg)
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+
+    m = Measure(
+        "g", "m", (TagSpec("svc", TagType.STRING),),
+        (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)),
+    )
+    regs[0].create_measure(m)  # node d1 never heard about it
+
+    gossiper = schema_gossip.SchemaGossiper(regs[1], transport, [nodes[0]])
+    report = gossiper.run_once(peer=nodes[0])
+    assert ("measure", "g/m") in report["pulled"]
+    assert regs[1].get_measure("g", "m") == m
+
+    # second round: nothing to do
+    report = gossiper.run_once(peer=nodes[0])
+    assert report["pulled"] == []
+
+    # conflicting content is reported, never overwritten
+    m2 = Measure(
+        "g", "m",
+        (TagSpec("svc", TagType.STRING), TagSpec("x", TagType.STRING)),
+        (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)),
+    )
+    regs[1]._put("measure", m2)
+    report = gossiper.run_once(peer=nodes[0])
+    assert ("measure", "g/m") in report["conflicts"]
+    assert regs[1].get_measure("g", "m") == m2  # untouched
+
+
+def test_schema_gossip_tombstones_propagate(tmp_path):
+    """Deletes propagate via tombstones — a lagging peer's live copy is
+    removed, and the deleter never resurrects the object."""
+    from banyandb_tpu.cluster import schema_gossip
+    from banyandb_tpu.cluster.data_node import DataNode
+    from banyandb_tpu.cluster.node import NodeInfo
+    from banyandb_tpu.cluster.rpc import LocalTransport
+
+    transport = LocalTransport()
+    regs, nodes = [], []
+    m = Measure(
+        "g", "m", (TagSpec("svc", TagType.STRING),),
+        (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)),
+    )
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts()))
+        reg.create_measure(m)
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        regs.append(reg)
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+
+    regs[0].delete_measure("g", "m")  # delete lands only on d0
+
+    # d0 gossips with the lagging d1: must NOT resurrect its own delete
+    g0 = schema_gossip.SchemaGossiper(regs[0], transport, [nodes[1]])
+    report = g0.run_once(peer=nodes[1])
+    assert report["pulled"] == []
+    with pytest.raises(KeyError):
+        regs[0].get_measure("g", "m")
+
+    # d1 gossips with d0: learns the tombstone, deletes its live copy
+    g1 = schema_gossip.SchemaGossiper(regs[1], transport, [nodes[0]])
+    report = g1.run_once(peer=nodes[0])
+    assert ("measure", "g/m") in report["deleted"]
+    with pytest.raises(KeyError):
+        regs[1].get_measure("g", "m")
+
+    # recreate with CHANGED content (the normal case — schema evolved)
+    # un-buries the key and gossips back out; identical-content recreate
+    # stays buried until an authoritative liaison push (documented)
+    m2 = Measure(
+        "g", "m",
+        (TagSpec("svc", TagType.STRING), TagSpec("v2", TagType.STRING)),
+        (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)),
+    )
+    regs[0].create_measure(m2)
+    report = g1.run_once(peer=nodes[0])
+    assert ("measure", "g/m") in report["pulled"]
+    assert regs[1].get_measure("g", "m") == m2

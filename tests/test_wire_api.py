@@ -323,3 +323,72 @@ def test_unknown_measure_is_not_found(server):
     with pytest.raises(grpc.RpcError) as ei:
         query(q)
     assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_spec_registries_roundtrip(server):
+    """IndexRule / IndexRuleBinding / TopNAggregation registries."""
+    _create_group(server)
+    _create_measure(server)
+    rpc = pb.database_rpc_pb2
+    sch = pb.database_schema_pb2
+
+    # index rule
+    create = _method(server, "banyandb.database.v1.IndexRuleRegistryService",
+                     "Create", rpc.IndexRuleRegistryServiceCreateRequest,
+                     rpc.IndexRuleRegistryServiceCreateResponse)
+    req = rpc.IndexRuleRegistryServiceCreateRequest()
+    req.index_rule.metadata.group, req.index_rule.metadata.name = "wg", "svc_idx"
+    req.index_rule.tags.append("svc")
+    req.index_rule.type = 1  # TYPE_INVERTED
+    assert create(req).mod_revision > 0
+    get = _method(server, "banyandb.database.v1.IndexRuleRegistryService",
+                  "Get", rpc.IndexRuleRegistryServiceGetRequest,
+                  rpc.IndexRuleRegistryServiceGetResponse)
+    g = rpc.IndexRuleRegistryServiceGetRequest()
+    g.metadata.group, g.metadata.name = "wg", "svc_idx"
+    got = get(g).index_rule
+    assert list(got.tags) == ["svc"] and got.type == 1
+
+    # binding
+    bc = _method(server, "banyandb.database.v1.IndexRuleBindingRegistryService",
+                 "Create", rpc.IndexRuleBindingRegistryServiceCreateRequest,
+                 rpc.IndexRuleBindingRegistryServiceCreateResponse)
+    req = rpc.IndexRuleBindingRegistryServiceCreateRequest()
+    b = req.index_rule_binding
+    b.metadata.group, b.metadata.name = "wg", "bind1"
+    b.rules.append("svc_idx")
+    b.subject.catalog = 2  # MEASURE
+    b.subject.name = "cpm"
+    assert bc(req).mod_revision > 0
+    bl = _method(server, "banyandb.database.v1.IndexRuleBindingRegistryService",
+                 "List", rpc.IndexRuleBindingRegistryServiceListRequest,
+                 rpc.IndexRuleBindingRegistryServiceListResponse)
+    got = bl(rpc.IndexRuleBindingRegistryServiceListRequest(group="wg"))
+    assert got.index_rule_binding[0].subject.name == "cpm"
+
+    # topn aggregation
+    tc = _method(server, "banyandb.database.v1.TopNAggregationRegistryService",
+                 "Create", rpc.TopNAggregationRegistryServiceCreateRequest,
+                 rpc.TopNAggregationRegistryServiceCreateResponse)
+    req = rpc.TopNAggregationRegistryServiceCreateRequest()
+    t = req.top_n_aggregation
+    t.metadata.group, t.metadata.name = "wg", "top_cpm"
+    t.source_measure.group, t.source_measure.name = "wg", "cpm"
+    t.field_name = "value"
+    t.group_by_tag_names.append("svc")
+    assert tc(req).mod_revision > 0
+    te = _method(server, "banyandb.database.v1.TopNAggregationRegistryService",
+                 "Exist", rpc.TopNAggregationRegistryServiceExistRequest,
+                 rpc.TopNAggregationRegistryServiceExistResponse)
+    e = rpc.TopNAggregationRegistryServiceExistRequest()
+    e.metadata.group, e.metadata.name = "wg", "top_cpm"
+    resp = te(e)
+    assert resp.has_group and resp.has_top_n_aggregation
+
+    # delete index rule
+    dr = _method(server, "banyandb.database.v1.IndexRuleRegistryService",
+                 "Delete", rpc.IndexRuleRegistryServiceDeleteRequest,
+                 rpc.IndexRuleRegistryServiceDeleteResponse)
+    d = rpc.IndexRuleRegistryServiceDeleteRequest()
+    d.metadata.group, d.metadata.name = "wg", "svc_idx"
+    assert dr(d).deleted
