@@ -219,7 +219,7 @@ class DataNode:
             state = self._sync_sessions.pop(session)
             for fname, buf in state["files"].items():
                 fs.atomic_write(state["dir"] / fname, bytes(buf))
-            part_name = self._introduce_part_dir(
+            part_name, _ = self._introduce_part_dir(
                 state["dir"],
                 state["group"],
                 int(state["shard"].split("-")[1]),
@@ -229,15 +229,23 @@ class DataNode:
         raise ValueError(f"bad sync phase {phase}")
 
     def _introduce_part_dir(
-        self, staged_dir, group: str, shard_idx: int, segment_start_millis: int
+        self,
+        staged_dir,
+        group: str,
+        shard_idx: int,
+        segment_start_millis: int,
+        catalog: str = "measure",
     ) -> str:
-        """Move a fully-staged part dir into the shard + publish + register
-        series (shared by the JSON path and streaming chunked sync)."""
+        """Move a fully-staged part dir into the owning engine's shard +
+        publish + register series (shared by the JSON path and streaming
+        chunked sync).  catalog routes measure vs stream parts to their
+        separate TSDB trees."""
         import os
 
         from banyandb_tpu.storage.part import Part
 
-        db = self.measure._tsdb(group)
+        engine = self.stream if catalog == "stream" else self.measure
+        db = engine._tsdb(group)
         seg = db.segment_for(segment_start_millis)
         shard = seg.shards[shard_idx]
         with shard._lock:
@@ -248,7 +256,7 @@ class DataNode:
             part = shard._parts[part_name] = Part(final)
             shard._publish()
         self._register_synced_series(seg, part)
-        return part_name
+        return part_name, final
 
     def install_synced_parts(self, meta, parts) -> None:
         """Streaming ChunkedSyncService install callback
@@ -270,10 +278,26 @@ class DataNode:
                 fs.atomic_write(staged / fname, blob)
             group = meta.group or pmeta.get("group")
             min_ts = int(pmeta.get("min_ts", pi.min_timestamp))
-            part_name = self._introduce_part_dir(
-                staged, group, int(meta.shard_id), min_ts
+            # explicit catalog from the sealer; key-sniff only for parts
+            # written before the field existed
+            catalog = pmeta.get(
+                "catalog", "stream" if "stream" in pmeta else "measure"
             )
-            self._observe_topn_part(group, pmeta, min_ts, int(meta.shard_id), part_name)
+            if catalog not in ("measure", "stream"):
+                raise ValueError(f"unsupported part catalog {catalog!r}")
+            part_name, part_dir = self._introduce_part_dir(
+                staged, group, int(meta.shard_id), min_ts, catalog=catalog
+            )
+            if catalog == "stream":
+                # element-index/bloom sidecars for the installed part
+                try:
+                    self.stream._build_part_index(group, part_dir, pmeta)
+                except Exception:  # noqa: BLE001 - pruning is optional
+                    pass
+            else:
+                self._observe_topn_part(
+                    group, pmeta, min_ts, int(meta.shard_id), part_name
+                )
 
     def _observe_topn_part(
         self, group: str, pmeta: dict, min_ts: int, shard_idx: int, part_name: str

@@ -256,6 +256,13 @@ class Liaison:
             raise RuntimeError("write queue not enabled (enable_write_queue)")
         return self.wqueue.append(req)
 
+    def write_stream_queued(self, group: str, name: str, elements) -> int:
+        """Stream twin of write_measure_queued: elements buffer into
+        sealed payload parts shipped over chunked sync."""
+        if getattr(self, "wqueue", None) is None:
+            raise RuntimeError("write queue not enabled (enable_write_queue)")
+        return self.wqueue.append_stream(group, name, elements)
+
     # -- writes -------------------------------------------------------------
     def write_measure(self, req: WriteRequest) -> int:
         """-> number of distinct points accepted (each counted once,

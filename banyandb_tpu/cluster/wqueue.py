@@ -52,7 +52,8 @@ class WriteQueue:
         self.shipper = shipper
         self.max_rows = max_rows
         self.flush_interval_s = flush_interval_s
-        self._buffers: dict[tuple[str, str, int], MemTable] = {}
+        # key: (catalog, group, resource, shard)
+        self._buffers: dict[tuple[str, str, str, int], MemTable] = {}
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -78,7 +79,7 @@ class WriteQueue:
                 ]
                 sid = hashing.series_id(entity)
                 shard = hashing.shard_id(sid, shard_num)
-                key = (req.group, req.name, shard)
+                key = ("measure", req.group, req.name, shard)
                 buf = self._buffers.get(key)
                 if buf is None:
                     buf = self._buffers[key] = MemTable(tag_names, field_names)
@@ -97,15 +98,59 @@ class WriteQueue:
             self._seal(key)
         return len(req.points)
 
+    def append_stream(self, group: str, name: str, elements) -> int:
+        """Stream twin of append(): elements (models.stream.ElementValue)
+        buffer per (group, stream, shard) with the element-id+body
+        payload column, sealing into stream parts the data node
+        introduces identically to its own flushes."""
+        st = self.registry.get_stream(group, name)
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        tag_names = [t.name for t in st.tags]
+        full = set()
+        with self._lock:
+            for e in elements:
+                entity = [name.encode()] + [
+                    hashing.entity_bytes(e.tags[t]) for t in st.entity
+                ]
+                sid = hashing.series_id(entity)
+                shard = hashing.shard_id(sid, shard_num)
+                key = ("stream", group, name, shard)
+                buf = self._buffers.get(key)
+                if buf is None:
+                    buf = self._buffers[key] = MemTable(
+                        tag_names, [], with_payload=True
+                    )
+                tag_bytes = {
+                    t: hashing.entity_bytes(e.tags[t])
+                    if e.tags.get(t) is not None
+                    else b""
+                    for t in tag_names
+                }
+                from banyandb_tpu.models.stream import encode_element_payload
+
+                buf.append(
+                    e.ts_millis,
+                    sid,
+                    0,
+                    tag_bytes,
+                    {},
+                    payload=encode_element_payload(e.element_id, e.body),
+                )
+                if len(buf) >= self.max_rows:
+                    full.add(key)
+        for key in full:
+            self._seal(key)
+        return len(elements)
+
     # -- seal + ship --------------------------------------------------------
-    def _seal(self, key: tuple[str, str, int]) -> None:
+    def _seal(self, key: tuple[str, str, str, int]) -> None:
         """Swap the buffer out and write its rows as sealed parts in the
         spool — one part per storage segment (rows spanning a segment
         boundary must not land in one part: the receiver installs a part
         into a single segment, and rows outside it would be invisible to
         time-pruned queries).  On write failure the buffer is restored so
         acknowledged rows are never dropped."""
-        group, measure, shard = key
+        catalog, group, resource, shard = key
         with self._lock:
             buf = self._buffers.pop(key, None)
         if buf is None or len(buf) == 0:
@@ -126,9 +171,12 @@ class WriteQueue:
             for start in np.unique(seg_starts).tolist():
                 mask = seg_starts == start
                 session = uuid.uuid4().hex
-                final_parent = self.spool / f"{group}@{measure}@{shard}@{session}"
+                final_parent = self.spool / f"{group}@{resource}@{shard}@{session}"
                 tmp_parent = self.spool / f".tmp-{session}"
                 tmp_parents.append(tmp_parent)
+                payloads = None
+                if cols.payloads is not None:
+                    payloads = [p for p, k in zip(cols.payloads, mask) if k]
                 PartWriter.write(
                     tmp_parent / "part-000000",
                     ts=cols.ts[mask],
@@ -137,7 +185,12 @@ class WriteQueue:
                     tag_codes={t: v[mask] for t, v in cols.tags.items()},
                     tag_dicts=dict(cols.dicts),
                     fields={f: v[mask] for f, v in cols.fields.items()},
-                    extra_meta={"measure": measure, "group": group},
+                    extra_meta={
+                        catalog: resource,
+                        "group": group,
+                        "catalog": catalog,
+                    },
+                    payloads=payloads,
                 )
                 staged.append((tmp_parent, final_parent))
             for tmp_parent, final_parent in staged:
@@ -169,6 +222,7 @@ class WriteQueue:
                             for t in snap.tags
                         },
                         dict(snap.fields),
+                        payloads=snap.payloads,
                     )
             raise
 

@@ -45,6 +45,18 @@ class ElementValue:
     body: bytes = b""
 
 
+def encode_element_payload(element_id: str, body: bytes) -> bytes:
+    """THE payload wire format for stream parts (id NUL body) — every
+    writer (engine, liaison wqueue) and reader goes through this pair so
+    the format can never fork."""
+    return element_id.encode() + b"\x00" + body
+
+
+def decode_element_payload(payload: bytes) -> tuple[str, bytes]:
+    elem_id, _, body = payload.partition(b"\x00")
+    return elem_id.decode(), body
+
+
 class StreamEngine:
     def __init__(self, registry: SchemaRegistry, root: str | Path):
         import threading
@@ -81,16 +93,26 @@ class StreamEngine:
                 self._tsdbs[group] = db
             return db
 
-    def _index_tags(self, group: str) -> tuple[set[str], set[str]]:
-        """(inverted tags, skipping tags) from the group's IndexRules.
-
-        Simplification vs the reference: rules apply to any stream in the
-        group carrying the tag (no IndexRuleBinding subject resolution) —
-        the binding layer routes the same way in the common case of one
-        rule set per group."""
+    def _index_tags(
+        self, group: str, stream_name: str = ""
+    ) -> tuple[set[str], set[str]]:
+        """(inverted tags, skipping tags) for a stream from the group's
+        IndexRules, honoring IndexRuleBinding subject resolution when
+        bindings exist (banyand/metadata binding semantics): with any
+        binding present in the group, only rules bound to this stream
+        apply; with none, every group rule applies (the common
+        one-rule-set-per-group case)."""
+        rules = self.registry.list_index_rules(group)
+        bindings = self.registry.list_index_rule_bindings(group)
+        if bindings and stream_name:
+            bound: set[str] = set()
+            for b in bindings:
+                if b.subject_catalog == "stream" and b.subject_name == stream_name:
+                    bound.update(b.rules)
+            rules = [r for r in rules if r.name in bound]
         inverted: set[str] = set()
         skipping: set[str] = set()
-        for r in self.registry.list_index_rules(group):
+        for r in rules:
             if r.type == "inverted":
                 inverted.update(r.tags)
             elif r.type == "skipping":
@@ -102,7 +124,7 @@ class StreamEngine:
             return
         from banyandb_tpu.index import element
 
-        inverted, skipping = self._index_tags(group)
+        inverted, skipping = self._index_tags(group, meta.get("stream", ""))
         if inverted or skipping:
             element.build_part_index(part_dir, inverted, skipping)
 
@@ -125,7 +147,7 @@ class StreamEngine:
                 else b""
                 for t in s.tags
             }
-            payload = e.element_id.encode() + b"\x00" + e.body
+            payload = encode_element_payload(e.element_id, e.body)
             seg.shards[shard].ingest(
                 lambda mem: mem.append(
                     name, tag_names, e.ts_millis, sid, tag_bytes, payload
@@ -191,7 +213,7 @@ class StreamEngine:
 
         rows: list[tuple] = []
         tag_names = [t.name for t in s.tags]
-        inverted, skipping = self._index_tags(req.groups[0])
+        inverted, skipping = self._index_tags(req.groups[0], s.name)
         stats = {"blocks_selected": 0, "blocks_read": 0, "blocks_skipped": 0}
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
@@ -244,14 +266,14 @@ class StreamEngine:
         out = []
         for i in np.nonzero(mask)[0]:
             payload = src.payloads[i] if src.payloads else b"\x00"
-            elem_id, _, body = payload.partition(b"\x00")
+            elem_id, body = decode_element_payload(payload)
             tags = {
                 t: qfilter.decode_tag_value(
                     src.dicts[t][src.tags[t][i]], s.tag(t).type
                 )
                 for t in src.tags
             }
-            out.append((int(src.ts[i]), elem_id.decode(), body, tags))
+            out.append((int(src.ts[i]), elem_id, body, tags))
         return out
 
 

@@ -221,3 +221,36 @@ def test_device_mask_matches_host_fuzz():
         else:
             dev = host
         np.testing.assert_array_equal(dev, host, err_msg=f"trial {trial}")
+
+
+def test_binding_subject_resolution(tmp_path):
+    """With IndexRuleBindings present, only rules bound to the queried
+    stream build sidecars/prune; streams without a binding get none."""
+    from banyandb_tpu.api.schema import IndexRuleBinding
+
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("bg", Catalog.STREAM, ResourceOpts(shard_num=1)))
+    for name in ("bound", "unbound"):
+        reg.create_stream(
+            Stream(
+                group="bg",
+                name=name,
+                tags=(TagSpec("svc", TagType.STRING),),
+                entity=("svc",),
+            )
+        )
+    reg.create_index_rule(
+        IndexRule(group="bg", name="svc_idx", tags=("svc",), type="inverted")
+    )
+    reg.create_index_rule_binding(
+        IndexRuleBinding(
+            group="bg",
+            name="b1",
+            rules=("svc_idx",),
+            subject_catalog="stream",
+            subject_name="bound",
+        )
+    )
+    eng = StreamEngine(reg, tmp_path / "data")
+    assert eng._index_tags("bg", "bound") == ({"svc"}, set())
+    assert eng._index_tags("bg", "unbound") == (set(), set())

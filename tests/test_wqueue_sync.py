@@ -11,6 +11,7 @@ grpc = pytest.importorskip("grpc")
 from banyandb_tpu.api import (  # noqa: E402
     Aggregation,
     Catalog,
+    Condition,
     DataPointValue,
     Entity,
     FieldSpec,
@@ -267,3 +268,61 @@ def test_wqueue_spool_recovery(tmp_path):
     assert wq2.pending_parts() == 1
     shipped, failed = wq2.ship_pending()
     assert shipped == 1 and failed == 0 and len(delivered) == 1
+
+
+def test_wqueue_stream_catalog(cluster):
+    """Stream elements batch through the write queue into payload parts
+    that data nodes introduce and serve (element ids + bodies intact,
+    element-index sidecars built on install)."""
+    from banyandb_tpu.api.schema import IndexRule, Stream, TagSpec as TS, TagType as TT
+    from banyandb_tpu.models.stream import ElementValue
+
+    liaison, wq, data_nodes = cluster
+    st = Stream(
+        group="wq",
+        name="logs",
+        tags=(TS("svc", TT.STRING), TS("level", TT.STRING)),
+        entity=("svc",),
+    )
+    rule = IndexRule(group="wq", name="svc_idx", tags=("svc",), type="inverted")
+    liaison.registry.create_stream(st)
+    liaison.registry.create_index_rule(rule)
+    for dn in data_nodes:
+        dn.registry.create_stream(st)
+        dn.registry.create_index_rule(rule)
+
+    elements = [
+        ElementValue(
+            element_id=f"e{i}",
+            ts_millis=T0 + i,
+            tags={"svc": f"s{i % 4}", "level": "ERROR" if i % 5 == 0 else "INFO"},
+            body=f"line-{i}".encode(),
+        )
+        for i in range(500)
+    ]
+    liaison.write_stream_queued("wq", "logs", elements)
+    wq.flush()
+    assert wq.pending_parts() == 0
+
+    res = liaison.query_stream(
+        QueryRequest(
+            groups=("wq",),
+            name="logs",
+            time_range=TimeRange(T0, T0 + 1000),
+            criteria=Condition("level", "eq", "ERROR"),
+            limit=1000,
+        )
+    )
+    assert len(res.data_points) == 100
+    sample = next(dp for dp in res.data_points if dp["element_id"] == "e0")
+    assert sample["body"] == b"line-0"
+
+    # installed stream parts carry element-index sidecars
+    sidecars = 0
+    for dn in data_nodes:
+        for seg in dn.stream._tsdb("wq").select_segments(0, 1 << 62):
+            for shard in seg.shards:
+                for part in shard.parts:
+                    if (part.dir / "eidx_svc.bin").exists():
+                        sidecars += 1
+    assert sidecars > 0
