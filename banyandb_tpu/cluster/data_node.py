@@ -40,6 +40,21 @@ class DataNode:
         shutil.rmtree(self.root / ".sync-staging", ignore_errors=True)
         self._register_handlers()
 
+    def start_lifecycle(self, **kw) -> None:
+        """Background flush/merge/retention over ALL engines' TSDBs —
+        installed stream/measure parts (liaison wqueue, tier sync) merge
+        and retention-sweep like locally-written ones."""
+        self.measure.start_lifecycle(
+            extra_tsdbs=lambda: (
+                list(self.stream._tsdbs.values())
+                + list(self.trace._tsdbs.values())
+            ),
+            **kw,
+        )
+
+    def stop_lifecycle(self) -> None:
+        self.measure.stop_lifecycle()
+
     def _register_handlers(self) -> None:
         self.bus.subscribe(Topic.MEASURE_WRITE, self._on_measure_write)
         self.bus.subscribe(Topic.MEASURE_QUERY_PARTIAL, self._on_measure_query_partial)
@@ -235,7 +250,7 @@ class DataNode:
         shard_idx: int,
         segment_start_millis: int,
         catalog: str = "measure",
-    ) -> str:
+    ) -> "tuple[str, Path]":
         """Move a fully-staged part dir into the owning engine's shard +
         publish + register series (shared by the JSON path and streaming
         chunked sync).  catalog routes measure vs stream parts to their
@@ -292,8 +307,13 @@ class DataNode:
                 # element-index/bloom sidecars for the installed part
                 try:
                     self.stream._build_part_index(group, part_dir, pmeta)
-                except Exception:  # noqa: BLE001 - pruning is optional
-                    pass
+                except Exception:  # noqa: BLE001 - pruning is optional,
+                    # but silent degradation to full scans is not
+                    import logging
+
+                    logging.getLogger("banyandb.datanode").exception(
+                        "sidecar build failed for installed part %s", part_dir
+                    )
             else:
                 self._observe_topn_part(
                     group, pmeta, min_ts, int(meta.shard_id), part_name

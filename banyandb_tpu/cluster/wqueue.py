@@ -103,6 +103,8 @@ class WriteQueue:
         buffer per (group, stream, shard) with the element-id+body
         payload column, sealing into stream parts the data node
         introduces identically to its own flushes."""
+        from banyandb_tpu.models.stream import encode_element_payload
+
         st = self.registry.get_stream(group, name)
         shard_num = self.registry.get_group(group).resource_opts.shard_num
         tag_names = [t.name for t in st.tags]
@@ -126,8 +128,6 @@ class WriteQueue:
                     else b""
                     for t in tag_names
                 }
-                from banyandb_tpu.models.stream import encode_element_payload
-
                 buf.append(
                     e.ts_millis,
                     sid,

@@ -59,14 +59,23 @@ class MeasureEngine:
                 st = self._dict_states[key] = measure_exec.DictState()
             return st
 
-    def start_lifecycle(self, **kw) -> None:
-        """Start background flush/merge/retention (svc_standalone analog)."""
+    def start_lifecycle(self, extra_tsdbs=None, **kw) -> None:
+        """Start background flush/merge/retention (svc_standalone analog).
+
+        extra_tsdbs: optional callable returning MORE TSDBs to manage —
+        the stream/trace engines' trees, so parts installed there (e.g.
+        via the liaison write queue) merge and retention-sweep too."""
         from banyandb_tpu.storage.loops import LifecycleLoops
 
         if self._loops is None:
-            self._loops = LifecycleLoops(
-                lambda: list(self._tsdbs.values()), **kw
-            )
+
+            def all_tsdbs():
+                out = list(self._tsdbs.values())
+                if extra_tsdbs is not None:
+                    out.extend(extra_tsdbs())
+                return out
+
+            self._loops = LifecycleLoops(all_tsdbs, **kw)
             self._loops.start()
 
     def stop_lifecycle(self) -> None:

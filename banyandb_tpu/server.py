@@ -494,8 +494,15 @@ class StandaloneServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
-        # one lifecycle daemon drives storage loops AND property-lease GC
-        self.measure.start_lifecycle(extra_tick=self._sweep_properties)
+        # one lifecycle group drives storage loops for ALL engines' TSDBs
+        # AND property-lease GC
+        self.measure.start_lifecycle(
+            extra_tick=self._sweep_properties,
+            extra_tsdbs=lambda: (
+                list(self.stream._tsdbs.values())
+                + list(self.trace._tsdbs.values())
+            ),
+        )
         self.grpc.start()
         if self.wire is not None:
             self.wire.start()

@@ -2,16 +2,24 @@
 
 The reference runs four channel-connected goroutine loops per shard
 (introducer/flusher/merger/syncer, banyand/measure/tstable.go:250).  The
-introducer's role (snapshot epoch ownership) is folded into the shard lock
-here; this module provides the periodic driver for the remaining three:
+introducer's role (snapshot epoch ownership) is folded into the shard
+lock here; the remaining stages run as CONCURRENT daemon threads wired
+by a queue, so a long merge never delays flushes (and vice versa):
 
-  flush tick   -> memtable -> parts       (flusher.go:28)
-  merge tick   -> size-tiered compaction  (merger.go:39)
-  retention    -> drop expired segments   (rotation.go retentionTask)
+  flusher thread   memtable -> parts; enqueues flushed shards (flusher.go:28)
+  merger thread    drains the queue: size-tiered compaction of exactly
+                   the shards that grew, plus a periodic full sweep
+                   (merger.go:39)
+  retention thread retention sweeps + index persistence + engine extras
+                   (rotation.go retentionTask)
+
+``tick()`` still runs every stage once synchronously — the test/manual
+entry point and the unit of each thread's work.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Callable, Optional
@@ -19,8 +27,43 @@ from typing import Callable, Optional
 from banyandb_tpu.storage.tsdb import TSDB
 
 
+class _RWLock:
+    """Tiny readers-writer lock: flush/merge stages run concurrently
+    (readers), retention's segment deletion is exclusive (writer) — a
+    sweep must never rmtree a segment an in-flight flush/merge is about
+    to write into (zombie seg-* dirs resurrected on restart)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        with self._cond:
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writer = True
+
+    def release_write(self):
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+
 class LifecycleLoops:
-    """One daemon thread driving flush/merge/retention for a set of TSDBs."""
+    """Concurrent stage threads driving flush/merge/retention."""
 
     def __init__(
         self,
@@ -29,6 +72,7 @@ class LifecycleLoops:
         flush_interval_s: float = 1.0,
         flush_min_rows: int = 1,
         retention_interval_s: float = 60.0,
+        merge_sweep_interval_s: float = 10.0,
         clock: Callable[[], float] = time.time,
         extra_tick: Optional[Callable[[], None]] = None,
     ):
@@ -36,60 +80,139 @@ class LifecycleLoops:
         self.flush_interval_s = flush_interval_s
         self.flush_min_rows = flush_min_rows
         self.retention_interval_s = retention_interval_s
+        self.merge_sweep_interval_s = merge_sweep_interval_s
         self._clock = clock
         self._extra_tick = extra_tick
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: list[threading.Thread] = []
+        self._merge_q: "queue.Queue" = queue.Queue()
         self._last_retention = 0.0
+        self._rw = _RWLock()
 
-    def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()  # allow stop() -> start() restart
-        self._thread = threading.Thread(
-            target=self._run, name="bydb-lifecycle", daemon=True
-        )
-        self._thread.start()
+    # -- stage bodies (each also usable synchronously via tick()) -----------
+    def flush_stage(self) -> int:
+        flushed = 0
+        self._rw.acquire_read()
+        try:
+            for db in self._tsdbs():
+                for seg in db.segments:
+                    for shard in seg.shards:
+                        if len(shard.mem) >= self.flush_min_rows:
+                            names = shard.flush()
+                            if names:
+                                flushed += len(names)
+                                self._merge_q.put(shard)
+                    # the sidx file is the only store for index-mode
+                    # measures: persist at FLUSH cadence (a crash loses at
+                    # most one flush interval of docs, not a retention one)
+                    seg.persist_index()
+        finally:
+            self._rw.release_read()
+        if self._extra_tick is not None:  # e.g. property-lease GC: same
+            # tight cadence the single-thread loop gave it
+            self._extra_tick()
+        return flushed
 
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            self._thread = None
+    def merge_shard(self, shard) -> int:
+        merged = 0
+        self._rw.acquire_read()
+        try:
+            while True:
+                if not shard.merge():
+                    break
+                merged += 1
+        finally:
+            self._rw.release_read()
+        return merged
 
-    def tick(self) -> dict:
-        """One round of flush+merge(+retention). Exposed for tests/manual."""
-        stats = {"flushed": 0, "merged": 0, "retired": 0}
-        now = self._clock()
+    def merge_sweep(self) -> int:
+        merged = 0
         for db in self._tsdbs():
             for seg in db.segments:
                 for shard in seg.shards:
-                    if len(shard.mem) >= self.flush_min_rows:
-                        names = shard.flush()
-                        stats["flushed"] += len(names or [])
-                    while True:
-                        merged = shard.merge()
-                        if not merged:
-                            break
-                        stats["merged"] += 1
-                # Series/index-mode docs must survive restarts too — the
-                # sidx file is the only store for index-mode measures.
-                seg.persist_index()
-            if now - self._last_retention >= self.retention_interval_s:
-                stats["retired"] += len(
-                    db.retention_sweep(int(now * 1000))
-                )
-        if now - self._last_retention >= self.retention_interval_s:
-            self._last_retention = now
-        if self._extra_tick is not None:
-            self._extra_tick()
+                    merged += self.merge_shard(shard)
+        return merged
+
+    def retention_stage(self, force: bool = False) -> int:
+        retired = 0
+        now = self._clock()
+        due = force or (now - self._last_retention >= self.retention_interval_s)
+        if not due:
+            return 0
+        # exclusive: segment deletion must not interleave with in-flight
+        # flush/merge writes (zombie segment dirs)
+        self._rw.acquire_write()
+        try:
+            for db in self._tsdbs():
+                retired += len(db.retention_sweep(int(now * 1000)))
+        finally:
+            self._rw.release_write()
+        self._last_retention = now
+        return retired
+
+    def tick(self) -> dict:
+        """One synchronous round of every stage (tests/manual driving)."""
+        stats = {"flushed": 0, "merged": 0, "retired": 0}
+        stats["flushed"] = self.flush_stage()
+        # drain what the flush enqueued, then sweep for anything else
+        while True:
+            try:
+                shard = self._merge_q.get_nowait()
+            except queue.Empty:
+                break
+            stats["merged"] += self.merge_shard(shard)
+        stats["merged"] += self.merge_sweep()
+        stats["retired"] = self.retention_stage(force=False)
         return stats
 
-    def _run(self) -> None:
-        while not self._stop.wait(self.flush_interval_s):
-            try:
-                self.tick()
-            except Exception:  # pragma: no cover - keep the loop alive
-                import logging
+    # -- threads ------------------------------------------------------------
+    def _guard(self, fn: Callable[[], None], name: str) -> None:
+        try:
+            fn()
+        except Exception:  # pragma: no cover - keep the loop alive
+            import logging
 
-                logging.getLogger(__name__).exception("lifecycle tick failed")
+            logging.getLogger(__name__).exception("%s stage failed", name)
+
+    def _flusher(self) -> None:
+        while not self._stop.wait(self.flush_interval_s):
+            self._guard(self.flush_stage, "flush")
+
+    def _merger(self) -> None:
+        last_sweep = 0.0
+        while not self._stop.is_set():
+            try:
+                shard = self._merge_q.get(timeout=self.flush_interval_s)
+                self._guard(lambda: self.merge_shard(shard), "merge")
+            except queue.Empty:
+                pass
+            now = self._clock()
+            if now - last_sweep >= self.merge_sweep_interval_s:
+                last_sweep = now
+                self._guard(lambda: self.merge_sweep(), "merge-sweep")
+
+    def _retainer(self) -> None:
+        while not self._stop.wait(min(self.retention_interval_s, 5.0)):
+            self._guard(lambda: self.retention_stage(False), "retention")
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()  # allow stop() -> start() restart
+        # first retention waits a FULL interval (an immediate first-fire
+        # would race fresh test/startup data whose timestamps predate TTL)
+        self._last_retention = self._clock()
+        for target, name in (
+            (self._flusher, "bydb-flusher"),
+            (self._merger, "bydb-merger"),
+            (self._retainer, "bydb-retention"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=30)
+        self._threads = []

@@ -201,3 +201,61 @@ def test_reopen_after_merge(engine, tmp_path):
     reg2 = SchemaRegistry(tmp_path)
     eng2 = MeasureEngine(reg2, tmp_path / "data")
     assert _count(eng2) == 10
+
+
+def test_concurrent_stage_threads(tmp_path):
+    """The staged threads (flusher -> queue -> merger, retention) drive
+    the lifecycle without manual ticks (tstable.go channel-loop analog)."""
+    import time as _time
+
+    from banyandb_tpu.api import (
+        Catalog,
+        DataPointValue,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        Measure,
+        ResourceOpts,
+        SchemaRegistry,
+        TagSpec,
+        TagType,
+        WriteRequest,
+    )
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    T0 = 1_700_000_000_000
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(
+        Measure("g", "m", (TagSpec("svc", TagType.STRING),),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    )
+    eng = MeasureEngine(reg, tmp_path / "data")
+    eng.start_lifecycle(flush_interval_s=0.05, retention_interval_s=3600)
+    try:
+        for batch in range(10):  # > DEFAULT_MAX_PARTS so merging engages
+            eng.write(WriteRequest("g", "m", tuple(
+                DataPointValue(T0 + batch * 100 + j, {"svc": f"s{j%3}"},
+                               {"v": 1.0}, version=1)
+                for j in range(50)
+            )))
+            _time.sleep(0.08)  # let the flusher pick each batch up
+        deadline = _time.monotonic() + 5
+        db = eng._tsdb("g")
+        while _time.monotonic() < deadline:
+            shard = db.select_segments(0, 1 << 62)[0].shards[0]
+            if len(shard.mem) == 0 and shard.parts:
+                break
+            _time.sleep(0.05)
+        shard = db.select_segments(0, 1 << 62)[0].shards[0]
+        assert len(shard.mem) == 0, "flusher thread never drained the memtable"
+        assert shard.parts, "no parts produced"
+        # merger thread compacts once the part count passes the
+        # size-tiered threshold (DEFAULT_MAX_PARTS=8)
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and len(shard.parts) > 7:
+            _time.sleep(0.1)
+        assert len(shard.parts) <= 7, f"{len(shard.parts)} parts left unmerged"
+    finally:
+        eng.stop_lifecycle()
