@@ -43,12 +43,14 @@ class DataNode:
     def start_lifecycle(self, **kw) -> None:
         """Background flush/merge/retention over ALL engines' TSDBs —
         installed stream/measure parts (liaison wqueue, tier sync) merge
-        and retention-sweep like locally-written ones."""
+        and retention-sweep like locally-written ones; the extra tick
+        runs trace maintenance (blooms + sidx flush/merge)."""
         self.measure.start_lifecycle(
             extra_tsdbs=lambda: (
                 list(self.stream._tsdbs.values())
                 + list(self.trace._tsdbs.values())
             ),
+            extra_tick=self.trace.maintain,
             **kw,
         )
 
@@ -259,7 +261,10 @@ class DataNode:
 
         from banyandb_tpu.storage.part import Part
 
-        engine = self.stream if catalog == "stream" else self.measure
+        engine = {
+            "stream": self.stream,
+            "trace": self.trace,
+        }.get(catalog, self.measure)
         db = engine._tsdb(group)
         seg = db.segment_for(segment_start_millis)
         shard = seg.shards[shard_idx]
@@ -298,12 +303,25 @@ class DataNode:
             catalog = pmeta.get(
                 "catalog", "stream" if "stream" in pmeta else "measure"
             )
-            if catalog not in ("measure", "stream"):
+            if catalog not in ("measure", "stream", "trace"):
                 raise ValueError(f"unsupported part catalog {catalog!r}")
             part_name, part_dir = self._introduce_part_dir(
                 staged, group, int(meta.shard_id), min_ts, catalog=catalog
             )
-            if catalog == "stream":
+            if catalog == "trace":
+                try:
+                    self._index_trace_part(
+                        group, pmeta, min_ts, int(meta.shard_id), part_dir
+                    )
+                except Exception:  # noqa: BLE001 - retrieval stays correct
+                    # via full scans; ordered/bloom pruning degrades
+                    import logging
+
+                    logging.getLogger("banyandb.datanode").exception(
+                        "trace index build failed for installed part %s",
+                        part_dir,
+                    )
+            elif catalog == "stream":
                 # element-index/bloom sidecars for the installed part
                 try:
                     self.stream._build_part_index(group, part_dir, pmeta)
@@ -318,6 +336,56 @@ class DataNode:
                 self._observe_topn_part(
                     group, pmeta, min_ts, int(meta.shard_id), part_name
                 )
+
+    def _index_trace_part(
+        self, group: str, pmeta: dict, min_ts: int, shard_idx: int, part_dir
+    ) -> None:
+        """Installed trace parts need the same auxiliaries local writes
+        get: a trace-id bloom sidecar and sidx ordered-index entries for
+        the part's tree-indexed tags (shipped in the part meta)."""
+        from banyandb_tpu.index.sidx import encode_ref
+        from banyandb_tpu.models.trace import write_trace_bloom
+        from banyandb_tpu.storage.part import Part
+
+        name = pmeta.get("trace")
+        if not name:
+            return
+        t = self.registry.get_trace(group, name)
+        part = Part(part_dir)
+        write_trace_bloom(part, t.trace_id_tag)
+        ordered = [
+            rt
+            for rt in pmeta.get("ordered_tags", ())
+            if rt in part.meta.get("tags", ())
+        ]
+        if not ordered or t.trace_id_tag not in part.meta.get("tags", ()):
+            return
+        db = self.trace._tsdb(group)
+        seg = db.segment_for(min_ts)
+        cols = part.read(
+            range(len(part.blocks)),
+            tags=[t.trace_id_tag] + ordered,
+            cached=False,
+        )
+        from banyandb_tpu.query.filter import decode_tag_value
+
+        for rt in ordered:
+            store = self.trace._ordered_index(group, seg, rt)
+            tid_col = cols.tags[t.trace_id_tag]
+            rt_col = cols.tags[rt]
+            for i in range(cols.ts.size):
+                raw = cols.dicts[rt][rt_col[i]]
+                if not raw:
+                    continue
+                tid = decode_tag_value(
+                    cols.dicts[t.trace_id_tag][tid_col[i]],
+                    t.tag(t.trace_id_tag).type,
+                )
+                store.insert(
+                    int.from_bytes(raw, "little", signed=True),
+                    encode_ref(str(tid), int(cols.ts[i])),
+                )
+            store.flush()
 
     def _observe_topn_part(
         self, group: str, pmeta: dict, min_ts: int, shard_idx: int, part_name: str

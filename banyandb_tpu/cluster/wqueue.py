@@ -55,6 +55,8 @@ class WriteQueue:
         # key: (catalog, group, resource, shard)
         self._buffers: dict[tuple[str, str, str, int], MemTable] = {}
         self._lock = threading.Lock()
+        # ordered-tag sets per trace buffer (ride in sealed part meta)
+        self._trace_meta: dict[tuple, tuple[str, ...]] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # orphaned sealed parts from a previous process retry first
@@ -142,6 +144,48 @@ class WriteQueue:
             self._seal(key)
         return len(elements)
 
+    def append_trace(self, group: str, name: str, spans, ordered_tags=()) -> int:
+        """Trace twin of append(): spans (models.trace.SpanValue) buffer
+        per (group, trace, shard) — trace routing hashes the TRACE ID
+        (partition.TraceShardID), not the series — with the opaque span
+        payload.  ordered_tags ride in part meta so the data node can
+        rebuild sidx entries on install."""
+        from banyandb_tpu.models.trace import trace_shard_id
+
+        t = self.registry.get_trace(group, name)
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        tag_names = [x.name for x in t.tags]
+        full = set()
+        with self._lock:
+            for sp in spans:
+                trace_id = str(sp.tags[t.trace_id_tag])
+                sid = hashing.series_id([name.encode(), trace_id.encode()])
+                shard = trace_shard_id(trace_id, shard_num)
+                key = ("trace", group, name, shard)
+                buf = self._buffers.get(key)
+                if buf is None:
+                    buf = self._buffers[key] = MemTable(
+                        tag_names, [], with_payload=True
+                    )
+                # union across calls: a later batch naming MORE ordered
+                # tags must not be silently ignored for this buffer
+                prev = self._trace_meta.get(key, ())
+                self._trace_meta[key] = tuple(
+                    dict.fromkeys((*prev, *ordered_tags))
+                )
+                tag_bytes = {
+                    x: hashing.entity_bytes(sp.tags[x])
+                    if sp.tags.get(x) is not None
+                    else b""
+                    for x in tag_names
+                }
+                buf.append(sp.ts_millis, sid, 0, tag_bytes, {}, payload=sp.span)
+                if len(buf) >= self.max_rows:
+                    full.add(key)
+        for key in full:
+            self._seal(key)
+        return len(spans)
+
     # -- seal + ship --------------------------------------------------------
     def _seal(self, key: tuple[str, str, str, int]) -> None:
         """Swap the buffer out and write its rows as sealed parts in the
@@ -177,6 +221,15 @@ class WriteQueue:
                 payloads = None
                 if cols.payloads is not None:
                     payloads = [p for p, k in zip(cols.payloads, mask) if k]
+                extra_meta = {
+                    catalog: resource,
+                    "group": group,
+                    "catalog": catalog,
+                }
+                if catalog == "trace":
+                    extra_meta["ordered_tags"] = list(
+                        self._trace_meta.get(key, ())
+                    )
                 PartWriter.write(
                     tmp_parent / "part-000000",
                     ts=cols.ts[mask],
@@ -185,11 +238,7 @@ class WriteQueue:
                     tag_codes={t: v[mask] for t, v in cols.tags.items()},
                     tag_dicts=dict(cols.dicts),
                     fields={f: v[mask] for f, v in cols.fields.items()},
-                    extra_meta={
-                        catalog: resource,
-                        "group": group,
-                        "catalog": catalog,
-                    },
+                    extra_meta=extra_meta,
                     payloads=payloads,
                 )
                 staged.append((tmp_parent, final_parent))

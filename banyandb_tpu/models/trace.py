@@ -44,6 +44,22 @@ class SpanValue:
     span: bytes  # opaque span payload
 
 
+def write_trace_bloom(part, trace_id_tag: str) -> bool:
+    """THE trace-id bloom sidecar builder — local flushes and installed
+    (liaison-shipped) parts both go through this, so sizing/encoding/
+    filename can never fork.  Returns True when a bloom was written."""
+    from banyandb_tpu.utils import fs
+
+    if trace_id_tag not in part.meta.get("tags", ()):
+        return False
+    ids = part.dict_for(trace_id_tag)
+    bloom = Bloom(max(len(ids), 1))
+    for v in ids:
+        bloom.add(v)
+    fs.atomic_write(part.dir / BLOOM_FILE, bloom.to_bytes())
+    return True
+
+
 def trace_shard_id(trace_id: str, shard_num: int) -> int:
     """partition.TraceShardID analog: hash the trace id directly."""
     h = hashlib.blake2b(trace_id.encode(), digest_size=8).digest()
@@ -161,15 +177,20 @@ class TraceEngine:
                         t = self.registry.get_trace(group, name)
                     except KeyError:
                         continue
-                    if t.trace_id_tag not in part.meta["tags"]:
-                        continue
-                    ids = part.dict_for(t.trace_id_tag)
-                    bloom = Bloom(max(len(ids), 1))
-                    for v in ids:
-                        bloom.add(v)
-                    from banyandb_tpu.utils import fs
+                    write_trace_bloom(part, t.trace_id_tag)
 
-                    fs.atomic_write(part.dir / BLOOM_FILE, bloom.to_bytes())
+    def maintain(self, group: Optional[str] = None) -> None:
+        """Periodic companion work the generic lifecycle flusher can't do
+        for trace TSDBs: trace-id bloom sidecars on new parts + sidx
+        ordered-index flush/merge (sidx mem entries are memory-only
+        until flushed — a crash before flush loses the ORDERING for
+        otherwise-durable spans).  Wired as the lifecycle extra tick."""
+        for gname, db in list(self._tsdbs.items()):
+            if group is None or gname == group:
+                self._write_blooms(db, gname)
+        for idx in list(self._sidx.values()):
+            idx.flush()
+            idx.merge()
 
     def finalize_segments(self, group: str) -> int:
         """Run the sampler chain over COMPLETE segments: every shard's

@@ -517,6 +517,12 @@ class StandaloneServer:
                 self.property.sweep_expired(g.name)
             except Exception:  # noqa: BLE001 - GC must not kill the loop
                 pass
+        try:
+            # trace maintenance: bloom sidecars + sidx flush/merge (the
+            # ordering index is memory-only until flushed)
+            self.trace.maintain()
+        except Exception:  # noqa: BLE001
+            pass
 
     def stop(self) -> None:
         self.measure.stop_lifecycle()

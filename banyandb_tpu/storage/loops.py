@@ -37,10 +37,13 @@ class _RWLock:
         self._cond = threading.Condition()
         self._readers = 0
         self._writer = False
+        self._writers_waiting = 0
 
     def acquire_read(self):
         with self._cond:
-            while self._writer:
+            # writer preference: new readers queue behind a waiting
+            # writer, or the 1s flusher/merger cadence starves retention
+            while self._writer or self._writers_waiting:
                 self._cond.wait()
             self._readers += 1
 
@@ -52,8 +55,12 @@ class _RWLock:
 
     def acquire_write(self):
         with self._cond:
-            while self._writer or self._readers:
-                self._cond.wait()
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
             self._writer = True
 
     def release_write(self):
@@ -117,6 +124,11 @@ class LifecycleLoops:
         merged = 0
         self._rw.acquire_read()
         try:
+            # a queued shard may belong to a segment retention deleted
+            # between enqueue and dequeue: merging it would recreate the
+            # deleted directory (zombie segment) — skip dead shards
+            if not shard.root.exists():
+                return 0
             while True:
                 if not shard.merge():
                     break
