@@ -28,7 +28,11 @@ from banyandb_tpu.api import schema as isch
 # enum maps (numbers fixed by the protos)
 _AGG_FN = {1: "mean", 2: "max", 3: "min", 4: "count", 5: "sum"}
 _AGG_FN_INV = {v: k for k, v in _AGG_FN.items()}
-_SORT = {0: "desc", 1: "desc", 2: "asc"}
+# SORT_UNSPECIFIED (0) means ascending in query order_by paths
+# (banyand/measure/query.go:292 treats SORT_ASC || SORT_UNSPECIFIED alike);
+# only TopN field_value_sort defaults to desc (measure_plan_top.go:69).
+_SORT = {0: "asc", 1: "desc", 2: "asc"}
+_SORT_TOPN = {0: "desc", 1: "desc", 2: "asc"}
 _CATALOG = {1: isch.Catalog.STREAM, 2: isch.Catalog.MEASURE,
             3: isch.Catalog.PROPERTY, 4: isch.Catalog.TRACE}
 _CATALOG_INV = {v: k for k, v in _CATALOG.items()}
@@ -201,7 +205,7 @@ def measure_query_to_internal(req) -> im.QueryRequest:
         top = im.Top(
             number=req.top.number or 100,
             field_name=req.top.field_name,
-            field_value_sort=_SORT.get(req.top.field_value_sort, "desc"),
+            field_value_sort=_SORT_TOPN.get(req.top.field_value_sort, "desc"),
         )
     order_by_ts = ""
     order_by_tag = ""
@@ -590,7 +594,7 @@ def topn_to_internal(t) -> isch.TopNAggregation:
         name=t.metadata.name,
         source_measure=t.source_measure.name,
         field_name=t.field_name,
-        field_value_sort=_SORT.get(t.field_value_sort, "desc"),
+        field_value_sort=_SORT_TOPN.get(t.field_value_sort, "desc"),
         group_by_tag_names=tuple(t.group_by_tag_names),
         counters_number=t.counters_number or 1000,
         lru_size=t.lru_size or 10,

@@ -35,6 +35,19 @@ class DataNode:
         from banyandb_tpu.admin.diskmonitor import DiskMonitor
 
         self.disk = DiskMonitor(self.root)
+        # Persisted content digests of installed synced parts, for
+        # idempotent re-delivery.  dict-as-ordered-set so the size bound
+        # evicts the OLDEST digest, never a fresh one.
+        import json as _json
+        import threading
+
+        try:
+            self._installed = dict.fromkeys(
+                _json.loads((self.root / ".sync-installed.json").read_text())
+            )
+        except (OSError, ValueError):
+            self._installed = {}
+        self._installed_lock = threading.Lock()
         self._sync_sessions: dict[str, dict] = {}
         # abandoned chunked-sync sessions from a previous process die here
         shutil.rmtree(self.root / ".sync-staging", ignore_errors=True)
@@ -278,25 +291,71 @@ class DataNode:
         self._register_synced_series(seg, part)
         return part_name, final
 
+    def _synced_part_digest(self, files: dict) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        for fname in sorted(files):
+            h.update(fname.encode())
+            h.update(b"\0")
+            h.update(files[fname])
+            h.update(b"\0")
+        return h.hexdigest()
+
+    def _persist_installed_digests(self) -> None:
+        """Flush the installed-digest record (call with new digests already
+        in self._installed; one write covers a whole sync batch)."""
+        with self._installed_lock:
+            # bound the sidecar; dict preserves insertion order, so this
+            # evicts the oldest digests — far beyond any re-ship window
+            while len(self._installed) > 8192:
+                del self._installed[next(iter(self._installed))]
+            # write under the lock: concurrent batch persists must not
+            # land out of order and drop each other's digests from disk
+            fs.atomic_write_json(
+                self.root / ".sync-installed.json", list(self._installed)
+            )
+
     def install_synced_parts(self, meta, parts) -> None:
         """Streaming ChunkedSyncService install callback
         (cluster/chunked_sync.py): write each part's files to staging,
         then introduce into the shard owning meta.shard_id.  The target
         segment comes from each part's min timestamp (the reference's
-        receiver does the same: parts land in their time's segment)."""
+        receiver does the same: parts land in their time's segment).
+        Idempotent per part content hash: re-delivery after a partial
+        ship installs nothing twice."""
         import json as _json
         import uuid as _uuid
 
         self.disk.check_write()
-        for pi, files in parts:
-            if "metadata.json" not in files:
-                raise ValueError("part missing metadata.json")
-            pmeta = _json.loads(files["metadata.json"])
+        installed_any = False
+        try:
+            for pi, files in parts:
+                installed_any |= self._install_one_synced_part(
+                    meta, pi, files, _json, _uuid
+                )
+        finally:
+            if installed_any:
+                self._persist_installed_digests()
+
+    def _install_one_synced_part(self, meta, pi, files, _json, _uuid) -> bool:
+        if "metadata.json" not in files:
+            raise ValueError("part missing metadata.json")
+        pmeta = _json.loads(files["metadata.json"])
+        group = meta.group or pmeta.get("group")
+        digest = f"{group}/{int(meta.shard_id)}/{self._synced_part_digest(files)}"
+        with self._installed_lock:
+            if digest in self._installed:
+                return False
+            # claim in-flight under the same acquisition: a concurrent
+            # re-delivery of this part must not pass the check while the
+            # first install is still running
+            self._installed[digest] = None
+        try:
             staged = self.root / ".sync-staging" / _uuid.uuid4().hex
             staged.mkdir(parents=True, exist_ok=True)
             for fname, blob in files.items():
                 fs.atomic_write(staged / fname, blob)
-            group = meta.group or pmeta.get("group")
             min_ts = int(pmeta.get("min_ts", pi.min_timestamp))
             # explicit catalog from the sealer; key-sniff only for parts
             # written before the field existed
@@ -308,34 +367,40 @@ class DataNode:
             part_name, part_dir = self._introduce_part_dir(
                 staged, group, int(meta.shard_id), min_ts, catalog=catalog
             )
-            if catalog == "trace":
-                try:
-                    self._index_trace_part(
-                        group, pmeta, min_ts, int(meta.shard_id), part_dir
-                    )
-                except Exception:  # noqa: BLE001 - retrieval stays correct
-                    # via full scans; ordered/bloom pruning degrades
-                    import logging
-
-                    logging.getLogger("banyandb.datanode").exception(
-                        "trace index build failed for installed part %s",
-                        part_dir,
-                    )
-            elif catalog == "stream":
-                # element-index/bloom sidecars for the installed part
-                try:
-                    self.stream._build_part_index(group, part_dir, pmeta)
-                except Exception:  # noqa: BLE001 - pruning is optional,
-                    # but silent degradation to full scans is not
-                    import logging
-
-                    logging.getLogger("banyandb.datanode").exception(
-                        "sidecar build failed for installed part %s", part_dir
-                    )
-            else:
-                self._observe_topn_part(
-                    group, pmeta, min_ts, int(meta.shard_id), part_name
+        except BaseException:
+            # failed install releases the claim so a retry can proceed
+            with self._installed_lock:
+                self._installed.pop(digest, None)
+            raise
+        if catalog == "trace":
+            try:
+                self._index_trace_part(
+                    group, pmeta, min_ts, int(meta.shard_id), part_dir
                 )
+            except Exception:  # noqa: BLE001 - retrieval stays correct
+                # via full scans; ordered/bloom pruning degrades
+                import logging
+
+                logging.getLogger("banyandb.datanode").exception(
+                    "trace index build failed for installed part %s",
+                    part_dir,
+                )
+        elif catalog == "stream":
+            # element-index/bloom sidecars for the installed part
+            try:
+                self.stream._build_part_index(group, part_dir, pmeta)
+            except Exception:  # noqa: BLE001 - pruning is optional,
+                # but silent degradation to full scans is not
+                import logging
+
+                logging.getLogger("banyandb.datanode").exception(
+                    "sidecar build failed for installed part %s", part_dir
+                )
+        else:
+            self._observe_topn_part(
+                group, pmeta, min_ts, int(meta.shard_id), part_name
+            )
+        return True
 
     def _index_trace_part(
         self, group: str, pmeta: dict, min_ts: int, shard_idx: int, part_dir

@@ -236,7 +236,9 @@ class Liaison:
                         chan, [part_dir], group=group, shard_id=shard
                     )
                     delivered.add(node.name)
-                    record.write_text(_json.dumps(sorted(delivered)))
+                    from banyandb_tpu.utils import fs as _fs
+
+                    _fs.atomic_write_json(record, sorted(delivered))
                 except TransportError as e:
                     self.alive.discard(node.name)
                     errors.append(f"{node.name}: {e}")

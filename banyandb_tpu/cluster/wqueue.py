@@ -225,6 +225,10 @@ class WriteQueue:
                     catalog: resource,
                     "group": group,
                     "catalog": catalog,
+                    # unique per seal: receiver-side dedup must distinguish
+                    # re-delivery of THIS part from an independent later
+                    # seal of byte-identical content (client retry batch)
+                    "seal_session": session,
                 }
                 if catalog == "trace":
                     extra_meta["ordered_tags"] = list(

@@ -86,8 +86,14 @@ def device_tag_mask(src: ColumnData, conds: list[Condition]):
         lut = {v: i for i, v in enumerate(d)}
         if c.op in ("in", "not_in"):
             codes = sorted({lut.get(tag_value_bytes(v), -1) for v in c.value})
-            arr = np.asarray(codes or [-1], dtype=np.int32)
-            preds.append((c.op, len(arr)))
+            # pad the set to the next power of two with the -1 sentinel
+            # (matches no real code, codes are dict indices >= 0) so the
+            # jit cache is keyed by O(log) set sizes, not every distinct
+            # IN-list cardinality seen
+            padded_len = 1 << max(0, (len(codes) - 1)).bit_length() if codes else 1
+            arr = np.full(padded_len, -1, dtype=np.int32)
+            arr[: len(codes)] = codes
+            preds.append((c.op, padded_len))
             pred_vals.append(jnp.asarray(arr))
         else:
             code = lut.get(tag_value_bytes(c.value), -1)

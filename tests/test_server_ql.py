@@ -162,8 +162,16 @@ def test_server_topn_topic(server):
 def test_server_stream_and_trace_topics(server):
     import base64
 
+    from banyandb_tpu.api import Catalog, Group, ResourceOpts
     from banyandb_tpu.cluster.rpc import GrpcTransport
     from banyandb_tpu.server import TOPIC_REGISTRY
+
+    try:
+        server.registry.get_group("sw")
+    except KeyError:  # independent of test ordering
+        server.registry.create_group(
+            Group("sw", Catalog.MEASURE, ResourceOpts(shard_num=2))
+        )
 
     t = GrpcTransport()
     try:

@@ -392,3 +392,29 @@ def test_spec_registries_roundtrip(server):
     d = rpc.IndexRuleRegistryServiceDeleteRequest()
     d.metadata.group, d.metadata.name = "wg", "svc_idx"
     assert dr(d).deleted
+
+
+def test_sort_unspecified_means_ascending():
+    """ADVICE r2: SORT_UNSPECIFIED (0) in query order_by is ascending
+    (banyand/measure/query.go:292); only TopN field_value_sort defaults
+    to desc (measure_plan_top.go:69)."""
+    from banyandb_tpu.api import wire
+
+    mq = pb.measure_query_pb2.QueryRequest(groups=["g"], name="m")
+    mq.order_by.index_rule_name = ""  # timestamp order
+    mq.order_by.sort = 0  # SORT_UNSPECIFIED
+    req = wire.measure_query_to_internal(mq)
+    assert req.order_by_ts == "asc"
+    mq.order_by.sort = 1  # SORT_DESC
+    assert wire.measure_query_to_internal(mq).order_by_ts == "desc"
+
+    sq = pb.stream_query_pb2.QueryRequest(groups=["g"], name="s")
+    sq.order_by.index_rule_name = "idx_tag"
+    sq.order_by.sort = 0
+    assert wire.stream_query_to_internal(sq).order_by_dir == "asc"
+
+    mq2 = pb.measure_query_pb2.QueryRequest(groups=["g"], name="m")
+    mq2.top.number = 5
+    mq2.top.field_name = "f"
+    mq2.top.field_value_sort = 0  # unspecified -> desc for TopN
+    assert wire.measure_query_to_internal(mq2).top.field_value_sort == "desc"

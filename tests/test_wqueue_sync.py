@@ -382,3 +382,58 @@ def test_wqueue_trace_catalog(cluster):
     assert blooms > 0 and ordered > 0
     # the global slowest trace (dur=1990 -> t19) tops ITS owning node
     assert "t19" in tops
+
+
+def test_sync_redelivery_is_idempotent(cluster):
+    """Receiver-side dedup (ADVICE r2): a part re-shipped after a liaison
+    crash between sync and its delivered.json record must not install
+    twice — stream/trace payload rows have no query-time version dedup."""
+    liaison, wq, data_nodes = cluster
+    pts = tuple(
+        DataPointValue(
+            ts_millis=T0 + i,
+            tags={"svc": f"s{i % 4}", "region": "eu"},
+            fields={"lat": float(i)},
+            version=1,
+        )
+        for i in range(256)
+    )
+    liaison.write_measure_queued(WriteRequest("wq", "m", pts))
+    wq.flush()
+
+    def part_count(dn):
+        return sum(
+            len(shard.parts)
+            for seg in dn.measure._tsdb("wq").select_segments(0, 1 << 62)
+            for shard in seg.shards
+        )
+
+    # find one installed part on a data node and re-ship it verbatim
+    target = None
+    for dn, ni in zip(data_nodes, liaison.selector.nodes):
+        for seg in dn.measure._tsdb("wq").select_segments(0, 1 << 62):
+            for si, shard in enumerate(seg.shards):
+                for part in shard.parts:
+                    target = (dn, ni, si, part.dir)
+        if target:
+            break
+    assert target is not None
+    dn, ni, shard_idx, part_dir = target
+    before = part_count(dn)
+
+    chan = liaison.transport.channel(ni.addr)
+    for _ in range(2):  # re-deliver twice; both must be skipped
+        chunked_sync.sync_part_dirs(
+            chan, [part_dir], group="wq", shard_id=shard_idx
+        )
+    assert part_count(dn) == before
+
+    # and the digest record survives restart-shaped reloads
+    import json
+
+    dn._installed = dict.fromkeys(
+        json.loads((dn.root / ".sync-installed.json").read_text())
+    )
+    assert dn._installed  # persisted record was non-empty
+    chunked_sync.sync_part_dirs(chan, [part_dir], group="wq", shard_id=shard_idx)
+    assert part_count(dn) == before
