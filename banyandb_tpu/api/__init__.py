@@ -19,6 +19,7 @@ from banyandb_tpu.api.schema import (
     Measure,
     Stream,
     Trace,
+    PropertySchema,
     IndexRule,
     TopNAggregation,
     SchemaRegistry,

@@ -58,6 +58,105 @@ def _abort(context, e: Exception):
     context.abort(grpc.StatusCode.INTERNAL, str(e))
 
 
+API_VERSION = "0.10"  # upstream api/proto/banyandb/version.go:22
+API_REVISION = "banyandb-tpu"
+
+# SchemaBarrierService key kinds (schema/v1/barrier.proto:46) -> registry kinds
+_BARRIER_KINDS = {
+    "measure": "measure",
+    "stream": "stream",
+    "trace": "trace",
+    "property": "property_schema",
+    "index_rule": "index_rule",
+    "index_rule_binding": "index_rule_binding",
+    "group": "group",
+    "top_n_aggregation": "topn",
+}
+
+
+class RegistryBarrier:
+    """Standalone SchemaBarrierService backend: the only 'cluster member'
+    is this process's registry (barrier.proto:30 — the standalone
+    implementation).  Cluster deployments pass a liaison-backed object
+    with the same three methods instead."""
+
+    def __init__(self, registry, node_name: str = "standalone"):
+        self.registry = registry
+        self.node = node_name
+
+    def _poll(self, deadline: float, check):
+        import time as _time
+
+        while True:
+            laggards = check()
+            if not laggards or _time.monotonic() >= deadline:
+                return (not laggards), laggards
+            _time.sleep(0.02)
+
+    def await_revision(self, min_revision: int, timeout_s: float):
+        import time as _time
+
+        def check():
+            rev = self.registry.revision
+            if rev >= min_revision:
+                return []
+            return [{"node": self.node, "current_mod_revision": rev}]
+
+        return self._poll(_time.monotonic() + timeout_s, check)
+
+    def await_applied(self, keys, min_revisions, timeout_s: float):
+        import time as _time
+
+        def check():
+            missing = []
+            for (kind, group, name), min_rev in zip(keys, min_revisions):
+                rkind = _BARRIER_KINDS.get(kind)
+                if rkind is None:
+                    raise ValueError(f"unknown schema kind {kind!r}")
+                key = name if rkind == "group" else f"{group}/{name}"
+                st = self.registry.stored_object_hash(rkind, key)
+                present = st["hash"] is not None
+                # rev 0 means "just present"; local revs reset on restart,
+                # so a present object always satisfies rev 0
+                if not present or (min_rev and st["rev"] < min_rev):
+                    missing.append((kind, group, name))
+            if missing:
+                return [
+                    {
+                        "node": self.node,
+                        "current_mod_revision": self.registry.revision,
+                        "missing_keys": missing,
+                    }
+                ]
+            return []
+
+        return self._poll(_time.monotonic() + timeout_s, check)
+
+    def await_deleted(self, keys, timeout_s: float):
+        import time as _time
+
+        def check():
+            present = []
+            for kind, group, name in keys:
+                rkind = _BARRIER_KINDS.get(kind)
+                if rkind is None:
+                    raise ValueError(f"unknown schema kind {kind!r}")
+                key = name if rkind == "group" else f"{group}/{name}"
+                if self.registry.stored_object_hash(rkind, key)["hash"] is not None:
+                    present.append((kind, group, name))
+            if present:
+                return [
+                    {
+                        "node": self.node,
+                        "current_mod_revision": self.registry.revision,
+                        "still_present_keys": present,
+                    }
+                ]
+            return []
+
+        return self._poll(_time.monotonic() + timeout_s, check)
+
+
 class WireServices:
     """Service handlers bound to the engines (StandaloneServer-compatible:
     any object exposing .registry/.measure/.stream works)."""
@@ -70,6 +169,9 @@ class WireServices:
         bydbql_fn=None,
         property_engine=None,
         trace_engine=None,
+        node_info: dict | None = None,
+        cluster_view_fn=None,
+        barrier=None,
     ):
         self.registry = registry
         self.measure = measure_engine
@@ -77,6 +179,25 @@ class WireServices:
         self.bydbql_fn = bydbql_fn
         self.property = property_engine
         self.trace = trace_engine
+        # NodeQuery/ClusterState context: standalone defaults report this
+        # single node as the whole (healthy) cluster
+        self.node_info = node_info or {"name": "standalone", "roles": ("data", "liaison")}
+        self.cluster_view_fn = cluster_view_fn or (
+            lambda: {
+                "tire2": {
+                    "registered": [dict(self.node_info)],
+                    "active": [self.node_info.get("name", "standalone")],
+                    "evictable": [],
+                }
+            }
+        )
+        self.barrier = barrier or RegistryBarrier(registry)
+        # Barrier RPCs hold a worker thread for their whole wait; cap the
+        # concurrent waiters so they can never exhaust the server pool and
+        # starve the very writes that would satisfy them.
+        import threading as _threading
+
+        self._barrier_slots = _threading.BoundedSemaphore(4)
 
     @staticmethod
     def _one_group(ireq) -> str:
@@ -588,6 +709,120 @@ class WireServices:
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
 
+    def get_api_version(self, req, context):
+        """common/v1 Service.GetAPIVersion (api_version.go analog):
+        clients negotiate compatibility from this before issuing calls."""
+        out = pb.common_rpc_pb2.GetAPIVersionResponse()
+        out.version.version = API_VERSION
+        out.version.revision = API_REVISION
+        return out
+
+    _ROLE = {"meta": 1, "data": 2, "liaison": 3}
+
+    def _node_to_pb(self, node_pb, info: dict) -> None:
+        node_pb.metadata.name = info.get("name", "")
+        node_pb.grpc_address = info.get("grpc_address", "")
+        node_pb.http_address = info.get("http_address", "")
+        for r in info.get("roles", ()):
+            node_pb.roles.append(self._ROLE.get(r, 0))
+        for k, v in (info.get("labels") or {}).items():
+            node_pb.labels[k] = v
+
+    def get_current_node(self, req, context):
+        """database/v1 NodeQueryService.GetCurrentNode (rpc.proto:928)."""
+        out = pb.database_rpc_pb2.GetCurrentNodeResponse()
+        self._node_to_pb(out.node, self.node_info)
+        return out
+
+    def get_cluster_state(self, req, context):
+        """database/v1 ClusterStateService (rpc.proto:952): route tables
+        of registered/active/evictable members per tier."""
+        try:
+            out = pb.database_rpc_pb2.GetClusterStateResponse()
+            for tier, table in self.cluster_view_fn().items():
+                rt = out.route_tables[tier]
+                for info in table.get("registered", ()):
+                    self._node_to_pb(rt.registered.add(), info)
+                rt.active.extend(table.get("active", ()))
+                rt.evictable.extend(table.get("evictable", ()))
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    # -- schema barrier (schema/v1/barrier.proto:30) -----------------------
+    @staticmethod
+    def _barrier_timeout(req) -> float:
+        d = req.timeout
+        s = d.seconds + d.nanos / 1e9
+        return s if s > 0 else 10.0
+
+    @staticmethod
+    def _laggards_to_pb(resp, laggards) -> None:
+        for lag in laggards:
+            lpb = resp.laggards.add(
+                node=lag.get("node", ""),
+                current_mod_revision=lag.get("current_mod_revision", 0),
+                reason=lag.get("reason", ""),
+            )
+            for kind, group, name in lag.get("missing_keys", ()):
+                lpb.missing_keys.add(kind=kind, group=group, name=name)
+            for kind, group, name in lag.get("still_present_keys", ()):
+                lpb.still_present_keys.add(kind=kind, group=group, name=name)
+
+    def _barrier_slot(self, context):
+        if not self._barrier_slots.acquire(blocking=False):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many concurrent schema barrier waits",
+            )
+
+    def barrier_await_revision(self, req, context):
+        self._barrier_slot(context)
+        try:
+            applied, laggards = self.barrier.await_revision(
+                req.min_revision, self._barrier_timeout(req)
+            )
+            out = pb.schema_barrier_pb2.AwaitRevisionAppliedResponse(applied=applied)
+            self._laggards_to_pb(out, laggards)
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+        finally:
+            self._barrier_slots.release()
+
+    def barrier_await_applied(self, req, context):
+        self._barrier_slot(context)
+        try:
+            if len(req.keys) > 10000:
+                raise ValueError("keys capped at 10000")
+            keys = [(k.kind, k.group, k.name) for k in req.keys]
+            revs = list(req.min_revisions) + [0] * (len(keys) - len(req.min_revisions))
+            applied, laggards = self.barrier.await_applied(
+                keys, revs, self._barrier_timeout(req)
+            )
+            out = pb.schema_barrier_pb2.AwaitSchemaAppliedResponse(applied=applied)
+            self._laggards_to_pb(out, laggards)
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+        finally:
+            self._barrier_slots.release()
+
+    def barrier_await_deleted(self, req, context):
+        self._barrier_slot(context)
+        try:
+            keys = [(k.kind, k.group, k.name) for k in req.keys]
+            applied, laggards = self.barrier.await_deleted(
+                keys, self._barrier_timeout(req)
+            )
+            out = pb.schema_barrier_pb2.AwaitSchemaDeletedResponse(applied=applied)
+            self._laggards_to_pb(out, laggards)
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+        finally:
+            self._barrier_slots.release()
+
     def bydbql_query(self, req, context):
         """bydbql/v1 Query: parse QL, dispatch by catalog, return the
         catalog-typed result in the response oneof."""
@@ -627,9 +862,21 @@ class WireServer:
         port: int = 17912,
         host: str = "127.0.0.1",
         max_workers: int = 8,
+        auth_file: str | None = None,
+        health_auth: bool = False,
     ):
         self.services = services
-        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        interceptors = ()
+        self.auth = None
+        if auth_file:
+            from banyandb_tpu.api.auth import AuthReloader, BasicAuthInterceptor
+
+            self.auth = AuthReloader(auth_file, health_auth=health_auth)
+            interceptors = (BasicAuthInterceptor(self.auth),)
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            interceptors=interceptors,
+        )
         s = services
         mq = pb.measure_query_pb2
         mw = pb.measure_write_pb2
@@ -733,6 +980,71 @@ class WireServer:
                     },
                 )
             )
+        generic += [
+            (
+                "banyandb.database.v1.TraceRegistryService",
+                s._spec_registry_handlers(
+                    "TraceRegistryService",
+                    "trace",
+                    "trace",
+                    wire.trace_to_internal,
+                    wire.trace_to_pb,
+                ),
+            ),
+            (
+                "banyandb.database.v1.PropertyRegistryService",
+                s._spec_registry_handlers(
+                    "PropertyRegistryService",
+                    "property",
+                    "property_schema",
+                    wire.property_schema_to_internal,
+                    wire.property_schema_to_pb,
+                ),
+            ),
+            (
+                "banyandb.common.v1.Service",
+                {
+                    "GetAPIVersion": _unary(
+                        s.get_api_version, pb.common_rpc_pb2.GetAPIVersionRequest
+                    )
+                },
+            ),
+            (
+                "banyandb.database.v1.NodeQueryService",
+                {
+                    "GetCurrentNode": _unary(
+                        s.get_current_node,
+                        pb.database_rpc_pb2.GetCurrentNodeRequest,
+                    )
+                },
+            ),
+            (
+                "banyandb.database.v1.ClusterStateService",
+                {
+                    "GetClusterState": _unary(
+                        s.get_cluster_state,
+                        pb.database_rpc_pb2.GetClusterStateRequest,
+                    )
+                },
+            ),
+            (
+                "banyandb.schema.v1.SchemaBarrierService",
+                {
+                    "AwaitRevisionApplied": _unary(
+                        s.barrier_await_revision,
+                        pb.schema_barrier_pb2.AwaitRevisionAppliedRequest,
+                    ),
+                    "AwaitSchemaApplied": _unary(
+                        s.barrier_await_applied,
+                        pb.schema_barrier_pb2.AwaitSchemaAppliedRequest,
+                    ),
+                    "AwaitSchemaDeleted": _unary(
+                        s.barrier_await_deleted,
+                        pb.schema_barrier_pb2.AwaitSchemaDeletedRequest,
+                    ),
+                },
+            ),
+        ]
         self.server.add_generic_rpc_handlers(
             tuple(
                 grpc.method_handlers_generic_handler(name, hs)

@@ -56,8 +56,18 @@ _GRPC_TO_HTTP = {
 
 
 class HttpGateway:
-    def __init__(self, services, host: str = "127.0.0.1", port: int = 17913):
+    def __init__(
+        self,
+        services,
+        host: str = "127.0.0.1",
+        port: int = 17913,
+        auth=None,
+    ):
+        """auth: optional banyandb_tpu.api.auth.AuthReloader — when set,
+        every API route (healthz excepted) requires HTTP Basic credentials
+        from the same hot-reloaded users file as the gRPC surface."""
         self.services = services
+        self.auth = auth
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -72,7 +82,33 @@ class HttpGateway:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _check_auth(self) -> bool:
+                if gateway.auth is None:
+                    return True
+                import base64
+
+                hdr = self.headers.get("Authorization", "")
+                if hdr.startswith("Basic "):
+                    try:
+                        user, _, pw = (
+                            base64.b64decode(hdr[6:]).decode().partition(":")
+                        )
+                    except (ValueError, UnicodeDecodeError):
+                        user = pw = ""
+                    if user and gateway.auth.check(user, pw):
+                        return True
+                body = json.dumps({"error": "Invalid credentials"}).encode()
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", 'Basic realm="banyandb"')
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return False
+
             def _dispatch(self, method: str):
+                if not self._check_auth():
+                    return
                 try:
                     route = gateway._route(method, self.path.rstrip("/"))
                     if route is None:
@@ -167,6 +203,36 @@ class HttpGateway:
                 s.trace_query,
                 pb.trace_query_pb2.QueryRequest,
             )
+        from banyandb_tpu.api import wire as _wire
+
+        self._reg["trace"] = s._spec_registry_handlers(
+            "TraceRegistryService", "trace", "trace",
+            _wire.trace_to_internal, _wire.trace_to_pb,
+        )
+        self._reg["property"] = s._spec_registry_handlers(
+            "PropertyRegistryService", "property", "property_schema",
+            _wire.property_schema_to_internal, _wire.property_schema_to_pb,
+        )
+        self._post[("v1", "trace", "schema")] = (
+            self._reg["trace"]["Create"].unary_unary,
+            rpc.TraceRegistryServiceCreateRequest,
+        )
+        self._post[("v1", "property", "schema")] = (
+            self._reg["property"]["Create"].unary_unary,
+            rpc.PropertyRegistryServiceCreateRequest,
+        )
+        # parameterless GET endpoints (rpc.proto:952 /v1/cluster/state,
+        # common/v1/rpc.proto /v1/common/api/version)
+        self._get_plain = {
+            ("v1", "cluster", "state"): (
+                s.get_cluster_state,
+                pb.database_rpc_pb2.GetClusterStateRequest,
+            ),
+            ("v1", "common", "api", "version"): (
+                s.get_api_version,
+                pb.common_rpc_pb2.GetAPIVersionRequest,
+            ),
+        }
 
     # -- routing -----------------------------------------------------------
     def _route(self, method: str, path: str):
@@ -178,6 +244,9 @@ class HttpGateway:
         if method == "POST":
             hit = self._post.get(tuple(parts))
             return (hit[0], hit[1]()) if hit else None
+        hit = self._get_plain.get(tuple(parts))
+        if hit:
+            return (hit[0], hit[1]())
         # GET routes with path params
         if len(parts) == 4 and parts[:3] == ["v1", "group", "schema"]:
             if parts[3] == "lists":
@@ -189,7 +258,7 @@ class HttpGateway:
                 self._reg["group"]["Get"].unary_unary,
                 rpc.GroupRegistryServiceGetRequest(group=parts[3]),
             )
-        for kind in ("measure", "stream"):
+        for kind in ("measure", "stream", "trace", "property"):
             if len(parts) == 5 and parts[:3] == ["v1", kind, "schema"]:
                 P = f"{kind.capitalize()}RegistryService"
                 if parts[3] == "lists":

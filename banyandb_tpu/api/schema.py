@@ -150,12 +150,24 @@ class Trace:
     tags: tuple[TagSpec, ...]
     trace_id_tag: str
     timestamp_tag: str = ""
+    span_id_tag: str = ""  # schema.proto Trace.span_id_tag_name
 
     def tag(self, name: str) -> TagSpec:
         for t in self.tags:
             if t.name == name:
                 return t
         raise KeyError(f"tag {name} not in trace {self.name}")
+
+
+@dataclass(frozen=True)
+class PropertySchema:
+    """database/v1 Property schema (schema.proto:224): the declared tag
+    set of a property namespace — registered via PropertyRegistryService,
+    distinct from property VALUES (property/v1 Apply/Query)."""
+
+    group: str
+    name: str
+    tags: tuple[TagSpec, ...]
 
 
 @dataclass(frozen=True)
@@ -205,6 +217,7 @@ _KINDS = {
     "measure": Measure,
     "stream": Stream,
     "trace": Trace,
+    "property_schema": PropertySchema,
     "index_rule": IndexRule,
     "index_rule_binding": IndexRuleBinding,
     "topn": TopNAggregation,
@@ -282,9 +295,10 @@ class SchemaRegistry:
         self._root = Path(root) / "schema" if root else None
         self._revision = 0
         self._store: dict[str, dict[str, object]] = {k: {} for k in _KINDS}
-        # per-object local revisions (barrier freshness checks); NOT
-        # persisted — after restart objects report rev 0, forcing the
-        # barrier to match by content hash
+        # per-object local revisions (barrier freshness checks); persisted
+        # alongside the objects so min_revision barriers remain truthful
+        # across restarts (pre-persistence files load as rev 0, and the
+        # cluster barrier additionally matches by content hash)
         self._obj_revs: dict[tuple[str, str], int] = {}
         # content hashes cached at put/load time (objects are frozen
         # dataclasses) so digests() is a dict copy, not an O(n) hash
@@ -311,7 +325,16 @@ class SchemaRegistry:
         payload = {k: _to_jsonable(v) for k, v in self._store[kind].items()}
         fs.atomic_write_json(
             self._root / f"{kind}.json",
-            {"revision": self._revision, "items": payload},
+            {
+                "revision": self._revision,
+                "items": payload,
+                # per-object revisions persist so barrier min_revision
+                # checks stay truthful across restarts
+                "revs": {
+                    k: self._obj_revs.get((kind, k), 0)
+                    for k in self._store[kind]
+                },
+            },
         )
 
     def _load(self) -> None:
@@ -321,10 +344,13 @@ class SchemaRegistry:
                 continue
             data = fs.read_json(path)
             self._revision = max(self._revision, data.get("revision", 0))
+            revs = data.get("revs", {})
             for key, item in data.get("items", {}).items():
                 obj = _from_jsonable(cls, item)
                 self._store[kind][key] = obj
                 self._obj_hashes[(kind, key)] = self.object_hash(obj)
+                if revs.get(key):
+                    self._obj_revs[(kind, key)] = revs[key]
         tpath = self._root / "tombstones.json"
         if tpath.exists():
             data = fs.read_json(tpath)
@@ -434,8 +460,9 @@ class SchemaRegistry:
 
     def stored_object_hash(self, kind: str, key: str) -> dict:
         """-> {hash, rev}: rev is this node's LOCAL per-object revision
-        (0 after a restart — reloaded objects must then match by hash,
-        which is exactly the stale-restart case the barrier closes)."""
+        (persisted with the object; 0 only for pre-persistence files —
+        cluster barriers still verify by content hash, never by trusting
+        another node's counters)."""
         with self._lock:
             present = key in self._store[kind]
             h = self._obj_hashes.get((kind, key)) if present else None
@@ -497,6 +524,23 @@ class SchemaRegistry:
 
     def get_trace(self, group: str, name: str) -> Trace:
         return self._get("trace", f"{group}/{name}")
+
+    def create_property_schema(self, p: PropertySchema) -> int:
+        self.get_group(p.group)
+        return self._put("property_schema", p)
+
+    def get_property_schema(self, group: str, name: str) -> PropertySchema:
+        return self._get("property_schema", f"{group}/{name}")
+
+    def list_property_schemas(self, group: str) -> list[PropertySchema]:
+        return [
+            p
+            for p in self._store["property_schema"].values()
+            if p.group == group
+        ]
+
+    def delete_property_schema(self, group: str, name: str) -> None:
+        self._delete("property_schema", f"{group}/{name}")
 
     def list_traces(self, group: str) -> list[Trace]:
         return [t for t in self._store["trace"].values() if t.group == group]

@@ -531,6 +531,55 @@ def stream_to_pb(s: isch.Stream):
     return out
 
 
+def trace_to_internal(t) -> isch.Trace:
+    """database/v1 Trace schema (schema.proto:247): flat TraceTagSpec
+    list + trace/span/timestamp tag names."""
+    return isch.Trace(
+        group=t.metadata.group,
+        name=t.metadata.name,
+        tags=tuple(
+            isch.TagSpec(s.name, _TAG_TYPE.get(s.type, isch.TagType.STRING))
+            for s in t.tags
+        ),
+        trace_id_tag=t.trace_id_tag_name,
+        timestamp_tag=t.timestamp_tag_name,
+        span_id_tag=t.span_id_tag_name,
+    )
+
+
+def trace_to_pb(t: isch.Trace):
+    out = pb.database_schema_pb2.Trace()
+    out.metadata.group = t.group
+    out.metadata.name = t.name
+    for s in t.tags:
+        out.tags.add(name=s.name, type=_TAG_TYPE_INV[s.type])
+    out.trace_id_tag_name = t.trace_id_tag
+    out.timestamp_tag_name = t.timestamp_tag
+    out.span_id_tag_name = t.span_id_tag
+    return out
+
+
+def property_schema_to_internal(p) -> isch.PropertySchema:
+    """database/v1 Property schema (schema.proto:224)."""
+    return isch.PropertySchema(
+        group=p.metadata.group,
+        name=p.metadata.name,
+        tags=tuple(
+            isch.TagSpec(s.name, _TAG_TYPE.get(s.type, isch.TagType.STRING))
+            for s in p.tags
+        ),
+    )
+
+
+def property_schema_to_pb(p: isch.PropertySchema):
+    out = pb.database_schema_pb2.Property()
+    out.metadata.group = p.group
+    out.metadata.name = p.name
+    for s in p.tags:
+        out.tags.add(name=s.name, type=_TAG_TYPE_INV[s.type])
+    return out
+
+
 # -- index rules / bindings / topn (database/v1) ----------------------------
 
 _IDX_TYPE = {1: "inverted", 2: "skipping", 3: "tree"}

@@ -80,6 +80,7 @@ class StandaloneServer:
         wire_port: int | None = None,
         http_port: int | None = None,
         pprof_port: int | None = None,
+        auth_file: str | None = None,
     ):
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
@@ -110,8 +111,15 @@ class StandaloneServer:
                 self.stream,
                 property_engine=self.property,
                 trace_engine=self.trace,
+                node_info={
+                    "name": "standalone",
+                    "grpc_address": f"127.0.0.1:{wire_port}",
+                    "roles": ("data", "liaison"),
+                },
             )
-            self.wire = WireServer(self._wire_services, port=wire_port)
+            self.wire = WireServer(
+                self._wire_services, port=wire_port, auth_file=auth_file
+            )
         if http_port is not None:
             from banyandb_tpu.api.grpc_server import WireServices
             from banyandb_tpu.api.http_gateway import HttpGateway
@@ -123,7 +131,17 @@ class StandaloneServer:
                 property_engine=self.property,
                 trace_engine=self.trace,
             )
-            self.http = HttpGateway(svcs, port=http_port)
+            # one users file governs both surfaces: an auth_file that only
+            # locked gRPC while HTTP served the same CRUD would be a trap
+            http_auth = None
+            if auth_file:
+                if self.wire is not None and self.wire.auth is not None:
+                    http_auth = self.wire.auth
+                else:
+                    from banyandb_tpu.api.auth import AuthReloader
+
+                    http_auth = AuthReloader(auth_file)
+            self.http = HttpGateway(svcs, port=http_port, auth=http_auth)
         self.pprof = None
         if pprof_port is not None:
             from banyandb_tpu.admin.profiling import ProfilingServer

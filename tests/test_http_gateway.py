@@ -111,3 +111,37 @@ def test_gateway_errors(gw):
     assert st == 404
     st, got = _call(g, "POST", "/api/v1/no/such", {})
     assert st == 404
+
+
+def test_gateway_round3_routes(gw):
+    """Trace/Property schema routes + /v1/cluster/state + api version."""
+    g, _eng = gw
+
+    st, v = _call(g, "GET", "/api/v1/common/api/version")
+    assert st == 200 and v["version"]["version"] == "0.10"
+    st, state = _call(g, "GET", "/api/v1/cluster/state")
+    assert st == 200 and "route_tables" in state
+
+    st, _ = _call(g, "POST", "/api/v1/group/schema", {"group": {
+        "metadata": {"name": "hg"}, "catalog": "CATALOG_TRACE",
+        "resource_opts": {"shard_num": 1}}})
+    assert st == 200
+
+    st, _ = _call(g, "POST", "/api/v1/trace/schema", {"trace": {
+        "metadata": {"group": "hg", "name": "sp"},
+        "tags": [{"name": "trace_id", "type": "TAG_TYPE_STRING"}],
+        "trace_id_tag_name": "trace_id",
+        "timestamp_tag_name": "ts",
+        "span_id_tag_name": "sid"}})
+    assert st == 200
+    st, got = _call(g, "GET", "/api/v1/trace/schema/hg/sp")
+    assert st == 200 and got["trace"]["trace_id_tag_name"] == "trace_id"
+    st, ls = _call(g, "GET", "/api/v1/trace/schema/lists/hg")
+    assert st == 200 and len(ls["trace"]) == 1
+
+    st, _ = _call(g, "POST", "/api/v1/property/schema", {"property": {
+        "metadata": {"group": "hg", "name": "tpl"},
+        "tags": [{"name": "content", "type": "TAG_TYPE_STRING"}]}})
+    assert st == 200
+    st, got = _call(g, "GET", "/api/v1/property/schema/hg/tpl")
+    assert st == 200 and got["property"]["tags"][0]["name"] == "content"
