@@ -47,6 +47,14 @@ def _stream_stream(fn: Callable, req_cls):
     )
 
 
+def _unary_stream(fn: Callable, req_cls):
+    return grpc.unary_stream_rpc_method_handler(
+        fn,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
 def _abort(context, e: Exception):
     if isinstance(e, KeyError):
         context.abort(grpc.StatusCode.NOT_FOUND, str(e))
@@ -172,6 +180,7 @@ class WireServices:
         node_info: dict | None = None,
         cluster_view_fn=None,
         barrier=None,
+        schema_store=None,
     ):
         self.registry = registry
         self.measure = measure_engine
@@ -191,6 +200,7 @@ class WireServices:
                 }
             }
         )
+        self.schema_store = schema_store
         self.barrier = barrier or RegistryBarrier(registry)
         # Barrier RPCs hold a worker thread for their whole wait; cap the
         # concurrent waiters so they can never exhaust the server pool and
@@ -823,6 +833,157 @@ class WireServices:
         finally:
             self._barrier_slots.release()
 
+    # -- schema plane (schema/v1/internal.proto) ---------------------------
+    @staticmethod
+    def _fill_schema_doc(prop_msg, kind: str, key: str, payload: str) -> None:
+        """One place encodes a schema doc as a property/v1.Property —
+        WatchSchemas replay and ListSchemas must never diverge."""
+        from banyandb_tpu.cluster import schema_plane
+
+        prop_msg.metadata.group = schema_plane.SCHEMA_GROUP
+        prop_msg.metadata.name = kind
+        prop_msg.id = key
+        tag = prop_msg.tags.add(key="payload")
+        tag.value.str.value = payload
+
+    @classmethod
+    def _schema_event_to_pb(cls, ev: dict):
+        from banyandb_tpu.cluster import schema_plane
+
+        ipb = pb.schema_internal_pb2
+        out = ipb.WatchSchemasResponse(event_type=ev["type"])
+        if ev["type"] != schema_plane.EVENT_REPLAY_DONE:
+            cls._fill_schema_doc(
+                out.property, ev["kind"], ev["key"], ev.get("payload", "")
+            )
+        return out
+
+    def _require_schema_store(self):
+        if self.schema_store is None:
+            raise NotImplementedError(
+                "schema plane not enabled (no PropertySchemaStore)"
+            )
+        return self.schema_store
+
+    def watch_schemas(self, request_iterator, context):
+        """SchemaUpdateService.WatchSchemas (internal.proto:79): replay
+        the current schema set, mark REPLAY_DONE, then stream live
+        events until the client goes away."""
+        import queue as _queue
+
+        store = self._require_schema_store()
+        # half-close without a subscribe request ends the stream cleanly
+        # (bare next() would raise StopIteration -> PEP 479 RuntimeError)
+        if next(iter(request_iterator), None) is None:
+            return
+        sid, q = store.hub.subscribe()
+        try:
+            for ev in store.replay_events():
+                yield self._schema_event_to_pb(ev)
+            while context.is_active():
+                if store.hub.is_dead(sid):
+                    # this subscriber lost events (queue overflow); end
+                    # the stream so the client reconnects and re-syncs
+                    break
+                try:
+                    ev = q.get(timeout=0.2)
+                except _queue.Empty:
+                    continue
+                yield self._schema_event_to_pb(ev)
+        finally:
+            store.hub.unsubscribe(sid)
+
+    def _schema_doc_apply(self, prop_msg) -> None:
+        """Insert/Update/Repair: a property doc whose metadata.name is
+        the schema kind and whose payload tag is the schema json."""
+        import json as _json
+
+        from banyandb_tpu.api import schema as schema_mod
+
+        kind = prop_msg.metadata.name
+        cls = schema_mod._KINDS.get(kind)
+        if cls is None:
+            raise ValueError(f"unknown schema kind {kind!r}")
+        payload = ""
+        for tag in prop_msg.tags:
+            if tag.key == "payload":
+                payload = tag.value.str.value
+        if not payload:
+            raise ValueError("schema doc missing payload tag")
+        obj = schema_mod._from_jsonable(cls, _json.loads(payload))
+        self.registry._put(kind, obj)
+
+    def schema_insert(self, req, context):
+        try:
+            self._require_schema_store()
+            self._schema_doc_apply(req.property)
+            return pb.schema_internal_pb2.InsertSchemaResponse()
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def schema_update(self, req, context):
+        try:
+            self._require_schema_store()
+            self._schema_doc_apply(req.property)
+            return pb.schema_internal_pb2.UpdateSchemaResponse()
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def schema_delete(self, req, context):
+        try:
+            from banyandb_tpu.api import schema as schema_mod
+
+            self._require_schema_store()
+            kind = req.delete.name
+            key = req.delete.id
+            if kind not in schema_mod._KINDS:
+                raise ValueError(f"unknown schema kind {kind!r}")
+            found = True
+            try:
+                self.registry._delete(kind, key)
+            except KeyError:
+                found = False
+            return pb.schema_internal_pb2.DeleteSchemaResponse(found=found)
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def schema_list(self, req, context):
+        """ListSchemas: server-streamed pages of the current docs."""
+        try:
+            store = self._require_schema_store()
+            from banyandb_tpu.cluster import schema_plane
+
+            for ev in store.replay_events():
+                if ev["type"] == schema_plane.EVENT_REPLAY_DONE:
+                    continue
+                out = pb.schema_internal_pb2.ListSchemasResponse()
+                self._fill_schema_doc(
+                    out.properties.add(), ev["kind"], ev["key"], ev["payload"]
+                )
+                out.delete_times.append(0)
+                yield out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def schema_repair(self, req, context):
+        try:
+            self._require_schema_store()
+            if req.delete_time > 0:
+                from banyandb_tpu.api import schema as schema_mod
+
+                kind = req.property.metadata.name
+                if kind not in schema_mod._KINDS:
+                    raise ValueError(f"unknown schema kind {kind!r}")
+                try:
+                    self.registry._delete(kind, req.property.id)
+                except KeyError:
+                    pass
+            else:
+                self._schema_doc_apply(req.property)
+            return pb.schema_internal_pb2.RepairSchemaResponse()
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
     def bydbql_query(self, req, context):
         """bydbql/v1 Query: parse QL, dispatch by catalog, return the
         catalog-typed result in the response oneof."""
@@ -1024,6 +1185,35 @@ class WireServer:
                     "GetClusterState": _unary(
                         s.get_cluster_state,
                         pb.database_rpc_pb2.GetClusterStateRequest,
+                    )
+                },
+            ),
+            (
+                "banyandb.schema.v1.SchemaManagementService",
+                {
+                    "InsertSchema": _unary(
+                        s.schema_insert, pb.schema_internal_pb2.InsertSchemaRequest
+                    ),
+                    "UpdateSchema": _unary(
+                        s.schema_update, pb.schema_internal_pb2.UpdateSchemaRequest
+                    ),
+                    "ListSchemas": _unary_stream(
+                        s.schema_list, pb.schema_internal_pb2.ListSchemasRequest
+                    ),
+                    "DeleteSchema": _unary(
+                        s.schema_delete, pb.schema_internal_pb2.DeleteSchemaRequest
+                    ),
+                    "RepairSchema": _unary(
+                        s.schema_repair, pb.schema_internal_pb2.RepairSchemaRequest
+                    ),
+                },
+            ),
+            (
+                "banyandb.schema.v1.SchemaUpdateService",
+                {
+                    "WatchSchemas": _stream_stream(
+                        s.watch_schemas,
+                        pb.schema_internal_pb2.WatchSchemasRequest,
                     )
                 },
             ),

@@ -11,6 +11,7 @@ as JSON files under <root>/schema/ via atomic writes.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
 import threading
@@ -311,6 +312,12 @@ class SchemaRegistry:
         # gossips normally
         self._tombstones: dict[str, dict[str, str]] = {k: {} for k in _KINDS}
         self._watchers: list = []
+        self._delete_watchers: list = []
+        # watcher callbacks run OUTSIDE self._lock (they may persist to
+        # disk / broadcast); events queue under the lock and drain FIFO
+        # under _notify_lock, so observers still see revision order
+        self._pending_events: collections.deque = collections.deque()
+        self._notify_lock = threading.Lock()
         if self._root and self._root.exists():
             self._load()
 
@@ -364,6 +371,23 @@ class SchemaRegistry:
                 self._root / "tombstones.json", self._tombstones
             )
 
+    def _drain_events(self) -> None:
+        """Deliver queued watcher events in FIFO order.  Whoever holds
+        _notify_lock drains everything pending; a mutator returning from
+        _put/_delete is guaranteed its own event has been delivered
+        (by itself or by a concurrent drainer)."""
+        with self._notify_lock:
+            while True:
+                try:
+                    op, kind, payload, rev = self._pending_events.popleft()
+                except IndexError:
+                    return
+                targets = (
+                    self._watchers if op == "put" else self._delete_watchers
+                )
+                for w in targets:
+                    w(kind, payload, rev)
+
     def _put(self, kind: str, obj) -> int:
         with self._lock:
             self._revision += 1
@@ -375,9 +399,10 @@ class SchemaRegistry:
                 # recreate clears the grave
                 self._persist_tombstones()
             self._persist(kind)
-            for w in self._watchers:
-                w(kind, obj, self._revision)
-            return self._revision
+            rev = self._revision
+            self._pending_events.append(("put", kind, obj, rev))
+        self._drain_events()
+        return rev
 
     def _get(self, kind: str, key: str):
         with self._lock:
@@ -398,6 +423,8 @@ class SchemaRegistry:
             self._tombstones[kind][key] = buried
             self._persist(kind)
             self._persist_tombstones()
+            self._pending_events.append(("delete", kind, key, self._revision))
+        self._drain_events()
 
     # -- public CRUD (parity with the 9 registry services) -----------------
     @property
@@ -448,9 +475,16 @@ class SchemaRegistry:
                 del self._store[kind][key]
                 self._obj_hashes.pop((kind, key), None)
                 self._persist(kind)
+                # gossip deletions notify delete watchers like local ones:
+                # a property-backed store must bury its doc too, or the
+                # deleted schema resurrects from replay on restart
+                self._pending_events.append(
+                    ("delete", kind, key, self._revision)
+                )
             self._tombstones[kind][key] = buried_hash
             self._persist_tombstones()
-            return existed
+        self._drain_events()
+        return existed
 
     def export_object(self, kind: str, key: str) -> Optional[dict]:
         """JSON-able form of one stored object (gossip pull)."""
@@ -474,6 +508,10 @@ class SchemaRegistry:
     def watch(self, callback) -> None:
         """callback(kind, obj, revision) on every create/update."""
         self._watchers.append(callback)
+
+    def watch_deletes(self, callback) -> None:
+        """callback(kind, key, revision) on every delete."""
+        self._delete_watchers.append(callback)
 
     def create_group(self, g: Group) -> int:
         return self._put("group", g)

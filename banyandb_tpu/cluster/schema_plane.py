@@ -1,0 +1,479 @@
+"""Property-backed schema registry + event-driven watch plane.
+
+The reference's metadata registry IS its Property engine: schema docs are
+stored as properties by a schema server
+(banyand/metadata/schema/schemaserver/service.go,
+banyand/metadata/schema/property/client.go) and every node keeps an
+event-driven schema cache fed by a WatchSchemas stream with retry
+(pkg/schema/cache.go:275, api/proto/banyandb/schema/v1/internal.proto:79).
+
+This module is the TPU-repo equivalent:
+
+- PropertySchemaStore: dogfoods PropertyEngine as the registry's durable
+  store.  Every registry create/update/delete lands as a property doc in
+  the internal "_schema" group; on restart the registry replays from the
+  property store.  One storage system, as upstream.
+- WatchHub: in-process fan-out of schema events to any number of
+  subscribed streams (schemaserver/watcher.go analog: bounded queues,
+  slow watchers drop events and must re-sync).
+- SchemaWatchClient: node-side cache feed.  Connects to the liaison's
+  SchemaUpdateService.WatchSchemas bidi stream, replays the full schema
+  set (REPLAY_DONE marker), then applies live events to the local
+  registry; reconnects with backoff on any error — a node that missed a
+  push converges via watch, not only via gossip.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+
+from banyandb_tpu.api import schema as schema_mod
+from banyandb_tpu.models.property import Property
+
+log = logging.getLogger("banyandb.schemaplane")
+
+SCHEMA_GROUP = "_schema"
+
+# wire enum values (schema/v1/internal.proto SchemaEventType)
+EVENT_INSERT = 1
+EVENT_UPDATE = 2
+EVENT_DELETE = 3
+EVENT_REPLAY_DONE = 4
+
+_QUEUE_SIZE = 512
+
+
+class WatchHub:
+    """Bounded fan-out of schema events (watcher.go:Broadcast analog:
+    a full subscriber queue drops the event — the stream layer then owes
+    the subscriber a re-sync, which SchemaWatchClient does by
+    reconnecting)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: dict[int, queue.Queue] = {}
+        self._dead: set[int] = set()
+        self._next = 0
+
+    def subscribe(self) -> tuple[int, queue.Queue]:
+        with self._lock:
+            self._next += 1
+            q: queue.Queue = queue.Queue(maxsize=_QUEUE_SIZE)
+            self._subs[self._next] = q
+            return self._next, q
+
+    def unsubscribe(self, sid: int) -> None:
+        with self._lock:
+            self._subs.pop(sid, None)
+            self._dead.discard(sid)
+
+    def is_dead(self, sid: int) -> bool:
+        with self._lock:
+            return sid in self._dead
+
+    def broadcast(self, event: dict) -> None:
+        with self._lock:
+            subs = [
+                (sid, q) for sid, q in self._subs.items()
+                if sid not in self._dead
+            ]
+        for sid, q in subs:
+            try:
+                q.put_nowait(event)
+            except queue.Full:
+                # a lossy stream must DIE so the client re-syncs via
+                # reconnect replay — silently dropping one event would
+                # leave the node's cache stale forever
+                with self._lock:
+                    self._dead.add(sid)
+                log.warning(
+                    "schema watcher %d queue full; terminating its stream",
+                    sid,
+                )
+
+
+class PropertySchemaStore:
+    """Registry persistence through the Property engine.
+
+    Wiring order matters: construct with a registry whose file
+    persistence is off (root=None) — the property store is then the one
+    durable home of schema docs.  A registry with its own root still
+    works (both stores stay consistent), which eases migration.
+    """
+
+    def __init__(self, registry, property_engine):
+        self.registry = registry
+        self.prop = property_engine
+        self.hub = WatchHub()
+        self._replaying = False
+        self._ensure_group()
+        self._replay_into_registry()
+        registry.watch(self._on_put)
+        registry.watch_deletes(self._on_delete)
+
+    # -- bootstrap ---------------------------------------------------------
+    def _ensure_group(self) -> None:
+        try:
+            self.registry.get_group(SCHEMA_GROUP)
+        except KeyError:
+            self._replaying = True  # group creation precedes watcher wiring,
+            try:  # but stay safe if called twice
+                self.registry.create_group(
+                    schema_mod.Group(
+                        SCHEMA_GROUP,
+                        schema_mod.Catalog.PROPERTY,
+                        schema_mod.ResourceOpts(shard_num=1),
+                    )
+                )
+            finally:
+                self._replaying = False
+
+    def _replay_into_registry(self) -> None:
+        """Load every persisted schema doc back into the registry (restart
+        path: the property shards reload from disk lazily)."""
+        self._replaying = True
+        try:
+            for kind, cls in schema_mod._KINDS.items():
+                for doc in self.prop.query(SCHEMA_GROUP, kind, limit=100000):
+                    payload = json.loads(doc.tags["payload"])
+                    obj = schema_mod._from_jsonable(cls, payload)
+                    key = self.registry._key(obj)
+                    if self.registry._store[kind].get(key) != obj:
+                        self.registry._put(kind, obj)
+        finally:
+            self._replaying = False
+
+    # -- registry hooks ----------------------------------------------------
+    def _on_put(self, kind: str, obj, revision: int) -> None:
+        if self._replaying:
+            return
+        key = self.registry._key(obj)
+        payload = json.dumps(schema_mod._to_jsonable(obj), sort_keys=True)
+        self.prop.apply(
+            Property(
+                group=SCHEMA_GROUP,
+                name=kind,
+                id=key,
+                tags={"payload": payload},
+            ),
+            strategy="replace",
+        )
+        self.prop.persist_group(SCHEMA_GROUP)
+        self.hub.broadcast(
+            {
+                "type": EVENT_UPDATE,
+                "kind": kind,
+                "key": key,
+                "payload": payload,
+                "revision": revision,
+            }
+        )
+
+    def _on_delete(self, kind: str, key: str, revision: int) -> None:
+        if self._replaying:
+            return
+        self.prop.delete(SCHEMA_GROUP, kind, key)
+        self.prop.persist_group(SCHEMA_GROUP)
+        self.hub.broadcast(
+            {
+                "type": EVENT_DELETE,
+                "kind": kind,
+                "key": key,
+                "payload": "",
+                "revision": revision,
+            }
+        )
+
+    # -- snapshot for stream replay ---------------------------------------
+    def replay_events(self) -> list[dict]:
+        """Current schema set as INSERT events + REPLAY_DONE marker."""
+        out = []
+        digests = self.registry.digests()
+        for kind in schema_mod._KINDS:
+            for key in digests.get(kind, {}):
+                payload = self.registry.export_object(kind, key)
+                if payload is None:
+                    continue
+                out.append(
+                    {
+                        "type": EVENT_INSERT,
+                        "kind": kind,
+                        "key": key,
+                        "payload": json.dumps(payload, sort_keys=True),
+                        "revision": self.registry.revision,
+                    }
+                )
+        out.append({"type": EVENT_REPLAY_DONE})
+        return out
+
+
+def apply_event(registry, ev: dict) -> None:
+    """Apply one watch event to a local registry (cache.go handler)."""
+    kind = ev.get("kind", "")
+    cls = schema_mod._KINDS.get(kind)
+    if cls is None:
+        return
+    if ev["type"] in (EVENT_INSERT, EVENT_UPDATE):
+        obj = schema_mod._from_jsonable(cls, json.loads(ev["payload"]))
+        key = registry._key(obj)
+        if registry._store[kind].get(key) != obj:
+            registry._put(kind, obj)
+    elif ev["type"] == EVENT_DELETE:
+        try:
+            registry._delete(kind, ev["key"])
+        except KeyError:
+            pass
+
+
+class LiaisonBarrier:
+    """Cluster SchemaBarrierService backend: verifies every alive data
+    node serves each key at the liaison registry's CURRENT content hash
+    (barrier.proto semantics over the bus SCHEMA_GET topic — content is
+    the truth, never node-local counters; liaison.schema_barrier uses
+    the same rule for push acks)."""
+
+    def __init__(self, liaison):
+        self.liaison = liaison
+
+    @property
+    def _registry(self):
+        return self.liaison.registry
+
+    def _nodes(self):
+        return [
+            n for n in self.liaison.selector.nodes
+            if n.name in self.liaison.alive
+        ]
+
+    def _poll(self, timeout_s: float, check):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            laggards = check()
+            if not laggards or time.monotonic() >= deadline:
+                return (not laggards), laggards
+            time.sleep(0.05)
+
+    def await_revision(self, min_revision: int, timeout_s: float):
+        """Liaison registry is the source of truth for the revision
+        counter; data nodes must then match its content for every key."""
+        if self._registry.revision < min_revision:
+            return False, [
+                {
+                    "node": "liaison",
+                    "current_mod_revision": self._registry.revision,
+                }
+            ]
+        digests = self._registry.digests()
+        keys, revs = [], []
+        from banyandb_tpu.api.grpc_server import _BARRIER_KINDS
+
+        inv = {v: k for k, v in _BARRIER_KINDS.items()}
+        for kind, objs in digests.items():
+            for key in objs:
+                group, _, name = key.rpartition("/")
+                keys.append((inv.get(kind, kind), group, name))
+                revs.append(0)
+        return self.await_applied(keys, revs, timeout_s)
+
+    def await_applied(self, keys, min_revisions, timeout_s: float):
+        from banyandb_tpu.api.grpc_server import _BARRIER_KINDS
+        from banyandb_tpu.cluster.bus import Topic
+        from banyandb_tpu.cluster.rpc import TransportError
+
+        addr_of = {n.name: n.addr for n in self.liaison.selector.nodes}
+
+        def check():
+            want = []
+            for kind, group, name in keys:
+                rkind = _BARRIER_KINDS.get(kind)
+                if rkind is None:
+                    raise ValueError(f"unknown schema kind {kind!r}")
+                key = name if rkind == "group" else f"{group}/{name}"
+                local = self._registry.stored_object_hash(rkind, key)
+                want.append((kind, group, name, rkind, key, local["hash"]))
+            laggards = []
+            missing_local = [
+                (k, g, n) for k, g, n, _rk, _key, h in want if h is None
+            ]
+            if missing_local:
+                laggards.append(
+                    {
+                        "node": "liaison",
+                        "current_mod_revision": self._registry.revision,
+                        "missing_keys": missing_local,
+                    }
+                )
+            for node in self._nodes():
+                missing = []
+                for kind, group, name, rkind, key, h in want:
+                    if h is None:
+                        continue
+                    try:
+                        r = self.liaison.transport.call(
+                            addr_of[node.name],
+                            Topic.SCHEMA_GET.value,
+                            {"kind": rkind, "key": key},
+                            timeout=5,
+                        )
+                    except TransportError:
+                        missing.append((kind, group, name))
+                        continue
+                    if r.get("hash") != h:
+                        missing.append((kind, group, name))
+                if missing:
+                    laggards.append(
+                        {
+                            "node": node.name,
+                            "current_mod_revision": 0,
+                            "missing_keys": missing,
+                        }
+                    )
+            return laggards
+
+        return self._poll(timeout_s, check)
+
+    def await_deleted(self, keys, timeout_s: float):
+        from banyandb_tpu.api.grpc_server import _BARRIER_KINDS
+        from banyandb_tpu.cluster.bus import Topic
+        from banyandb_tpu.cluster.rpc import TransportError
+
+        addr_of = {n.name: n.addr for n in self.liaison.selector.nodes}
+
+        def check():
+            laggards = []
+            for node in [{"name": "liaison", "addr": None}] + [
+                {"name": n.name, "addr": addr_of[n.name]}
+                for n in self._nodes()
+            ]:
+                present = []
+                for kind, group, name in keys:
+                    rkind = _BARRIER_KINDS.get(kind)
+                    if rkind is None:
+                        raise ValueError(f"unknown schema kind {kind!r}")
+                    key = name if rkind == "group" else f"{group}/{name}"
+                    if node["addr"] is None:
+                        h = self._registry.stored_object_hash(rkind, key)["hash"]
+                    else:
+                        try:
+                            h = self.liaison.transport.call(
+                                node["addr"],
+                                Topic.SCHEMA_GET.value,
+                                {"kind": rkind, "key": key},
+                                timeout=5,
+                            ).get("hash")
+                        except TransportError:
+                            h = "unreachable"
+                    if h is not None:
+                        present.append((kind, group, name))
+                if present:
+                    laggards.append(
+                        {
+                            "node": node["name"],
+                            "current_mod_revision": 0,
+                            "still_present_keys": present,
+                        }
+                    )
+            return laggards
+
+        return self._poll(timeout_s, check)
+
+
+class SchemaWatchClient:
+    """Event-driven per-node schema cache (pkg/schema/cache.go:275
+    analog): WatchSchemas stream -> local registry, with reconnect +
+    exponential backoff.  The full replay on every (re)connect is the
+    retry story: any missed event is healed by the next replay."""
+
+    def __init__(self, registry, addr: str, channel_factory=None):
+        self.registry = registry
+        self.addr = addr
+        self._channel_factory = channel_factory
+        self._stop = threading.Event()
+        self.synced = threading.Event()  # set after first REPLAY_DONE
+        self._thread: threading.Thread | None = None
+        self._call = None  # live gRPC call, cancelled on stop()
+        self.reconnects = 0
+
+    def _channel(self):
+        if self._channel_factory is not None:
+            return self._channel_factory(self.addr)
+        import grpc
+
+        return grpc.insecure_channel(self.addr)
+
+    def start(self) -> "SchemaWatchClient":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        call = self._call
+        if call is not None:
+            try:
+                call.cancel()  # unblocks the response iterator immediately
+            except Exception:  # noqa: BLE001
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def wait_synced(self, timeout: float = 10.0) -> bool:
+        return self.synced.wait(timeout)
+
+    def _run(self) -> None:
+        from banyandb_tpu.api import pb
+
+        ipb = pb.schema_internal_pb2
+        backoff = 0.2
+        while not self._stop.is_set():
+            chan = None
+            try:
+                chan = self._channel()
+                stub = chan.stream_stream(
+                    "/banyandb.schema.v1.SchemaUpdateService/WatchSchemas",
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=ipb.WatchSchemasResponse.FromString,
+                )
+
+                def reqs():
+                    yield ipb.WatchSchemasRequest()
+                    # keep the stream open until stop
+                    while not self._stop.is_set():
+                        time.sleep(0.1)
+
+                self._call = stub(reqs())
+                for resp in self._call:
+                    if self._stop.is_set():
+                        break
+                    if resp.event_type == EVENT_REPLAY_DONE:
+                        self.synced.set()
+                        backoff = 0.2  # healthy stream resets the backoff
+                        continue
+                    ev = {
+                        "type": resp.event_type,
+                        "kind": resp.property.metadata.name,
+                        "key": resp.property.id,
+                        "payload": "",
+                    }
+                    for tag in resp.property.tags:
+                        if tag.key == "payload":
+                            ev["payload"] = tag.value.str.value
+                    apply_event(self.registry, ev)
+            except Exception as e:  # noqa: BLE001 - reconnect loop
+                if not self._stop.is_set():
+                    log.debug("schema watch stream error (%s); retrying", e)
+            finally:
+                if chan is not None:
+                    try:
+                        chan.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            if self._stop.is_set():
+                return
+            self.reconnects += 1
+            self._stop.wait(backoff)
+            backoff = min(backoff * 2, 8.0)

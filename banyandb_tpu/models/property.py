@@ -200,3 +200,13 @@ class PropertyEngine:
     def persist(self) -> None:
         for idx in self._shards.values():
             idx.persist()
+
+    def persist_group(self, group: str) -> None:
+        """Persist only one group's shards (schema-plane writes touch
+        just the _schema group; fsyncing every shard would stall)."""
+        with self._lock:
+            shards = [
+                idx for (g, _s), idx in self._shards.items() if g == group
+            ]
+        for idx in shards:
+            idx.persist()

@@ -95,6 +95,11 @@ class StandaloneServer:
 
         self.disk = DiskMonitor(self.root)
         self.access_log = AccessLog(self.root / "logs" / "access.log")
+        # schema docs dogfood the property engine (schemaserver analog);
+        # the registry's own JSON files remain as a migration-safe mirror
+        from banyandb_tpu.cluster.schema_plane import PropertySchemaStore
+
+        self.schema_store = PropertySchemaStore(self.registry, self.property)
         self.bus = LocalBus()
         self._register()
         self.grpc = GrpcBusServer(self.bus, port=port)
@@ -116,6 +121,7 @@ class StandaloneServer:
                     "grpc_address": f"127.0.0.1:{wire_port}",
                     "roles": ("data", "liaison"),
                 },
+                schema_store=self.schema_store,
             )
             self.wire = WireServer(
                 self._wire_services, port=wire_port, auth_file=auth_file

@@ -1,0 +1,266 @@
+"""Property-backed schema registry + event-driven watch cache
+(VERDICT r2 next #4; reference: banyand/metadata/schema/schemaserver,
+pkg/schema/cache.go:275, schema/v1/internal.proto)."""
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from banyandb_tpu.api import (  # noqa: E402
+    Catalog,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    Measure,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+)
+from banyandb_tpu.api.grpc_server import WireServer, WireServices  # noqa: E402
+from banyandb_tpu.cluster.schema_plane import (  # noqa: E402
+    PropertySchemaStore,
+    SchemaWatchClient,
+)
+from banyandb_tpu.models.measure import MeasureEngine  # noqa: E402
+from banyandb_tpu.models.property import PropertyEngine  # noqa: E402
+from banyandb_tpu.models.stream import StreamEngine  # noqa: E402
+
+
+def _measure(group="pg", name="m"):
+    return Measure(
+        group=group,
+        name=name,
+        tags=(TagSpec("svc", TagType.STRING),),
+        fields=(FieldSpec("lat", FieldType.FLOAT),),
+        entity=Entity(("svc",)),
+    )
+
+
+def test_schema_crud_survives_restart_through_property_store(tmp_path):
+    """Registry with NO file persistence of its own: the property engine
+    is the single durable store, and a fresh process replays from it."""
+    reg = SchemaRegistry(None)  # no registry JSON files
+    prop = PropertyEngine(reg, tmp_path)
+    PropertySchemaStore(reg, prop)
+
+    reg.create_group(Group("pg", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+    reg.create_measure(_measure())
+    reg.create_measure(_measure(name="m2"))
+    reg.delete_measure("pg", "m2")
+
+    # restart: fresh registry + property engine over the same dir
+    reg2 = SchemaRegistry(None)
+    prop2 = PropertyEngine(reg2, tmp_path)
+    PropertySchemaStore(reg2, prop2)
+    assert reg2.get_group("pg").resource_opts.shard_num == 2
+    assert reg2.get_measure("pg", "m").tags[0].name == "svc"
+    with pytest.raises(KeyError):
+        reg2.get_measure("pg", "m2")  # delete persisted too
+
+
+@pytest.fixture()
+def schema_server(tmp_path):
+    reg = SchemaRegistry(None)
+    prop = PropertyEngine(reg, tmp_path / "liaison")
+    store = PropertySchemaStore(reg, prop)
+    measure = MeasureEngine(reg, tmp_path / "liaison/data")
+    stream = StreamEngine(reg, tmp_path / "liaison/data")
+    srv = WireServer(
+        WireServices(reg, measure, stream, schema_store=store), port=0
+    )
+    srv.start()
+    yield reg, store, f"127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+def test_watch_client_replays_and_follows(schema_server, tmp_path):
+    reg, _store, addr = schema_server
+    reg.create_group(Group("wg", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(_measure("wg", "pre"))
+
+    # a data node that connects late converges via replay
+    node_reg = SchemaRegistry(None)
+    client = SchemaWatchClient(node_reg, addr).start()
+    try:
+        assert client.wait_synced(10)
+        assert node_reg.get_measure("wg", "pre").entity.tag_names == ("svc",)
+
+        # live events: create + delete propagate without any push
+        reg.create_measure(_measure("wg", "live"))
+        _await(lambda: _has_measure(node_reg, "wg", "live"))
+        reg.delete_measure("wg", "live")
+        _await(lambda: not _has_measure(node_reg, "wg", "live"))
+    finally:
+        client.stop()
+
+
+def test_watch_client_reconnects_after_server_restart(tmp_path):
+    reg = SchemaRegistry(None)
+    prop = PropertyEngine(reg, tmp_path / "l")
+    store = PropertySchemaStore(reg, prop)
+    measure = MeasureEngine(reg, tmp_path / "l/data")
+    stream = StreamEngine(reg, tmp_path / "l/data")
+    srv = WireServer(WireServices(reg, measure, stream, schema_store=store), port=0)
+    srv.start()
+    addr = f"127.0.0.1:{srv.port}"
+    reg.create_group(Group("rg", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+
+    node_reg = SchemaRegistry(None)
+    client = SchemaWatchClient(node_reg, addr).start()
+    try:
+        assert client.wait_synced(10)
+        # kill the server; create a schema while the node is deaf; restart
+        # on the same port — the client's reconnect replay heals the gap
+        srv.stop(grace=0)
+        port = int(addr.rsplit(":", 1)[1])
+        reg.create_measure(_measure("rg", "missed"))
+        srv2 = WireServer(
+            WireServices(reg, measure, stream, schema_store=store), port=port
+        )
+        srv2.start()
+        try:
+            _await(lambda: _has_measure(node_reg, "rg", "missed"), timeout=15)
+            assert client.reconnects >= 1
+        finally:
+            srv2.stop()
+    finally:
+        client.stop()
+
+
+def test_schema_management_service_crud(schema_server):
+    import json as _json
+
+    reg, _store, addr = schema_server
+    from banyandb_tpu.api import pb
+    from banyandb_tpu.api import schema as schema_mod
+
+    reg.create_group(Group("mg", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    ipb = pb.schema_internal_pb2
+    chan = grpc.insecure_channel(addr)
+    try:
+        insert = chan.unary_unary(
+            "/banyandb.schema.v1.SchemaManagementService/InsertSchema",
+            request_serializer=ipb.InsertSchemaRequest.SerializeToString,
+            response_deserializer=ipb.InsertSchemaResponse.FromString,
+        )
+        req = ipb.InsertSchemaRequest()
+        req.property.metadata.group = "_schema"
+        req.property.metadata.name = "measure"
+        req.property.id = "mg/wire_m"
+        tag = req.property.tags.add(key="payload")
+        tag.value.str.value = _json.dumps(
+            schema_mod._to_jsonable(_measure("mg", "wire_m"))
+        )
+        insert(req)
+        assert reg.get_measure("mg", "wire_m").fields[0].name == "lat"
+
+        listing = chan.unary_stream(
+            "/banyandb.schema.v1.SchemaManagementService/ListSchemas",
+            request_serializer=ipb.ListSchemasRequest.SerializeToString,
+            response_deserializer=ipb.ListSchemasResponse.FromString,
+        )
+        docs = [p.id for resp in listing(ipb.ListSchemasRequest())
+                for p in resp.properties]
+        assert "mg/wire_m" in docs
+
+        delete = chan.unary_unary(
+            "/banyandb.schema.v1.SchemaManagementService/DeleteSchema",
+            request_serializer=ipb.DeleteSchemaRequest.SerializeToString,
+            response_deserializer=ipb.DeleteSchemaResponse.FromString,
+        )
+        dreq = ipb.DeleteSchemaRequest()
+        dreq.delete.group = "_schema"
+        dreq.delete.name = "measure"
+        dreq.delete.id = "mg/wire_m"
+        assert delete(dreq).found
+        with pytest.raises(KeyError):
+            reg.get_measure("mg", "wire_m")
+    finally:
+        chan.close()
+
+
+def _has_measure(reg, group, name) -> bool:
+    try:
+        reg.get_measure(group, name)
+        return True
+    except KeyError:
+        return False
+
+
+def _await(cond, timeout=10.0):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError("condition not reached in time")
+
+
+def test_liaison_barrier_cluster_convergence(tmp_path):
+    """Wire barrier over a real 2-data-node cluster: applied only once
+    both nodes serve the liaison's content hash (barrier rides the
+    schema plane, VERDICT r2 #4 'barrier rides it')."""
+    from banyandb_tpu.cluster.data_node import DataNode
+    from banyandb_tpu.cluster.liaison import Liaison
+    from banyandb_tpu.cluster.node import NodeInfo
+    from banyandb_tpu.cluster.rpc import GrpcBusServer, GrpcTransport
+    from banyandb_tpu.cluster.schema_plane import LiaisonBarrier
+
+    nodes, servers = [], []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}/schema")
+        dn = DataNode(f"dn{i}", reg, tmp_path / f"n{i}/data")
+        srv = GrpcBusServer(dn.bus, port=0)
+        srv.start()
+        nodes.append((dn, NodeInfo(f"dn{i}", srv.addr)))
+        servers.append(srv)
+    lreg = SchemaRegistry(tmp_path / "l/schema")
+    transport = GrpcTransport()
+    liaison = Liaison(lreg, transport, [ni for _, ni in nodes])
+    liaison.probe()
+    barrier = LiaisonBarrier(liaison)
+
+    lreg.create_group(Group("cg", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    # not yet on data nodes: barrier reports both as laggards
+    applied, laggards = barrier.await_applied([("group", "", "cg")], [0], 0.3)
+    assert not applied
+    assert {l["node"] for l in laggards} == {"dn0", "dn1"}
+
+    # push the schema (liaison sync path), barrier turns green
+    liaison.sync_schema("group", lreg.get_group("cg"))
+    applied, laggards = barrier.await_applied([("group", "", "cg")], [0], 5)
+    assert applied, laggards
+
+    applied, _ = barrier.await_revision(1, 5)
+    assert applied
+
+    # delete barrier: group still present everywhere -> not applied
+    applied, laggards = barrier.await_deleted([("group", "", "cg")], 0.3)
+    assert not applied
+
+    transport.close()
+    for s in servers:
+        s.stop()
+
+
+def test_gossip_tombstone_buries_property_doc(tmp_path):
+    """apply_tombstone (gossip deletion path) must reach the property
+    store, or the deleted schema resurrects from replay on restart."""
+    reg = SchemaRegistry(None)
+    prop = PropertyEngine(reg, tmp_path)
+    PropertySchemaStore(reg, prop)
+    reg.create_group(Group("tg", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(_measure("tg", "doomed"))
+    buried = reg.object_hash(reg.get_measure("tg", "doomed"))
+
+    assert reg.apply_tombstone("measure", "tg/doomed", buried)
+
+    # restart: the doc must NOT come back
+    reg2 = SchemaRegistry(None)
+    prop2 = PropertyEngine(reg2, tmp_path)
+    PropertySchemaStore(reg2, prop2)
+    assert not _has_measure(reg2, "tg", "doomed")
