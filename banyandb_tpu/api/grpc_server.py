@@ -208,6 +208,10 @@ class WireServices:
         import threading as _threading
 
         self._barrier_slots = _threading.BoundedSemaphore(4)
+        # WatchSchemas streams hold a worker for their whole life; cap them
+        # so watchers can never exhaust the server pool (WireServer raises
+        # this bound alongside max_workers)
+        self._watch_slots = _threading.BoundedSemaphore(4)
 
     @staticmethod
     def _one_group(ireq) -> str:
@@ -584,7 +588,13 @@ class WireServices:
 
         def list_(req, context):
             try:
-                gs = self.registry.list_groups()
+                # internal groups (_schema backing store) stay off the
+                # public surface
+                gs = [
+                    g
+                    for g in self.registry.list_groups()
+                    if not g.name.startswith("_")
+                ]
                 return rpcpb.GroupRegistryServiceListResponse(
                     group=[wire.group_to_pb(g) for g in gs]
                 )
@@ -872,6 +882,19 @@ class WireServices:
         import queue as _queue
 
         store = self._require_schema_store()
+        if not self._watch_slots.acquire(blocking=False):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many concurrent schema watch streams",
+            )
+        try:
+            yield from self._watch_schemas_inner(request_iterator, context, store)
+        finally:
+            self._watch_slots.release()
+
+    def _watch_schemas_inner(self, request_iterator, context, store):
+        import queue as _queue
+
         # half-close without a subscribe request ends the stream cleanly
         # (bare next() would raise StopIteration -> PEP 479 RuntimeError)
         if next(iter(request_iterator), None) is None:
@@ -1022,11 +1045,17 @@ class WireServer:
         services: WireServices,
         port: int = 17912,
         host: str = "127.0.0.1",
-        max_workers: int = 8,
+        max_workers: int = 16,
         auth_file: str | None = None,
         health_auth: bool = False,
     ):
         self.services = services
+        # long-lived watch streams may hold at most a quarter of the pool
+        import threading as _threading
+
+        services._watch_slots = _threading.BoundedSemaphore(
+            max(2, max_workers // 4)
+        )
         interceptors = ()
         self.auth = None
         if auth_file:

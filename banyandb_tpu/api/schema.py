@@ -523,6 +523,11 @@ class SchemaRegistry:
         return list(self._store["group"].values())
 
     def delete_group(self, name: str) -> None:
+        if name.startswith("_"):
+            # internal groups (e.g. _schema, the registry's own property
+            # backing store) must not be deletable: dropping _schema would
+            # break every subsequent schema mutation's persistence
+            raise ValueError(f"group {name} is internal and cannot be deleted")
         self._delete("group", name)
 
     def create_measure(self, m: Measure) -> int:

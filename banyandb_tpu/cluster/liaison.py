@@ -411,10 +411,29 @@ class Liaison:
             out.append(serde.partials_from_json(r["partials"]))
         return out
 
+    def enable_mesh_fastpath(self, mesh, engines_by_node: dict) -> None:
+        """Switch supported aggregate queries onto the collective plane
+        (psum/pmin/pmax over the mesh, parallel/mesh_query.py) when the
+        data-node engines share this process.  Unsupported query shapes
+        fall back to scatter partials per call
+        (pkg/query/vectorized/measure/adapter.go:43 analog)."""
+        from banyandb_tpu.parallel.mesh_query import MeshExecutor
+
+        self.mesh_exec = MeshExecutor(mesh, engines_by_node)
+
     def query_measure(self, req: QueryRequest) -> QueryResult:
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         assignment = self._shard_assignment(group, req.stages)
+
+        mesh_exec = getattr(self, "mesh_exec", None)
+        if mesh_exec is not None and (req.agg or req.group_by):
+            from banyandb_tpu.parallel.mesh_query import MeshUnsupported
+
+            try:
+                return mesh_exec.execute(m, req, assignment)
+            except MeshUnsupported:
+                pass  # general scatter path below
 
         if not (req.agg or req.group_by or req.top):
             # Raw scatter-gather.  Nodes scan ONLY their assigned shards

@@ -431,6 +431,11 @@ class SchemaWatchClient:
         backoff = 0.2
         while not self._stop.is_set():
             chan = None
+            # per-call termination: the request generator must die with
+            # ITS call, not with the client — otherwise every reconnect
+            # attempt leaks one blocked request-consumer thread for the
+            # client's whole lifetime
+            call_done = threading.Event()
             try:
                 chan = self._channel()
                 stub = chan.stream_stream(
@@ -439,10 +444,11 @@ class SchemaWatchClient:
                     response_deserializer=ipb.WatchSchemasResponse.FromString,
                 )
 
-                def reqs():
+                def reqs(done=call_done):
                     yield ipb.WatchSchemasRequest()
-                    # keep the stream open until stop
-                    while not self._stop.is_set():
+                    # keep the stream open until this call (or the client)
+                    # is done
+                    while not done.is_set() and not self._stop.is_set():
                         time.sleep(0.1)
 
                 self._call = stub(reqs())
@@ -467,6 +473,14 @@ class SchemaWatchClient:
                 if not self._stop.is_set():
                     log.debug("schema watch stream error (%s); retrying", e)
             finally:
+                call_done.set()
+                call = self._call
+                self._call = None
+                if call is not None:
+                    try:
+                        call.cancel()
+                    except Exception:  # noqa: BLE001
+                        pass
                 if chan is not None:
                     try:
                         chan.close()

@@ -322,19 +322,11 @@ class MeasureEngine:
         shards (banyand/query processor + agg_return_partial analog)."""
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
-        db = self._tsdb(group)
+        sources = self.gather_query_sources(req, shard_ids=shard_ids)
         if m.index_mode:
-            sources = self._index_sources(db, m, req, shard_ids)
             return measure_exec.compute_partials(
                 m, req, sources, hist_range=hist_range
             )
-        for attempt in range(3):
-            try:
-                sources = self._gather_sources(db, m, req, shard_ids=shard_ids)
-                break
-            except FileNotFoundError:
-                if attempt == 2:
-                    raise
         return measure_exec.compute_partials(
             m,
             req,
@@ -342,6 +334,22 @@ class MeasureEngine:
             hist_range=hist_range,
             dict_state=self._dict_state(group, req.name),
         )
+
+    def gather_query_sources(self, req, shard_ids=None):
+        """Source selection for the map phase, shared by the host partial
+        path and the mesh fast path (parallel/mesh_query.py): same
+        segment/series pruning, same retry on concurrently-merged parts."""
+        group = req.groups[0]
+        m = self.registry.get_measure(group, req.name)
+        db = self._tsdb(group)
+        if m.index_mode:
+            return self._index_sources(db, m, req, shard_ids)
+        for attempt in range(3):
+            try:
+                return self._gather_sources(db, m, req, shard_ids=shard_ids)
+            except FileNotFoundError:
+                if attempt == 2:
+                    raise
 
     def _index_sources(self, db, m, req, shard_ids):
         """Index-mode sources, optionally restricted to a shard subset

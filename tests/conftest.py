@@ -9,3 +9,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def mesh8():
+    """4x2 (shard x seg) mesh over the 8 forced host devices."""
+    from banyandb_tpu.parallel import make_mesh
+
+    return make_mesh(4, 2)

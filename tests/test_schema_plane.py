@@ -264,3 +264,41 @@ def test_gossip_tombstone_buries_property_doc(tmp_path):
     prop2 = PropertyEngine(reg2, tmp_path)
     PropertySchemaStore(reg2, prop2)
     assert not _has_measure(reg2, "tg", "doomed")
+
+
+def test_internal_group_protected(tmp_path):
+    """_schema is invisible on the public List and not deletable."""
+    from banyandb_tpu.api import pb
+    from banyandb_tpu.api.wire import group_to_pb  # noqa: F401 - sanity import
+
+    reg = SchemaRegistry(None)
+    prop = PropertyEngine(reg, tmp_path)
+    PropertySchemaStore(reg, prop)
+    with pytest.raises(ValueError):
+        reg.delete_group("_schema")
+
+    measure = MeasureEngine(reg, tmp_path / "data")
+    stream = StreamEngine(reg, tmp_path / "data")
+    srv = WireServer(WireServices(reg, measure, stream), port=0)
+    srv.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+    try:
+        rpc = pb.database_rpc_pb2
+        ls = chan.unary_unary(
+            "/banyandb.database.v1.GroupRegistryService/List",
+            request_serializer=rpc.GroupRegistryServiceListRequest.SerializeToString,
+            response_deserializer=rpc.GroupRegistryServiceListResponse.FromString,
+        )(rpc.GroupRegistryServiceListRequest())
+        assert "_schema" not in [g.metadata.name for g in ls.group]
+
+        delete = chan.unary_unary(
+            "/banyandb.database.v1.GroupRegistryService/Delete",
+            request_serializer=rpc.GroupRegistryServiceDeleteRequest.SerializeToString,
+            response_deserializer=rpc.GroupRegistryServiceDeleteResponse.FromString,
+        )
+        with pytest.raises(grpc.RpcError) as ei:
+            delete(rpc.GroupRegistryServiceDeleteRequest(group="_schema"))
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        chan.close()
+        srv.stop()
