@@ -181,6 +181,7 @@ class WireServices:
         cluster_view_fn=None,
         barrier=None,
         schema_store=None,
+        watch_stream_cap: int = 4,
     ):
         self.registry = registry
         self.measure = measure_engine
@@ -208,10 +209,10 @@ class WireServices:
         import threading as _threading
 
         self._barrier_slots = _threading.BoundedSemaphore(4)
-        # WatchSchemas streams hold a worker for their whole life; cap them
-        # so watchers can never exhaust the server pool (WireServer raises
-        # this bound alongside max_workers)
-        self._watch_slots = _threading.BoundedSemaphore(4)
+        # WatchSchemas streams hold a worker for their whole life; cap
+        # them so watchers can never exhaust the server pool (WireServer
+        # passes a cap proportional to its max_workers)
+        self._watch_slots = _threading.BoundedSemaphore(watch_stream_cap)
 
     @staticmethod
     def _one_group(ireq) -> str:
@@ -879,8 +880,6 @@ class WireServices:
         """SchemaUpdateService.WatchSchemas (internal.proto:79): replay
         the current schema set, mark REPLAY_DONE, then stream live
         events until the client goes away."""
-        import queue as _queue
-
         store = self._require_schema_store()
         if not self._watch_slots.acquire(blocking=False):
             context.abort(
@@ -1055,7 +1054,7 @@ class WireServer:
 
         services._watch_slots = _threading.BoundedSemaphore(
             max(2, max_workers // 4)
-        )
+        )  # rebound to THIS server's pool size (services default is 4)
         interceptors = ()
         self.auth = None
         if auth_file:

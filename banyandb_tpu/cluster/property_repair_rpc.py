@@ -1,0 +1,379 @@
+"""Property repair + gossip over the wire.
+
+The reference reconciles property replicas with a bidirectional
+RepairService stream driven through a gossip scheduler
+(banyand/property/db/repair.go, db/repair_gossip.go,
+api/proto/banyandb/property/v1/repair.proto:113, gossip.proto:46,
+docs/concept/property-repair.md).  This module serves the SAME proto
+message shapes on the repo's GrpcBusServer:
+
+  compare stage:  client TreeRoot        -> server RootCompare
+                  client TreeSlots       -> server DifferTreeSummary
+  repair stage:   client PropertyMissing -> server PropertySyncWithFrom
+                  client PropertySync    -> server PropertySyncWithFrom
+
+One deliberate simplification vs upstream: every repair-stage request
+gets exactly one response (an empty PropertySyncWithFrom means "nothing
+for you"), keeping the bidi exchange in lockstep — the reference
+pipelines asynchronously.  Conflict resolution carries mod_revision in
+a reserved "@mod" tag on the wire (the upstream Property message has no
+revision field; it resolves by delete_time/updated_at instead) — higher
+revision wins, and installs preserve the winner's revision verbatim so
+both trees converge to identical SHAs.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Callable
+
+import grpc
+
+from banyandb_tpu.api import pb
+from banyandb_tpu.models import property_repair
+from banyandb_tpu.models.property import Property
+
+REPAIR_SERVICE = "banyandb.property.v1.RepairService"
+REPAIR_METHOD = f"/{REPAIR_SERVICE}/Repair"
+GOSSIP_SERVICE = "banyandb.property.v1.GossipService"
+GOSSIP_METHOD = f"/{GOSSIP_SERVICE}/Propagation"
+
+_MOD_TAG = "@mod"
+_CREATE_TAG = "@create"
+
+
+def _prop_to_pb(p: Property):
+    rpb = pb.property_property_pb2
+    out = rpb.Property()
+    out.metadata.group = p.group
+    out.metadata.name = p.name
+    out.id = p.id
+    for k, v in sorted(p.tags.items()):
+        tag = out.tags.add(key=k)
+        tag.value.str.value = str(v)
+    mod = out.tags.add(key=_MOD_TAG)
+    mod.value.str.value = str(p.mod_revision)
+    cre = out.tags.add(key=_CREATE_TAG)
+    cre.value.str.value = str(p.create_revision)
+    return out
+
+
+def _prop_from_pb(msg) -> Property:
+    tags, mod, cre = {}, 0, 0
+    for tag in msg.tags:
+        if tag.key == _MOD_TAG:
+            mod = int(tag.value.str.value or 0)
+        elif tag.key == _CREATE_TAG:
+            cre = int(tag.value.str.value or 0)
+        else:
+            tags[tag.key] = tag.value.str.value
+    return Property(
+        group=msg.metadata.group,
+        name=msg.metadata.name,
+        id=msg.id,
+        tags=tags,
+        mod_revision=mod,
+        create_revision=cre,
+    )
+
+
+def _split_entity(entity: str) -> tuple[str, str]:
+    name, _, pid = entity.partition("/")
+    return name, pid
+
+
+# -- server ------------------------------------------------------------------
+
+
+def repair_behavior(engine) -> Callable:
+    """Bidi handler bound to this node's PropertyEngine."""
+    rpb = pb.property_repair_pb2
+
+    def behavior(request_iterator, context):
+        group = ""
+        shard = 0
+        tree: dict = {}
+        installed = False
+        for req in request_iterator:
+            which = req.WhichOneof("data")
+            if which == "tree_root":
+                group = req.tree_root.group
+                shard = int(req.tree_root.shard_id)
+                tree = property_repair.build_shard_tree(engine, group, shard)
+                yield rpb.RepairResponse(
+                    root_compare=rpb.RootCompare(
+                        tree_found=True,
+                        root_sha_match=(
+                            req.tree_root.root_sha == tree["root"]
+                        ),
+                    )
+                )
+            elif which == "tree_slots":
+                client = {
+                    str(s.slot): s.value for s in req.tree_slots.slot_sha
+                }
+                mine = tree.get("slots", {})
+                differ = [
+                    s
+                    for s in set(client) | set(mine)
+                    if client.get(s) != mine.get(s)
+                ]
+                nodes = []
+                for s in sorted(differ, key=int):
+                    mine_leaves = tree.get("leaves", {}).get(s, [])
+                    if not mine_leaves:
+                        nodes.append(
+                            rpb.TreeLeafNode(slot_index=int(s), exists=False)
+                        )
+                        continue
+                    for entity, sha in mine_leaves:
+                        nodes.append(
+                            rpb.TreeLeafNode(
+                                slot_index=int(s),
+                                exists=True,
+                                entity=entity,
+                                sha=sha,
+                            )
+                        )
+                yield rpb.RepairResponse(
+                    differ_tree_summary=rpb.DifferTreeSummary(nodes=nodes)
+                )
+            elif which == "wait_next_differ":
+                yield rpb.RepairResponse(
+                    differ_tree_summary=rpb.DifferTreeSummary(nodes=[])
+                )
+            elif which == "property_missing":
+                name, pid = _split_entity(req.property_missing.entity)
+                p = engine.get(group, name, pid)
+                resp = rpb.PropertySyncWithFrom()
+                if p is not None:
+                    # 'from' is a Python keyword; protobuf exposes it via setattr
+                    setattr(resp, "from", 1)  # MISSING: client lacks it
+                    resp.property.id = req.property_missing.entity.encode()
+                    resp.property.property.CopyFrom(_prop_to_pb(p))
+                yield rpb.RepairResponse(property_sync=resp)
+            elif which == "property_sync":
+                theirs = _prop_from_pb(req.property_sync.property)
+                mine = engine.get(theirs.group, theirs.name, theirs.id)
+                resp = rpb.PropertySyncWithFrom()
+                if mine is None or property_repair.wins(theirs, mine):
+                    property_repair.install_verbatim(engine, theirs)
+                    installed = True
+                    # lockstep ack: from=MISSING with no property means
+                    # "server took yours" (upstream pipelines these
+                    # asynchronously and needs no ack)
+                    setattr(resp, "from", 1)
+                elif property_repair.wins(mine, theirs):
+                    setattr(resp, "from", 2)  # SYNC: server side is newer
+                    resp.property.id = (
+                        f"{mine.name}/{mine.id}".encode()
+                    )
+                    resp.property.property.CopyFrom(_prop_to_pb(mine))
+                yield rpb.RepairResponse(property_sync=resp)
+            else:
+                # lockstep invariant: EVERY request gets a response, even
+                # one whose oneof we do not recognize — silence here
+                # deadlocks the exchange
+                yield rpb.RepairResponse(
+                    differ_tree_summary=rpb.DifferTreeSummary(nodes=[])
+                )
+        # stream over: docs installed for the client must survive a
+        # server restart (the client persists its own side in finally)
+        if installed and group:
+            engine.persist_group(group)
+
+    return behavior
+
+
+def generic_handler(engine):
+    rpb = pb.property_repair_pb2
+    return grpc.method_handlers_generic_handler(
+        REPAIR_SERVICE,
+        {
+            "Repair": grpc.stream_stream_rpc_method_handler(
+                repair_behavior(engine),
+                request_deserializer=rpb.RepairRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        },
+    )
+
+
+# -- client ------------------------------------------------------------------
+
+_SENTINEL = object()
+
+
+def repair_with_peer(channel, engine, group: str, shard: int) -> int:
+    """Drive one full repair round against a peer; returns docs copied
+    in either direction.  Raises on transport failure mid-round — the
+    caller (gossip scheduler) retries; every exchange is idempotent."""
+    rpb = pb.property_repair_pb2
+    stub = channel.stream_stream(
+        REPAIR_METHOD,
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=rpb.RepairResponse.FromString,
+    )
+    reqq: queue.Queue = queue.Queue()
+    call = stub(iter(reqq.get, _SENTINEL))
+    copied = 0
+    try:
+        tree = property_repair.build_shard_tree(engine, group, shard)
+        req = rpb.RepairRequest()
+        req.tree_root.group = group
+        req.tree_root.shard_id = shard
+        req.tree_root.root_sha = tree["root"]
+        reqq.put(req)
+        rc = next(call).root_compare
+        if rc.root_sha_match:
+            return 0
+
+        req = rpb.RepairRequest()
+        req.tree_slots.SetInParent()  # an EMPTY slot set must still set
+        # the oneof, or the server sees a dataless request and the
+        # lockstep exchange deadlocks
+        for s, v in tree["slots"].items():
+            req.tree_slots.slot_sha.add(slot=int(s), value=v)
+        reqq.put(req)
+        summary = next(call).differ_tree_summary
+
+        # index the server's leaves by slot
+        server_leaves: dict[int, dict[str, str]] = {}
+        server_slots: set[int] = set()
+        for n in summary.nodes:
+            server_slots.add(n.slot_index)
+            if n.exists:
+                server_leaves.setdefault(n.slot_index, {})[n.entity] = n.sha
+
+        my_leaves: dict[int, dict[str, str]] = {}
+        for s, lst in tree["leaves"].items():
+            my_leaves[int(s)] = {e: h for e, h in lst}
+
+        # every slot the server called out, plus slots it lacks entirely
+        for s in sorted(server_slots | set(my_leaves)):
+            srv = server_leaves.get(s, {})
+            if s not in server_slots:
+                continue  # slot SHAs matched; nothing to reconcile
+            mine = my_leaves.get(s, {})
+            for entity in sorted(set(srv) | set(mine)):
+                if srv.get(entity) == mine.get(entity):
+                    continue
+                if entity not in mine:
+                    # case 1: client missing, server existing
+                    req = rpb.RepairRequest()
+                    req.property_missing.entity = entity
+                    reqq.put(req)
+                    resp = next(call).property_sync
+                    if getattr(resp, "from") == 1 and resp.property.HasField("property"):
+                        property_repair.install_verbatim(
+                            engine, _prop_from_pb(resp.property.property)
+                        )
+                        copied += 1
+                else:
+                    # case 2/3: client existing, server missing or differs
+                    name, pid = _split_entity(entity)
+                    mine_p = engine.get(group, name, pid)
+                    if mine_p is None:
+                        continue
+                    req = rpb.RepairRequest()
+                    req.property_sync.id = entity.encode()
+                    req.property_sync.property.CopyFrom(_prop_to_pb(mine_p))
+                    reqq.put(req)
+                    resp = next(call).property_sync
+                    if getattr(resp, "from") == 2 and resp.property.HasField("property"):
+                        property_repair.install_verbatim(
+                            engine, _prop_from_pb(resp.property.property)
+                        )
+                        copied += 1  # pulled the server's newer doc
+                    elif getattr(resp, "from") == 1:
+                        copied += 1  # server took ours (install ack)
+                    # from=0: nothing moved on either side
+        return copied
+    finally:
+        reqq.put(_SENTINEL)
+        try:
+            call.cancel()
+        except Exception:  # noqa: BLE001
+            pass
+        engine.persist_group(group)
+
+
+# -- gossip scheduler --------------------------------------------------------
+
+
+class PropertyGossip:
+    """Propagation handler + initiator (repair_gossip.go analog).
+
+    On Propagation(group, shard): repair with the NEXT node in
+    context.nodes (ring order), then forward the request with
+    current_propagation_count+1 until max_propagation_count.  Any node
+    failure stops this round; the next scheduled round retries — rounds
+    are idempotent.
+    """
+
+    def __init__(self, node_name: str, engine, channel_of: Callable[[str], object]):
+        self.node_name = node_name
+        self.engine = engine
+        self.channel_of = channel_of  # node name -> grpc channel
+        self.rounds = 0
+
+    def behavior(self, request, context):
+        gpb = pb.property_gossip_pb2
+        ctx = request.context
+        self._run(request, ctx)
+        return gpb.PropagationResponse()
+
+    def _run(self, request, ctx) -> None:
+        if ctx.current_propagation_count >= ctx.max_propagation_count:
+            return
+        nodes = list(ctx.nodes)
+        if self.node_name not in nodes:
+            return
+        nxt = nodes[(nodes.index(self.node_name) + 1) % len(nodes)]
+        if nxt == self.node_name:
+            return
+        chan = self.channel_of(nxt)
+        repair_with_peer(
+            chan, self.engine, request.group, int(request.shard_id)
+        )
+        self.rounds += 1
+        fwd = pb.property_gossip_pb2.PropagationRequest()
+        fwd.CopyFrom(request)
+        fwd.context.current_propagation_count = (
+            ctx.current_propagation_count + 1
+        )
+        if fwd.context.current_propagation_count >= ctx.max_propagation_count:
+            return
+        stub = chan.unary_unary(
+            GOSSIP_METHOD,
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.property_gossip_pb2.PropagationResponse.FromString,
+        )
+        stub(fwd)
+
+    def start_round(
+        self, nodes: list[str], group: str, shard: int, max_hops: int = 0
+    ) -> None:
+        """Initiate a propagation round from this node."""
+        gpb = pb.property_gossip_pb2
+        req = gpb.PropagationRequest()
+        req.context.nodes.extend(nodes)
+        req.context.max_propagation_count = max_hops or len(nodes)
+        req.context.current_propagation_count = 0
+        req.context.origin_node = self.node_name
+        req.group = group
+        req.shard_id = shard
+        self._run(req, req.context)
+
+    def generic_handler(self):
+        gpb = pb.property_gossip_pb2
+        return grpc.method_handlers_generic_handler(
+            GOSSIP_SERVICE,
+            {
+                "Propagation": grpc.unary_unary_rpc_method_handler(
+                    self.behavior,
+                    request_deserializer=gpb.PropagationRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                )
+            },
+        )

@@ -87,9 +87,12 @@ class GrpcBusServer:
         cert_file: Optional[str] = None,
         key_file: Optional[str] = None,
         sync_install=None,
+        extra_handlers=(),
     ):
         """sync_install: optional callback enabling the streaming
-        ChunkedSyncService on this server (cluster/chunked_sync.py)."""
+        ChunkedSyncService on this server (cluster/chunked_sync.py).
+        extra_handlers: additional generic RPC handlers to co-host (e.g.
+        property repair/gossip, cluster/property_repair_rpc.py)."""
         import grpc
 
         self.bus = bus
@@ -133,6 +136,8 @@ class GrpcBusServer:
             self._server.add_generic_rpc_handlers(
                 (chunked_sync.generic_handler(sync_install),)
             )
+        if extra_handlers:
+            self._server.add_generic_rpc_handlers(tuple(extra_handlers))
         self.tls_reloader = None
         if cert_file and key_file:
             # hot-reloading credentials (pkg/tls/reloader.go:55 analog):

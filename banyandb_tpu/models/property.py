@@ -140,6 +140,9 @@ class PropertyEngine:
             if dead:
                 idx.delete(dead)
                 removed += len(dead)
+        if removed:
+            with self._lock:
+                self._revision += 1  # state-tree freshness (see delete())
         return removed
 
     def get(self, group: str, name: str, pid: str) -> Optional[Property]:
@@ -160,6 +163,10 @@ class PropertyEngine:
         if idx.get(doc_id) is None:
             return False
         idx.delete([doc_id])
+        with self._lock:
+            # any mutation advances the revision: the repair state tree's
+            # freshness guard must see deletions too
+            self._revision += 1
         return True
 
     def query(
@@ -196,6 +203,33 @@ class PropertyEngine:
                 if len(out) >= limit:
                     return out
         return out
+
+    def docs_in_shard(self, group: str, shard: int) -> list[Property]:
+        """All live docs of one (group, shard) — repair-tree enumeration
+        (banyand/property/db/repair.go walks the shard store the same
+        way)."""
+        idx = self._shard_idx(group, shard)
+        out = []
+        for doc_id in idx.search(None).tolist():
+            doc = idx.get(doc_id)
+            if doc is None or self._expired(doc):
+                continue
+            src = json.loads(doc.payload)
+            out.append(
+                Property(
+                    group=group,
+                    name=src["name"],
+                    id=src["id"],
+                    tags=src["tags"],
+                    mod_revision=doc.numerics.get("@mod", 0),
+                    create_revision=doc.numerics.get("@create", 0),
+                )
+            )
+        return out
+
+    @property
+    def revision(self) -> int:
+        return self._revision
 
     def persist(self) -> None:
         for idx in self._shards.values():

@@ -31,6 +31,17 @@ def _doc_hash(p) -> bytes:
     return h.digest()
 
 
+def wins(x, y) -> bool:
+    """True when doc x beats doc y: higher mod_revision, with a
+    deterministic content-hash tie-break — revisions are per-node
+    counters, so two nodes can mint EQUAL revisions for different
+    content; without a total order those replicas would never
+    converge."""
+    if x.mod_revision != y.mod_revision:
+        return x.mod_revision > y.mod_revision
+    return _doc_hash(x) > _doc_hash(y)
+
+
 def _slot_of(p) -> int:
     return int.from_bytes(
         hashlib.blake2b(p.id.encode(), digest_size=2).digest(), "little"
@@ -84,13 +95,77 @@ def repair_pair(
         docs_b = _slot_docs(b, group, name, int(s))
         for pid in set(docs_a) | set(docs_b):
             pa, pb = docs_a.get(pid), docs_b.get(pid)
-            if pa is not None and (pb is None or pa.mod_revision > pb.mod_revision):
+            if pa is not None and (pb is None or wins(pa, pb)):
                 _install(b, pa)
                 copied += 1
-            elif pb is not None and (pa is None or pb.mod_revision > pa.mod_revision):
+            elif pb is not None and (pa is None or wins(pb, pa)):
                 _install(a, pb)
                 copied += 1
     return copied
+
+
+# -- persisted shard state tree (state-tree.data analog) --------------------
+
+
+def _entity_of(p) -> str:
+    return f"{p.name}/{p.id}"
+
+
+def _entity_slot(entity: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(entity.encode(), digest_size=2).digest(), "little"
+    ) % SLOTS
+
+
+def build_shard_tree(engine: "PropertyEngine", group: str, shard: int) -> dict:
+    """Three-level Merkle over one (group, shard): root -> slot SHAs ->
+    per-entity leaf SHAs, PERSISTED next to the shard
+    (banyand/property/db/repair.go:95 state-tree.data analog).  The
+    persisted tree is reused while the engine revision is unchanged, so
+    repeated gossip rounds over a quiet shard cost one file read."""
+    import json
+
+    from banyandb_tpu.utils import fs
+
+    path = engine.root / "repair" / f"state-tree-{group}-{shard}.json"
+    rev = engine.revision
+    try:
+        cached = json.loads(path.read_text())
+        if cached.get("built_rev") == rev:
+            return cached
+    except (OSError, ValueError):
+        pass
+
+    leaves: dict[str, list] = {}
+    for p in engine.docs_in_shard(group, shard):
+        e = _entity_of(p)
+        s = str(_entity_slot(e))
+        leaves.setdefault(s, []).append([e, _doc_hash(p).hex()])
+    for lst in leaves.values():
+        lst.sort()
+    slot_sha = {}
+    for s, lst in leaves.items():
+        h = hashlib.blake2b(digest_size=16)
+        for e, hx in lst:
+            h.update(e.encode() + bytes.fromhex(hx))
+        slot_sha[s] = h.hexdigest()
+    root = hashlib.blake2b(digest_size=16)
+    for s in sorted(slot_sha, key=int):
+        root.update(int(s).to_bytes(2, "little") + bytes.fromhex(slot_sha[s]))
+    tree = {
+        "built_rev": rev,
+        "root": root.hexdigest(),
+        "slots": slot_sha,
+        "leaves": leaves,
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fs.atomic_write_json(path, tree)
+    return tree
+
+
+def install_verbatim(engine: "PropertyEngine", p) -> None:
+    """Public alias of _install for the wire repair path."""
+    _install(engine, p)
 
 
 def _install(engine: "PropertyEngine", p) -> None:
@@ -100,6 +175,11 @@ def _install(engine: "PropertyEngine", p) -> None:
 
     from banyandb_tpu.index.inverted import Doc
 
+    # the engine's revision counter is the persisted state tree's
+    # freshness guard: advance it so the NEXT build_shard_tree sees the
+    # install (the doc's own mod_revision stays the replica's, above)
+    with engine._lock:
+        engine._revision += 1
     idx = engine._shard_for(p.group, p.name, p.id)
     keywords = {"@name": p.name.encode(), "@id": p.id.encode()}
     for k, v in p.tags.items():

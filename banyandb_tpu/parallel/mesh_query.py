@@ -83,13 +83,30 @@ class MeshExecutor:
         tags_code = tuple(sorted(set(group_tags) | {c.name for c in conds}))
         gd = measure_exec.GlobalDicts(tags_code)
 
-        # --- gather per node (its assigned shards only), shared dicts ----
-        per_node_cols = []
+        # --- select sources per node (its assigned shards only) ----------
+        per_node_srcs = []
         for node, shards in assignment.items():
             eng = self.engines.get(node.name)
             if eng is None:
                 raise MeshUnsupported(f"no in-process engine for {node.name}")
-            srcs = eng.gather_query_sources(req, shard_ids=shards)
+            per_node_srcs.append(eng.gather_query_sources(req, shard_ids=shards))
+
+        # group-cardinality budget BEFORE the expensive row gather/dedup:
+        # union the sources' own dictionaries per group tag (dict metadata
+        # only, no row work) so an over-budget query falls back cheaply
+        est = 1
+        for t in group_tags:
+            union: set = set()
+            for srcs in per_node_srcs:
+                for src in srcs:
+                    union.update(src.dicts.get(t, ()))
+            est *= max(len(union), 1)
+        if est > _MAX_MESH_GROUPS:
+            raise MeshUnsupported(f"~{est} groups exceed the mesh budget")
+
+        # --- gather rows per node, shared global dicts -------------------
+        per_node_cols = []
+        for srcs in per_node_srcs:
             cols = measure_exec._gather_rows(
                 srcs,
                 list(tags_code),

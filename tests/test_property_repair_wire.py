@@ -1,0 +1,216 @@
+"""Property repair over the wire (VERDICT r2 next #6): persisted state
+trees + reconciliation rounds between REAL GrpcBus nodes using the
+reference's repair/gossip proto shapes (property/v1/repair.proto:113,
+gossip.proto:46)."""
+
+import grpc as _grpc
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from banyandb_tpu.api import Catalog, Group, ResourceOpts, SchemaRegistry  # noqa: E402
+from banyandb_tpu.cluster import property_repair_rpc as prw  # noqa: E402
+from banyandb_tpu.cluster.bus import LocalBus  # noqa: E402
+from banyandb_tpu.cluster.rpc import GrpcBusServer  # noqa: E402
+from banyandb_tpu.models import property_repair  # noqa: E402
+from banyandb_tpu.models.property import Property, PropertyEngine  # noqa: E402
+
+GROUP = "pr"
+
+
+def _node(tmp_path, name):
+    reg = SchemaRegistry(tmp_path / name / "schema")
+    reg.create_group(Group(GROUP, Catalog.PROPERTY, ResourceOpts(shard_num=2)))
+    eng = PropertyEngine(reg, tmp_path / name / "data")
+    srv = GrpcBusServer(
+        LocalBus(),
+        port=0,
+        extra_handlers=[prw.generic_handler(eng)],
+    )
+    srv.start()
+    return eng, srv
+
+
+def _apply(eng, name, pid, tags, rev):
+    property_repair.install_verbatim(
+        eng,
+        Property(
+            group=GROUP, name=name, id=pid, tags=tags,
+            mod_revision=rev, create_revision=rev,
+        ),
+    )
+
+
+def _all_docs(eng):
+    out = {}
+    for s in range(2):
+        for p in eng.docs_in_shard(GROUP, s):
+            out[f"{p.name}/{p.id}"] = (p.mod_revision, tuple(sorted(p.tags.items())))
+    return out
+
+
+def test_two_grpc_nodes_converge(tmp_path):
+    a_eng, a_srv = _node(tmp_path, "a")
+    b_eng, b_srv = _node(tmp_path, "b")
+    try:
+        # divergence: a-only docs, b-only docs, and a conflict where b is newer
+        _apply(a_eng, "svc", "only-a", {"v": "1"}, 10)
+        _apply(b_eng, "svc", "only-b", {"v": "2"}, 11)
+        _apply(a_eng, "svc", "both", {"v": "old"}, 5)
+        _apply(b_eng, "svc", "both", {"v": "new"}, 9)
+
+        chan = _grpc.insecure_channel(b_srv.addr)
+        copied = 0
+        for shard in range(2):
+            copied += prw.repair_with_peer(chan, a_eng, GROUP, shard)
+        chan.close()
+        assert copied >= 3
+
+        da, db = _all_docs(a_eng), _all_docs(b_eng)
+        assert da == db
+        assert da["svc/both"][0] == 9  # higher revision won
+        assert dict(da["svc/both"][1])["v"] == "new"
+
+        # state trees now agree and a re-run copies nothing
+        chan = _grpc.insecure_channel(b_srv.addr)
+        assert sum(
+            prw.repair_with_peer(chan, a_eng, GROUP, s) for s in range(2)
+        ) == 0
+        chan.close()
+    finally:
+        a_srv.stop()
+        b_srv.stop()
+
+
+def test_state_tree_persisted_and_reused(tmp_path):
+    eng, srv = _node(tmp_path, "n")
+    try:
+        _apply(eng, "svc", "x", {"v": "1"}, 3)
+        # find the shard the doc hashed into
+        shard = next(
+            s for s in range(2) if eng.docs_in_shard(GROUP, s)
+        )
+        t1 = property_repair.build_shard_tree(eng, GROUP, shard)
+        path = eng.root / "repair" / f"state-tree-{GROUP}-{shard}.json"
+        assert path.exists()  # state-tree.data analog on disk
+        assert t1["leaves"]
+        t2 = property_repair.build_shard_tree(eng, GROUP, shard)
+        assert t2 == t1  # reused while the engine revision is unchanged
+
+        _apply(eng, "svc", "x", {"v": "CHANGED"}, 4)  # bumps the revision
+        t3 = property_repair.build_shard_tree(eng, GROUP, shard)
+        assert t3["root"] != t1["root"]
+    finally:
+        srv.stop()
+
+
+def test_kill_one_mid_round_converges_on_retry(tmp_path):
+    a_eng, a_srv = _node(tmp_path, "a")
+    b_eng, b_srv = _node(tmp_path, "b")
+    port = b_srv.port
+    try:
+        for i in range(40):
+            _apply(b_eng, "svc", f"doc{i}", {"v": str(i)}, i + 1)
+        _apply(a_eng, "svc", "mine", {"v": "a"}, 1)
+
+        # kill the peer before the round: the client raises, nothing corrupts
+        b_srv.stop(grace=0)
+        chan = _grpc.insecure_channel(f"127.0.0.1:{port}")
+        with pytest.raises(Exception):
+            for s in range(2):
+                prw.repair_with_peer(chan, a_eng, GROUP, s)
+        chan.close()
+
+        # peer restarts on the same port with the same on-disk engine state
+        b_srv2 = GrpcBusServer(
+            LocalBus(), port=port,
+            extra_handlers=[prw.generic_handler(b_eng)],
+        )
+        b_srv2.start()
+        chan = _grpc.insecure_channel(f"127.0.0.1:{port}")
+        for s in range(2):
+            prw.repair_with_peer(chan, a_eng, GROUP, s)
+        chan.close()
+        b_srv2.stop()
+        assert _all_docs(a_eng) == _all_docs(b_eng)
+        assert len(_all_docs(a_eng)) == 41
+    finally:
+        a_srv.stop()
+        b_srv.stop()
+
+
+def test_gossip_propagation_ring(tmp_path):
+    """Three nodes, gossip round from n0: every node converges."""
+    engines, servers, gossips = [], [], []
+    addrs = {}
+    chans = {}
+
+    def channel_of(node_name):
+        if node_name not in chans:
+            chans[node_name] = _grpc.insecure_channel(addrs[node_name])
+        return chans[node_name]
+
+    try:
+        for i in range(3):
+            reg = SchemaRegistry(tmp_path / f"n{i}/schema")
+            reg.create_group(
+                Group(GROUP, Catalog.PROPERTY, ResourceOpts(shard_num=1))
+            )
+            eng = PropertyEngine(reg, tmp_path / f"n{i}/data")
+            g = prw.PropertyGossip(f"n{i}", eng, channel_of)
+            srv = GrpcBusServer(
+                LocalBus(), port=0,
+                extra_handlers=[prw.generic_handler(eng), g.generic_handler()],
+            )
+            srv.start()
+            engines.append(eng)
+            servers.append(srv)
+            gossips.append(g)
+            addrs[f"n{i}"] = srv.addr
+
+        _apply(engines[0], "svc", "from0", {"v": "0"}, 7)
+        _apply(engines[1], "svc", "from1", {"v": "1"}, 8)
+        _apply(engines[2], "svc", "from2", {"v": "2"}, 9)
+
+        nodes = ["n0", "n1", "n2"]
+        # a full ring needs each pair repaired; two rounds of 3 hops settle it
+        gossips[0].start_round(nodes, GROUP, 0, max_hops=3)
+        gossips[0].start_round(nodes, GROUP, 0, max_hops=3)
+
+        views = [_all_docs(e) for e in engines]
+        assert views[0] == views[1] == views[2]
+        assert len(views[0]) == 3
+    finally:
+        for c in chans.values():
+            c.close()
+        for s in servers:
+            s.stop()
+
+
+def test_equal_revision_different_content_converges(tmp_path):
+    """Per-node revision counters can mint EQUAL revisions for different
+    content; the deterministic content-hash tie-break must converge both
+    replicas to ONE winner (review r3 finding)."""
+    a_eng, a_srv = _node(tmp_path, "a")
+    b_eng, b_srv = _node(tmp_path, "b")
+    try:
+        _apply(a_eng, "svc", "clash", {"v": "from-a"}, 5)
+        _apply(b_eng, "svc", "clash", {"v": "from-b"}, 5)
+
+        chan = _grpc.insecure_channel(b_srv.addr)
+        copied = sum(
+            prw.repair_with_peer(chan, a_eng, GROUP, s) for s in range(2)
+        )
+        assert copied == 1
+        da, db = _all_docs(a_eng), _all_docs(b_eng)
+        assert da == db
+        assert dict(da["svc/clash"][1])["v"] in ("from-a", "from-b")
+
+        # second round: fully converged, nothing moves
+        assert sum(
+            prw.repair_with_peer(chan, a_eng, GROUP, s) for s in range(2)
+        ) == 0
+        chan.close()
+    finally:
+        a_srv.stop()
+        b_srv.stop()
