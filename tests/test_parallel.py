@@ -79,3 +79,212 @@ def test_distributed_matches_oracle(mesh):
 def test_mesh_too_small():
     with pytest.raises(ValueError, match="devices"):
         make_mesh(100, 2)
+
+
+def _oracle(per_shard, pred=None):
+    svc = np.concatenate([r["tags"]["svc"] for r in per_shard])
+    region = np.concatenate([r["tags"]["region"] for r in per_shard])
+    lat = np.concatenate([r["fields"]["lat"] for r in per_shard])
+    sel = np.ones(svc.size, bool) if pred is None else region == pred
+    return svc, region, lat, sel
+
+
+def _check_groups(out, svc, lat, sel, num_groups):
+    for g in range(num_groups):
+        m = sel & (svc == g)
+        assert float(out["count"][g]) == m.sum(), g
+        np.testing.assert_allclose(
+            float(out["sums"]["lat"][g]), lat[m].sum(), rtol=1e-4
+        )
+        if m.any():
+            np.testing.assert_allclose(float(out["mins"]["lat"][g]), lat[m].min())
+            np.testing.assert_allclose(float(out["maxs"]["lat"][g]), lat[m].max())
+
+
+def _plan(**kw):
+    base = dict(
+        tags_code=("region", "svc"),
+        fields=("lat",),
+        group_tags=("svc",),
+        radices=(6,),
+        num_groups=6,
+    )
+    base.update(kw)
+    return DistPlan(**base)
+
+
+def test_ragged_shards(mesh):
+    """Device slots with wildly different row counts: padding rows are
+    invalid and must not contaminate any aggregate."""
+    sizes = [0, 1, 7, 400, 33, 256, 511, 100]
+    per_shard = [_mk_rows(n) for n in sizes]
+    plan = _plan()
+    chunks = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, 512)
+    out = distributed_aggregate(mesh, plan, chunks)
+    svc, _region, lat, sel = _oracle(per_shard)
+    _check_groups(out, svc, lat, sel, 6)
+    assert float(np.asarray(out["count"]).sum()) == sum(sizes)
+
+
+@pytest.mark.parametrize("shape", [(8, 1), (2, 4), (1, 2), (4, 2)])
+def test_mesh_shapes_agree(shape):
+    """The same data over 8x1 / 2x4 / 1x2 / 4x2 meshes produces identical
+    counts — the collective reduce is topology-independent."""
+    n_shard, n_seg = shape
+    mesh = make_mesh(n_shard, n_seg)
+    d = n_shard * n_seg
+    rng = np.random.default_rng(5)
+    rows = {
+        "tags": {
+            "svc": rng.integers(0, 6, 1024).astype(np.int32),
+            "region": rng.integers(0, 3, 1024).astype(np.int32),
+        },
+        "fields": {"lat": rng.gamma(2.0, 40.0, 1024).astype(np.float32)},
+    }
+    per = 1024 // d
+    per_shard = [
+        {
+            "tags": {t: a[i * per : (i + 1) * per] for t, a in rows["tags"].items()},
+            "fields": {f: a[i * per : (i + 1) * per] for f, a in rows["fields"].items()},
+        }
+        for i in range(d)
+    ]
+    plan = _plan()
+    chunks = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, per)
+    out = distributed_aggregate(mesh, plan, chunks)
+    svc = rows["tags"]["svc"]
+    expect = [int((svc[: per * d] == g).sum()) for g in range(6)]
+    got = [int(c) for c in np.asarray(out["count"])]
+    assert got == expect
+
+
+def test_single_device_mesh():
+    """Degenerate 1-device mesh: psum over a singleton axis is identity."""
+    mesh = make_mesh(1, 1)
+    per_shard = [_mk_rows(333)]
+    plan = _plan()
+    chunks = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, 512)
+    out = distributed_aggregate(mesh, plan, chunks)
+    svc, _r, lat, sel = _oracle(per_shard)
+    _check_groups(out, svc, lat, sel, 6)
+
+
+def test_mesh_wide_two_pass_percentile(mesh):
+    """Two-pass percentile across the mesh: pass 1 agrees the global
+    range (pmin/pmax), pass 2 histograms with it; p50 lands within one
+    bucket width of the exact quantile."""
+    per_shard = [_mk_rows(400) for _ in range(8)]
+    plan1 = _plan()
+    chunks = stack_shard_chunks(mesh, per_shard, plan1.tags_code, plan1.fields, 512)
+    out1 = distributed_aggregate(mesh, plan1, chunks)
+    count = np.asarray(out1["count"])
+    nz = count > 0
+    lo = float(np.asarray(out1["mins"]["lat"])[nz].min())
+    hi = float(np.asarray(out1["maxs"]["lat"])[nz].max())
+    span = max(hi - lo, 1e-6)
+
+    plan2 = _plan(want_hist="lat")
+    out2 = distributed_aggregate(
+        mesh, plan2, chunks, hist_lo=lo, hist_span=span
+    )
+    hist = np.asarray(out2["hist"])
+    svc, _r, lat, _sel = _oracle(per_shard)
+    width = span / hist.shape[1]
+    for g in range(6):
+        vals = lat[svc == g]
+        if vals.size == 0:
+            continue
+        cdf = np.cumsum(hist[g])
+        k = int(np.searchsorted(cdf, 0.5 * vals.size))
+        approx = lo + (k + 0.5) * width
+        assert abs(approx - np.quantile(vals, 0.5)) <= 2 * width
+
+
+def test_dist_vs_single_chip_parity_fuzz(mesh):
+    """Randomized plans + data: the 8-device mesh result equals the same
+    plan run on a 1-device mesh over the union of the rows."""
+    single = make_mesh(1, 1)
+    for seed in range(5):
+        rng = np.random.default_rng(100 + seed)
+        nsvc = int(rng.integers(2, 9))
+        per_shard = []
+        sizes = [int(rng.integers(0, 300)) for _ in range(8)]
+        for n in sizes:
+            per_shard.append(
+                {
+                    "tags": {
+                        "svc": rng.integers(0, nsvc, n).astype(np.int32),
+                        "region": rng.integers(0, 3, n).astype(np.int32),
+                    },
+                    "fields": {"lat": rng.gamma(2.0, 40.0, n).astype(np.float32)},
+                }
+            )
+        use_pred = bool(rng.integers(0, 2))
+        plan = _plan(
+            radices=(nsvc,),
+            num_groups=nsvc,
+            eq_preds=("region",) if use_pred else (),
+        )
+        pred = {"region": 1} if use_pred else None
+        chunks8 = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, 512)
+        out8 = distributed_aggregate(mesh, plan, chunks8, pred_codes=pred)
+
+        union = {
+            "tags": {
+                t: np.concatenate([r["tags"][t] for r in per_shard])
+                for t in ("svc", "region")
+            },
+            "fields": {
+                "lat": np.concatenate([r["fields"]["lat"] for r in per_shard])
+            },
+        }
+        chunks1 = stack_shard_chunks(
+            single, [union], plan.tags_code, plan.fields, 4096
+        )
+        out1 = distributed_aggregate(single, plan, chunks1, pred_codes=pred)
+        np.testing.assert_array_equal(
+            np.asarray(out8["count"]), np.asarray(out1["count"])
+        )
+        np.testing.assert_allclose(
+            np.asarray(out8["sums"]["lat"]),
+            np.asarray(out1["sums"]["lat"]),
+            rtol=1e-4,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out8["mins"]["lat"]), np.asarray(out1["mins"]["lat"])
+        )
+
+
+def test_global_aggregate_no_group_tags(mesh):
+    """num_groups=1, no group tags: a pure global reduce."""
+    per_shard = [_mk_rows(100) for _ in range(8)]
+    plan = _plan(group_tags=(), radices=(), num_groups=1)
+    chunks = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, 128)
+    out = distributed_aggregate(mesh, plan, chunks)
+    _svc, _r, lat, _sel = _oracle(per_shard)
+    assert float(out["count"][0]) == 800
+    np.testing.assert_allclose(float(out["sums"]["lat"][0]), lat.sum(), rtol=1e-4)
+
+
+def test_multi_tag_mixed_radix_grouping(mesh):
+    """Two group tags compose a mixed-radix key; decode matches oracle."""
+    per_shard = [_mk_rows(200) for _ in range(8)]
+    plan = _plan(group_tags=("region", "svc"), radices=(3, 6), num_groups=18)
+    chunks = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, 256)
+    out = distributed_aggregate(mesh, plan, chunks)
+    svc, region, lat, _sel = _oracle(per_shard)
+    count = np.asarray(out["count"])
+    for r in range(3):
+        for s in range(6):
+            key = r * 6 + s
+            assert float(count[key]) == int(((region == r) & (svc == s)).sum())
+
+
+def test_all_empty_shards(mesh):
+    """Every slot empty: zero counts, no NaNs crossing the collectives."""
+    per_shard = [_mk_rows(0) for _ in range(8)]
+    plan = _plan()
+    chunks = stack_shard_chunks(mesh, per_shard, plan.tags_code, plan.fields, 64)
+    out = distributed_aggregate(mesh, plan, chunks)
+    assert float(np.asarray(out["count"]).sum()) == 0
+    assert np.isfinite(np.asarray(out["sums"]["lat"])).all()
