@@ -63,7 +63,8 @@ class DataNode:
                 list(self.stream._tsdbs.values())
                 + list(self.trace._tsdbs.values())
             ),
-            extra_tick=self.trace.maintain,
+            extra_tick=lambda: self.trace.maintain(flush_sidx=False),
+            pre_flush=self.trace._flush_sidx_first,
             **kw,
         )
 

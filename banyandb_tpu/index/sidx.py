@@ -50,15 +50,24 @@ class SidxStore:
 
     # -- lifecycle ----------------------------------------------------------
     def _load_snapshot(self) -> None:
+        import shutil
+
         snp = self.root / SNAPSHOT
-        if not snp.exists():
-            return
-        data = fs.read_json(snp)
-        self._epoch = data["epoch"]
-        for name in data["parts"]:
-            pdir = self.root / name
-            if pdir.exists():
-                self._parts[name] = Part(pdir)
+        listed: set[str] = set()
+        if snp.exists():
+            data = fs.read_json(snp)
+            self._epoch = data["epoch"]
+            listed = set(data["parts"])
+            for name in data["parts"]:
+                pdir = self.root / name
+                if pdir.exists():
+                    self._parts[name] = Part(pdir)
+        # a part dir NOT in the snapshot is a crash-orphan (staged flush
+        # never committed, or interrupted merge temp): remove it so the
+        # store reopens exactly at its last published snapshot
+        for pdir in self.root.iterdir():
+            if pdir.is_dir() and pdir.name not in listed:
+                shutil.rmtree(pdir, ignore_errors=True)
 
     def _publish(self) -> None:
         fs.atomic_write_json(
@@ -77,38 +86,79 @@ class SidxStore:
         return n + sum(p.total_count for p in self._parts.values())
 
     def flush(self) -> Optional[str]:
-        with self._flush_lock:
-            return self._flush_locked()
+        txn = self.prepare_flush()
+        if txn is None:
+            return None
+        return txn.commit()
 
-    def _flush_locked(self) -> Optional[str]:
-        # mem is only TRIMMED after the part registers (same lock), so a
-        # concurrent range_query always sees every element in exactly one
-        # of (mem prefix, new part) — no invisible window mid-flush.
-        with self._lock:
-            if not self._mem_keys:
-                return None
-            keys = list(self._mem_keys)
-            payloads = list(self._mem_payloads)
-            self._epoch += 1
-            name = f"part-{self._epoch:016x}"
-        n = len(keys)
-        PartWriter.write(
-            self.root / name,
-            ts=np.asarray(keys, dtype=np.int64),
-            series=np.zeros(n, dtype=np.int64),
-            version=np.zeros(n, dtype=np.int64),
-            tag_codes={},
-            tag_dicts={},
-            fields={},
-            extra_meta={"sidx": True},
-            payloads=payloads,
-        )
-        with self._lock:
-            del self._mem_keys[:n]
-            del self._mem_payloads[:n]
-            self._parts[name] = Part(self.root / name)
-            self._publish()
-        return name
+    def prepare_flush(self) -> Optional["SidxFlushTxn"]:
+        """Stage a flush WITHOUT publishing (PrepareFlushed analog,
+        /root/reference/banyand/internal/sidx/interfaces.go:37): the part
+        is written to disk but the snapshot is untouched until commit(),
+        so a host engine can order the sidx commit point relative to its
+        own store's publish.  Holds the flush lock until commit/abort —
+        exactly one staged flush can be outstanding.
+
+        Crash semantics: an unpublished part dir is an orphan; reopen
+        removes it (not listed in the snapshot), as if the flush never
+        happened."""
+        self._flush_lock.acquire()
+        try:
+            # mem is only TRIMMED at commit (same lock), so a concurrent
+            # range_query always sees every element in exactly one of
+            # (mem prefix, new part) — no invisible window mid-flush.
+            with self._lock:
+                if not self._mem_keys:
+                    self._flush_lock.release()
+                    return None
+                keys = list(self._mem_keys)
+                payloads = list(self._mem_payloads)
+                self._epoch += 1
+                name = f"part-{self._epoch:016x}"
+            n = len(keys)
+            PartWriter.write(
+                self.root / name,
+                ts=np.asarray(keys, dtype=np.int64),
+                series=np.zeros(n, dtype=np.int64),
+                version=np.zeros(n, dtype=np.int64),
+                tag_codes={},
+                tag_dicts={},
+                fields={},
+                extra_meta={"sidx": True},
+                payloads=payloads,
+            )
+            return SidxFlushTxn(self, name, n)
+        except BaseException:
+            import shutil
+
+            # a half-written part dir is garbage now, not just at the
+            # next reopen's orphan sweep
+            try:
+                shutil.rmtree(self.root / name, ignore_errors=True)
+            except NameError:
+                pass  # failed before the part name existed
+            self._flush_lock.release()
+            raise
+
+    def _commit_staged(self, name: str, n: int) -> str:
+        try:
+            with self._lock:
+                del self._mem_keys[:n]
+                del self._mem_payloads[:n]
+                self._parts[name] = Part(self.root / name)
+                self._publish()
+            return name
+        finally:
+            self._flush_lock.release()
+
+    def _abort_staged(self, name: str) -> None:
+        import shutil
+
+        try:
+            shutil.rmtree(self.root / name, ignore_errors=True)
+            # the epoch bump is NOT rolled back: part names stay unique
+        finally:
+            self._flush_lock.release()
 
     def merge(self, max_parts: int = 8) -> Optional[str]:
         """Rewrite all parts into one when the count passes max_parts.
@@ -244,6 +294,28 @@ class SidxStore:
             if limit is not None and len(out) >= limit:
                 break
         return out
+
+
+class SidxFlushTxn:
+    """One staged sidx flush.  commit() publishes the part in the
+    snapshot and trims the flushed mem prefix; abort() deletes the
+    unpublished part dir.  Exactly one of the two must be called."""
+
+    def __init__(self, store: SidxStore, name: str, n: int):
+        self._store = store
+        self.name = name
+        self._n = n
+        self._done = False
+
+    def commit(self) -> str:
+        assert not self._done, "txn already finished"
+        self._done = True
+        return self._store._commit_staged(self.name, self._n)
+
+    def abort(self) -> None:
+        assert not self._done, "txn already finished"
+        self._done = True
+        self._store._abort_staged(self.name)
 
 
 def encode_ref(trace_id: str, ts_millis: int) -> bytes:

@@ -139,11 +139,10 @@ class TraceEngine:
                 else b""
                 for x in t.tags
             }
-            seg.shards[shard].ingest(
-                lambda mem: mem.append(
-                    name, tag_names, sp.ts_millis, sid, tag_bytes, sp.span
-                )
-            )
+            # ordering keys FIRST: if a concurrent flush tick lands
+            # between these two inserts, the failure direction is a
+            # prunable dangling key — never a durable span whose key was
+            # still mem-only (query_ordered would omit it forever)
             for rt in ordered_tags:
                 v = sp.tags.get(rt)
                 if v is None:
@@ -151,17 +150,53 @@ class TraceEngine:
                 self._ordered_index(group, seg, rt).insert(
                     int(v), sidx_encode_ref(trace_id, sp.ts_millis)
                 )
+            seg.shards[shard].ingest(
+                lambda mem: mem.append(
+                    name, tag_names, sp.ts_millis, sid, tag_bytes, sp.span
+                )
+            )
             n += 1
         return n
 
+    def _flush_sidx_first(self) -> None:
+        """Commit sidx flushes BEFORE span parts publish (the adapted
+        sidx/interfaces.go:37 snapshot-transaction contract): stage every
+        store's part, then publish them all, then let the caller flush
+        spans.  Any crash between the two publish points leaves at worst
+        DANGLING ordered keys, which query_ordered prunes via
+        verify_live — never durable spans missing their ordering keys
+        (the old order's divergence)."""
+        txns = []
+        try:
+            for idx in list(self._sidx.values()):
+                t = idx.prepare_flush()
+                if t is not None:
+                    txns.append(t)
+        except BaseException:
+            for t in txns:
+                t.abort()
+            raise
+        for i, t in enumerate(txns):
+            try:
+                t.commit()
+            except BaseException:
+                # a failed commit must not leak the remaining stores'
+                # flush locks (that would deadlock every future flush)
+                for u in txns[i + 1 :]:
+                    try:
+                        u.abort()
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
+
     def flush(self, group: Optional[str] = None) -> list[str]:
         out = []
-        for gname, db in self._tsdbs.items():
+        self._flush_sidx_first()
+        for gname, db in list(self._tsdbs.items()):
             if group is None or gname == group:
                 out.extend(db.flush_all())
                 self._write_blooms(db, gname)
         for idx in list(self._sidx.values()):
-            idx.flush()
             idx.merge()
         return out
 
@@ -179,17 +214,24 @@ class TraceEngine:
                         continue
                     write_trace_bloom(part, t.trace_id_tag)
 
-    def maintain(self, group: Optional[str] = None) -> None:
+    def maintain(
+        self, group: Optional[str] = None, *, flush_sidx: bool = True
+    ) -> None:
         """Periodic companion work the generic lifecycle flusher can't do
         for trace TSDBs: trace-id bloom sidecars on new parts + sidx
-        ordered-index flush/merge (sidx mem entries are memory-only
-        until flushed — a crash before flush loses the ORDERING for
-        otherwise-durable spans).  Wired as the lifecycle extra tick."""
+        ordered-index flush/merge.  Ordering keys always publish BEFORE
+        span parts (_flush_sidx_first here and as the lifecycle
+        pre_flush hook), so no crash window leaves durable spans without
+        their keys.  Wired as the lifecycle extra tick."""
         for gname, db in list(self._tsdbs.items()):
             if group is None or gname == group:
                 self._write_blooms(db, gname)
+        if flush_sidx:
+            # skipped when the caller already runs _flush_sidx_first as
+            # the lifecycle pre_flush hook (one sidx part per tick, not
+            # two)
+            self._flush_sidx_first()
         for idx in list(self._sidx.values()):
-            idx.flush()
             idx.merge()
 
     def finalize_segments(self, group: str) -> int:

@@ -82,8 +82,10 @@ class LifecycleLoops:
         merge_sweep_interval_s: float = 10.0,
         clock: Callable[[], float] = time.time,
         extra_tick: Optional[Callable[[], None]] = None,
+        pre_flush: Optional[Callable[[], None]] = None,
     ):
         self._tsdbs = tsdbs
+        self._pre_flush = pre_flush
         self.flush_interval_s = flush_interval_s
         self.flush_min_rows = flush_min_rows
         self.retention_interval_s = retention_interval_s
@@ -101,6 +103,12 @@ class LifecycleLoops:
         flushed = 0
         self._rw.acquire_read()
         try:
+            if self._pre_flush is not None:
+                # ordering hook: e.g. trace sidx ordered keys must publish
+                # BEFORE span memtables flush (trace._flush_sidx_first).
+                # Inside the read lock: sidx part writes must not
+                # interleave with retention's exclusive segment rmtree.
+                self._pre_flush()
             for db in self._tsdbs():
                 for seg in db.segments:
                     for shard in seg.shards:

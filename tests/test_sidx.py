@@ -146,3 +146,126 @@ def test_trace_order_by_duration_prunes(tmp_path):
         eng.last_sidx_blocks_read,
         total,
     )
+
+
+def _trace_setup(tmp_path, n=500):
+    from banyandb_tpu.api import (
+        Catalog,
+        Group,
+        ResourceOpts,
+        SchemaRegistry,
+        TagSpec,
+        TagType,
+    )
+    from banyandb_tpu.api.schema import Trace
+    from banyandb_tpu.models.trace import SpanValue, TraceEngine
+
+    T0 = 1_700_000_000_000
+    reg = SchemaRegistry(tmp_path)
+    try:
+        reg.get_group("tg")
+    except KeyError:
+        reg.create_group(Group("tg", Catalog.TRACE, ResourceOpts(shard_num=2)))
+        reg.create_trace(
+            Trace(
+                group="tg", name="sw",
+                tags=(TagSpec("trace_id", TagType.STRING),
+                      TagSpec("dur", TagType.INT)),
+                trace_id_tag="trace_id",
+            )
+        )
+    eng = TraceEngine(reg, tmp_path / "data")
+    spans = [
+        SpanValue(ts_millis=T0 + i, tags={"trace_id": f"t{i}", "dur": i}, span=b"s")
+        for i in range(n)
+    ]
+    return reg, eng, spans, T0, n
+
+
+def test_staged_flush_commit_abort_and_orphan_cleanup(tmp_path):
+    """prepare/commit/abort (sidx/interfaces.go:37 PrepareFlushed
+    analog) + crash-orphan removal on reopen."""
+    st = SidxStore(tmp_path / "s")
+    for i in range(10):
+        st.insert(i, f"p{i}".encode())
+    txn = st.prepare_flush()
+    assert (tmp_path / "s" / txn.name).exists()
+    # unpublished: a reader still sees the mem prefix, not the part
+    assert len(st.range_query(0, 100)) == 10
+    txn.commit()
+    assert len(st.range_query(0, 100)) == 10
+    assert txn.name in st._parts
+
+    # abort path removes the staged dir
+    st.insert(99, b"x")
+    txn2 = st.prepare_flush()
+    staged_dir = tmp_path / "s" / txn2.name
+    assert staged_dir.exists()
+    txn2.abort()
+    assert not staged_dir.exists()
+    assert len(st.range_query(0, 100)) == 11  # the element stayed in mem
+
+    # crash between stage and commit: orphan dir survives on disk, and a
+    # REOPEN removes it, returning to the last published snapshot
+    txn3 = st.prepare_flush()
+    orphan = tmp_path / "s" / txn3.name
+    assert orphan.exists()
+    st2 = SidxStore(tmp_path / "s")  # simulated restart (txn3 never ends)
+    assert not orphan.exists()
+    assert len(st2.range_query(0, 100)) == 10  # published part only
+
+
+def test_crash_between_sidx_and_span_flush_no_divergence(tmp_path):
+    """The commit order is sidx-first: simulate a crash after the sidx
+    publish but before the span parts flush.  After reopen, the ordered
+    index holds DANGLING refs (spans lost with the memtable) which
+    query_ordered prunes via verify_live — never an error, and never a
+    durable span missing its ordering key."""
+    from banyandb_tpu.api import TimeRange
+    from banyandb_tpu.models.trace import TraceEngine
+
+    reg, eng, spans, T0, n = _trace_setup(tmp_path)
+    eng.write("tg", "sw", spans, ordered_tags=("dur",))
+
+    # crash simulation: ordered keys commit, span memtable is lost
+    eng._flush_sidx_first()
+    eng2 = TraceEngine(reg, tmp_path / "data")  # reopen
+    ids = eng2.query_ordered(
+        "tg", "sw", "dur", TimeRange(T0, T0 + n + 1), asc=False, limit=5
+    )
+    assert ids == []  # dangling refs pruned, no divergence
+
+    # the same data rewritten + fully flushed works end to end
+    eng2.write("tg", "sw", spans, ordered_tags=("dur",))
+    eng2.flush("tg")
+    eng3 = TraceEngine(reg, tmp_path / "data")
+    ids = eng3.query_ordered(
+        "tg", "sw", "dur", TimeRange(T0, T0 + n + 1), asc=False, limit=3
+    )
+    assert ids == [f"t{n-1}", f"t{n-2}", f"t{n-3}"]
+
+
+def test_span_flush_failure_keeps_keys_durable(tmp_path):
+    """If the span flush RAISES after the sidx commit, the ordering keys
+    are already durable; the spans retry on the next flush tick and the
+    index needs no rebuild."""
+    from banyandb_tpu.api import TimeRange
+
+    reg, eng, spans, T0, n = _trace_setup(tmp_path)
+    eng.write("tg", "sw", spans, ordered_tags=("dur",))
+
+    real_flush_all = {}
+    for gname, db in eng._tsdbs.items():
+        real_flush_all[gname] = db.flush_all
+        db.flush_all = lambda: (_ for _ in ()).throw(OSError("disk full"))
+    with pytest.raises(OSError):
+        eng.flush("tg")
+    for gname, db in eng._tsdbs.items():
+        db.flush_all = real_flush_all[gname]
+
+    # retry succeeds; ordered query is complete
+    eng.flush("tg")
+    ids = eng.query_ordered(
+        "tg", "sw", "dur", TimeRange(T0, T0 + n + 1), asc=False, limit=3
+    )
+    assert ids == [f"t{n-1}", f"t{n-2}", f"t{n-3}"]
