@@ -143,10 +143,19 @@ class SidxStore:
     def _commit_staged(self, name: str, n: int) -> str:
         try:
             with self._lock:
+                # Open + publish the part BEFORE trimming the mem prefix:
+                # if either raises (bad metadata, disk full on publish) the
+                # elements are still mem-resident and the staged dir is
+                # just an orphan for the reopen sweep — nothing is lost.
+                part = Part(self.root / name)
+                self._parts[name] = part
+                try:
+                    self._publish()
+                except BaseException:
+                    del self._parts[name]
+                    raise
                 del self._mem_keys[:n]
                 del self._mem_payloads[:n]
-                self._parts[name] = Part(self.root / name)
-                self._publish()
             return name
         finally:
             self._flush_lock.release()

@@ -134,15 +134,19 @@ def _kahan_tiled_reduce(
 
 
 def _pick_method(nrows: int, num_groups: int) -> str:
-    # One-hot matmul materializes an [N, G+1] f32 operand through the MXU;
-    # worth it while G stays in the low thousands AND the operand stays
-    # under a VMEM-friendly working set.  Past that, TPUs still prefer the
-    # tiled MXU scan (scatter is slow on TPU); other backends scatter.
-    if num_groups <= 4096:
-        if nrows * (num_groups + 1) <= 2**25:
-            return "matmul"
-        if jax.default_backend() == "tpu":
-            return "matmul_tiled"
+    # Measured on a real v5e-1 (2026-07-29, docs/tpu_measurements.md): the
+    # Pallas kernel is best-or-equal at every (N, G) tried — 11.3 Grows/s
+    # at N=2^23 standalone vs 5.8 for one-shot matmul (which also OOMs
+    # once N*(G+1) f32 exceeds HBM) and ~15 Mrows/s for eager scatter /
+    # matmul_tiled, which drown in per-op dispatch.  Inside a fused jit
+    # XLA's scatter reaches HBM bandwidth too, but pallas never loses, so
+    # TPU always takes it (group-tiled: any G compiles).  Off-TPU, pallas
+    # only interprets; one-hot matmul wins small operands, scatter the
+    # rest (measured 35x over matmul_tiled on CPU, BENCH_r02).
+    if jax.default_backend() == "tpu":
+        return "pallas"
+    if num_groups <= 4096 and nrows * (num_groups + 1) <= 2**25:
+        return "matmul"
     return "scatter"
 
 

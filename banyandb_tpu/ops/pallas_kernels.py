@@ -30,6 +30,19 @@ from jax.experimental import pallas as pl
 TILE = 2048
 
 
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# Group-dimension tile: bounds the [GTILE, TILE] one-hot operand
+# (2048x2048 f32 = 16 MiB) plus the [F, GTILE] accumulator blocks in
+# VMEM, so group counts in the tens of thousands compile instead of
+# exhausting VMEM.  16 MiB leaves little headroom beyond a few fields on
+# a 128 MiB-VMEM v5e — verified to compile at G=40k; shrink GTILE before
+# growing anything else here.
+GTILE = 2048
+
+
 def _fused_kernel(
     codes_ref,
     pred_ref,
@@ -40,7 +53,12 @@ def _fused_kernel(
     ccomp_ref,
     scomp_ref,
 ):
-    i = pl.program_id(0)
+    # Grid is (group tiles, row tiles) with the row dimension innermost:
+    # for a fixed group tile j the kernel streams every row tile i,
+    # accumulating into the same output blocks (TPU grids run
+    # sequentially, so read-modify-write across i is sound).
+    j = pl.program_id(0)
+    i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _init():
@@ -57,11 +75,22 @@ def _fused_kernel(
     # predicate arrives as a per-row 0/1 flag; multiply is the AND
     mask = valid * pred.astype(jnp.float32)  # [1, TILE]
 
+    # Mosaic cannot lower 1-D integer indexing (it becomes an unsupported
+    # gather), so the one-hot is built transposed — [GTILE, TILE] with
+    # row r equal to group j*GTILE + r — and contracted along TILE via
+    # dot_general with a transposed RHS, which maps straight onto the MXU.
     g = count_ref.shape[1]
-    groups = jax.lax.broadcasted_iota(jnp.int32, (1, g), 1)
-    onehot = (codes[0, :, None] == groups[0, None, :]).astype(jnp.float32)
-    cnt_p = (mask[0, :] @ onehot)[None, :]  # [1, G]
-    sum_p = (vals * mask) @ onehot  # [F, G] — one contraction, all fields
+    gids = j * g + jax.lax.broadcasted_iota(
+        jnp.int32, (g, codes.shape[1]), 0
+    )
+    onehot_t = (gids == codes).astype(jnp.float32)  # [GTILE, TILE]
+    dn = (((1,), (1,)), ((), ()))
+    cnt_p = jax.lax.dot_general(
+        mask, onehot_t, dn, preferred_element_type=jnp.float32
+    )  # [1, GTILE]
+    sum_p = jax.lax.dot_general(
+        vals * mask, onehot_t, dn, preferred_element_type=jnp.float32
+    )  # [F, GTILE] — one contraction, all fields
 
     # Kahan-compensated add of this tile's partials into the accumulators.
     y = cnt_p - ccomp_ref[:]
@@ -105,16 +134,20 @@ def fused_group_multi(
             interpret=interpret,
         )
         return count, jnp.zeros((0, num_groups), jnp.float32)
-    grid = (n // TILE,)
+    # Pad the group axis to a GTILE multiple; padded groups match no row
+    # code (codes are < num_groups) and are sliced off below.
+    gt = min(GTILE, _round_up(num_groups, 128))
+    gpad = _round_up(num_groups, gt)
+    grid = (gpad // gt, n // TILE)
 
     codes2 = codes.reshape(1, n)
     pred2 = pred_mask.astype(jnp.int32).reshape(1, n)
     valid2 = valid.astype(jnp.float32).reshape(1, n)
 
-    row_spec = pl.BlockSpec((1, TILE), lambda i: (0, i))
-    val_spec = pl.BlockSpec((nf, TILE), lambda i: (0, i))
-    cacc_spec = pl.BlockSpec((1, num_groups), lambda i: (0, 0))
-    sacc_spec = pl.BlockSpec((nf, num_groups), lambda i: (0, 0))
+    row_spec = pl.BlockSpec((1, TILE), lambda j, i: (0, i))
+    val_spec = pl.BlockSpec((nf, TILE), lambda j, i: (0, i))
+    cacc_spec = pl.BlockSpec((1, gt), lambda j, i: (0, j))
+    sacc_spec = pl.BlockSpec((nf, gt), lambda j, i: (0, j))
 
     count, total, ccomp, scomp = pl.pallas_call(
         _fused_kernel,
@@ -122,16 +155,19 @@ def fused_group_multi(
         in_specs=[row_spec, row_spec, val_spec, row_spec],
         out_specs=(cacc_spec, sacc_spec, cacc_spec, sacc_spec),
         out_shape=(
-            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
-            jax.ShapeDtypeStruct((nf, num_groups), jnp.float32),
-            jax.ShapeDtypeStruct((1, num_groups), jnp.float32),
-            jax.ShapeDtypeStruct((nf, num_groups), jnp.float32),
+            jax.ShapeDtypeStruct((1, gpad), jnp.float32),
+            jax.ShapeDtypeStruct((nf, gpad), jnp.float32),
+            jax.ShapeDtypeStruct((1, gpad), jnp.float32),
+            jax.ShapeDtypeStruct((nf, gpad), jnp.float32),
         ),
         interpret=interpret,
     )(codes2, pred2, values, valid2)
     # Fold the residual compensation back in (classic Kahan final step;
     # the compensation holds the negated running error).
-    return (count - ccomp)[0], total - scomp
+    return (
+        (count - ccomp)[0, :num_groups],
+        (total - scomp)[:, :num_groups],
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))

@@ -522,6 +522,10 @@ class StandaloneServer:
         # AND property-lease GC
         self.measure.start_lifecycle(
             extra_tick=self._sweep_properties,
+            # ordering keys must be durable BEFORE the span memtables they
+            # describe flush (sidx-first commit ordering; mirrors the
+            # data-node wiring in cluster/data_node.py)
+            pre_flush=self.trace._flush_sidx_first,
             extra_tsdbs=lambda: (
                 list(self.stream._tsdbs.values())
                 + list(self.trace._tsdbs.values())
@@ -542,9 +546,9 @@ class StandaloneServer:
             except Exception:  # noqa: BLE001 - GC must not kill the loop
                 pass
         try:
-            # trace maintenance: bloom sidecars + sidx flush/merge (the
-            # ordering index is memory-only until flushed)
-            self.trace.maintain()
+            # trace maintenance: bloom sidecars + sidx merge only — the
+            # sidx flush already ran in pre_flush, ahead of span memtables
+            self.trace.maintain(flush_sidx=False)
         except Exception:  # noqa: BLE001
             pass
 
