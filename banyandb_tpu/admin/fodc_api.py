@@ -1,0 +1,279 @@
+"""FODC proxy REST + Prometheus aggregation API.
+
+Analog of /root/reference/fodc/proxy/internal/api/server.go (869 LoC):
+the HTTP face of the proxy — aggregated Prometheus exposition over every
+registered agent's latest metrics, windowed JSON metrics, agent health,
+cluster topology/lifecycle views, crash diagnostics, and pressure-profile
+listing/download driven over the FODCService command stream.  Routes
+mirror the reference's mux (server.go:101-108):
+
+    GET /metrics
+    GET /metrics-windows?start=<unix_s>&end=<unix_s>
+    GET /health
+    GET /cluster/topology
+    GET /cluster/lifecycle
+    GET /diagnostics[?capture=1]
+    GET /pressure-profiles
+    GET /pressure-profiles/<pod_name>/<profile_id>/<type>
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from banyandb_tpu.admin import fodc_wire
+
+
+def _sanitize_filename_part(s: str) -> str:
+    """Strip anything that could inject header syntax or path separators
+    into the Content-Disposition filename (server.go:806 analog)."""
+    return re.sub(r"[^A-Za-z0-9._-]", "_", s)[:128]
+
+
+def _fmt_value(v: float) -> str:
+    return f"{int(v)}" if float(v).is_integer() else repr(float(v))
+
+
+class FodcApiServer:
+    """HTTP server over the proxy state (+ optional bundle proxy)."""
+
+    def __init__(
+        self,
+        state: fodc_wire.FodcProxyState,
+        *,
+        proxy=None,  # admin.fodc.FodcProxy for /diagnostics bundles
+        host: str = "127.0.0.1",
+        port: int = 0,
+        stale_after_s: float = 90.0,
+    ):
+        self.state = state
+        self.proxy = proxy
+        self.stale_after_s = stale_after_s
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str, extra=()):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in extra:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code: int = 200):
+                self._send(
+                    code,
+                    json.dumps(obj, indent=1, default=str).encode(),
+                    "application/json",
+                )
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                q = parse_qs(u.query)
+                try:
+                    route = outer._route(u.path, q)
+                except FileNotFoundError as e:
+                    self._json({"error": str(e)}, 404)
+                    return
+                except Exception as e:  # noqa: BLE001 - surface, don't crash
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                    return
+                kind, payload = route
+                if kind == "prom":
+                    self._send(200, payload.encode(), "text/plain; version=0.0.4")
+                elif kind == "json":
+                    self._json(payload)
+                else:  # download
+                    fname, data = payload
+                    self._send(
+                        200,
+                        data,
+                        "application/octet-stream",
+                        extra=(
+                            (
+                                "Content-Disposition",
+                                f'attachment; filename="{fname}"',
+                            ),
+                        ),
+                    )
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self.addr = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing -------------------------------------------------------------
+    def _route(self, path: str, q: dict):
+        if path == "/metrics":
+            return ("prom", self._prometheus_text())
+        if path == "/metrics-windows":
+            start = float(q.get("start", ["0"])[0])
+            end = float(q.get("end", ["1e18"])[0])
+            return ("json", self._metrics_windows(start, end))
+        if path == "/health":
+            return ("json", self._health())
+        if path == "/cluster/topology":
+            return ("json", self._topology())
+        if path == "/cluster/lifecycle":
+            return ("json", self._lifecycle())
+        if path == "/diagnostics":
+            return ("json", self._diagnostics(capture="capture" in q))
+        if path == "/pressure-profiles":
+            return ("json", self._pressure_profiles())
+        m = re.fullmatch(r"/pressure-profiles/([^/]+)/([^/]+)/([^/]+)", path)
+        if m:
+            return ("download", self._pressure_download(*m.groups()))
+        raise FileNotFoundError(path)
+
+    # -- views ---------------------------------------------------------------
+    def _identity_labels(self, st) -> list[tuple[str, str]]:
+        ident = st.identity
+        out = [("pod", ident.get("pod_name", ""))]
+        if ident.get("node_role"):
+            out.append(("node_role", ident["node_role"]))
+        return out
+
+    def _prometheus_text(self) -> str:
+        """Aggregate every agent's latest cycle into one exposition,
+        grouped into typed families (server.go:293 formatPrometheusText)."""
+        families: dict[str, tuple[str, list[str]]] = {}
+        for st in self.state.all_agents():
+            ident = dict(self._identity_labels(st))
+            for m in st.metrics:
+                lbls = dict(m.labels)
+                lbls.update(ident)
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(lbls.items()) if v != ""
+                )
+                line = f"{m.name}{{{inner}}} {_fmt_value(m.value)}"
+                typ, lines = families.setdefault(m.name, (m.type, []))
+                lines.append(line)
+        out = []
+        for name in sorted(families):
+            typ, lines = families[name]
+            if typ in ("gauge", "counter", "histogram", "summary"):
+                out.append(f"# TYPE {name} {typ}")
+            out.extend(sorted(lines))
+        return "\n".join(out) + "\n"
+
+    def _metrics_windows(self, start_s: float, end_s: float) -> list[dict]:
+        out = []
+        for st in self.state.all_agents():
+            ident = dict(self._identity_labels(st))
+            for ts, cycle in st.metric_history:
+                if not (start_s <= ts <= end_s):
+                    continue
+                out.append(
+                    {
+                        "timestamp": ts,
+                        **ident,
+                        "metrics": [
+                            {
+                                "name": m.name,
+                                "labels": dict(m.labels),
+                                "value": m.value,
+                                "type": m.type,
+                            }
+                            for m in cycle
+                        ],
+                    }
+                )
+        out.sort(key=lambda w: w["timestamp"])
+        return out
+
+    def _health(self) -> dict:
+        import time as _t
+
+        now = _t.time()
+        agents = [
+            {
+                "agent_id": st.agent_id,
+                **dict(self._identity_labels(st)),
+                "last_seen_s_ago": round(now - st.last_seen, 1),
+                "healthy": (now - st.last_seen) < self.stale_after_s,
+            }
+            for st in self.state.all_agents()
+        ]
+        return {
+            "status": "ok" if all(a["healthy"] for a in agents) else "degraded",
+            "agents": agents,
+        }
+
+    def _topology(self) -> dict:
+        nodes, calls, seen = [], [], set()
+        for st in self.state.all_agents():
+            if not st.topology:
+                continue
+            for n in st.topology.get("nodes", []):
+                if n["name"] not in seen:
+                    seen.add(n["name"])
+                    nodes.append(n)
+            calls.extend(st.topology.get("calls", []))
+        return {"nodes": nodes, "calls": calls}
+
+    def _lifecycle(self) -> list[dict]:
+        return [st.lifecycle for st in self.state.all_agents() if st.lifecycle]
+
+    def _diagnostics(self, capture: bool) -> dict:
+        out = {
+            "crashes": {
+                st.identity.get("pod_name", st.agent_id): st.crashes
+                for st in self.state.all_agents()
+                if st.crashes
+            }
+        }
+        if self.proxy is not None:
+            if capture:
+                out["captured"] = self.proxy.capture(reason="api").name
+            out["bundles"] = self.proxy.list_bundles()
+        return out
+
+    def _pressure_profiles(self) -> list[dict]:
+        out = []
+        for st in self.state.all_agents():
+            if not st.pp_connected:
+                continue
+            pod = st.identity.get("pod_name", st.agent_id)
+            try:
+                for rec in fodc_wire.list_pressure_profiles(st):
+                    rec["pod_name"] = pod
+                    rec["node_role"] = st.identity.get("node_role", "")
+                    out.append(rec)
+            except Exception:  # noqa: BLE001 - one dead agent must not 500 the list
+                continue
+        # top-N by RSS at trigger — the reference's sort key
+        out.sort(key=lambda r: -int(r.get("rss_bytes", 0)))
+        return out
+
+    def _pressure_download(self, pod_name: str, profile_id: str, kind: str):
+        st = self.state.by_pod(pod_name)
+        if st is None:
+            raise FileNotFoundError(f"no agent for pod {pod_name}")
+        data = fodc_wire.fetch_pressure_profile(st, profile_id, kind)
+        fname = "-".join(
+            _sanitize_filename_part(p) for p in (pod_name, profile_id, kind)
+        )
+        return (f"{fname}.txt", data)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="fodc-api"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,618 @@
+"""FODCService on the wire: proxy-side servicer + agent-side client.
+
+Implements banyandb.fodc.v1.FODCService (the reference's agent<->proxy
+plane, /root/reference/api/proto/banyandb/fodc/v1/rpc.proto:29 served by
+fodc/proxy/internal/grpc/service.go) on the generated protos: six bidi
+streams, all agent-initiated.  Agents dial the proxy, register with an
+identity, then push metrics/topology/lifecycle/crash data; the
+pressure-profiles stream is proxy-driven (list/fetch commands down,
+records/chunks up, correlated by request_id).
+
+Correlation between streams of one agent uses gRPC metadata
+('fodc-agent-id', assigned at registration) — equivalent to the
+reference's per-connection AgentIdentity registry.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Optional
+
+from banyandb_tpu.admin.fodc_agent import RawMetric
+
+SERVICE = "banyandb.fodc.v1.FODCService"
+AGENT_ID_MD = "fodc-agent-id"
+HEARTBEAT_S = 30
+CHUNK_BYTES = 1 << 20
+
+
+def _now_ts():
+    from google.protobuf import timestamp_pb2
+
+    ts = timestamp_pb2.Timestamp()
+    ts.GetCurrentTime()
+    return ts
+
+
+class AgentState:
+    """Everything the proxy knows about one registered agent."""
+
+    def __init__(self, agent_id: str, identity: dict):
+        self.agent_id = agent_id
+        self.identity = identity  # node_role, labels, pod_name, containers
+        self.last_seen = time.time()
+        self.metrics: list[RawMetric] = []
+        self.metric_history: list[tuple[float, list[RawMetric]]] = []
+        self.topology: Optional[dict] = None
+        self.lifecycle: Optional[dict] = None
+        self.crashes: list[dict] = []
+        # pressure-profile command plane: proxy pushes commands, the
+        # stream handler routes replies to the issuing waiter by request_id
+        self.pp_commands: "queue.Queue" = queue.Queue()
+        self.pp_waiters: dict[str, "queue.Queue"] = {}
+        self.pp_connected = False
+
+
+class FodcProxyState:
+    """Shared registry behind the servicer and the REST API."""
+
+    HISTORY_CYCLES = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.agents: dict[str, AgentState] = {}
+
+    def register(self, identity: dict) -> AgentState:
+        agent_id = uuid.uuid4().hex[:12]
+        st = AgentState(agent_id, identity)
+        with self._lock:
+            self.agents[agent_id] = st
+        return st
+
+    def get(self, agent_id: str) -> Optional[AgentState]:
+        with self._lock:
+            return self.agents.get(agent_id)
+
+    def by_pod(self, pod_name: str) -> Optional[AgentState]:
+        with self._lock:
+            for st in self.agents.values():
+                if st.identity.get("pod_name") == pod_name:
+                    return st
+        return None
+
+    def all_agents(self) -> list[AgentState]:
+        with self._lock:
+            return list(self.agents.values())
+
+    def record_metrics(self, st: AgentState, metrics: list[RawMetric]) -> None:
+        now = time.time()
+        with self._lock:
+            st.metrics = metrics
+            st.last_seen = now
+            st.metric_history.append((now, metrics))
+            if len(st.metric_history) > self.HISTORY_CYCLES:
+                st.metric_history.pop(0)
+
+
+def _agent_from_context(state: FodcProxyState, context) -> Optional[AgentState]:
+    for k, v in context.invocation_metadata():
+        if k == AGENT_ID_MD:
+            return state.get(v)
+    return None
+
+
+def _metric_to_raw(m) -> RawMetric:
+    from banyandb_tpu.api import pb
+
+    f = pb.fodc_rpc_pb2
+    type_name = {
+        f.METRIC_TYPE_GAUGE: "gauge",
+        f.METRIC_TYPE_COUNTER: "counter",
+        f.METRIC_TYPE_HISTOGRAM: "histogram",
+        f.METRIC_TYPE_SUMMARY: "summary",
+    }.get(m.type, "untyped")
+    return RawMetric(
+        name=m.name,
+        labels=tuple(sorted(m.labels.items())),
+        value=m.value,
+        type=type_name,
+        ts_millis=m.timestamp.ToMilliseconds() if m.HasField("timestamp") else 0,
+    )
+
+
+def _raw_to_metric(m: RawMetric):
+    from banyandb_tpu.api import pb
+
+    f = pb.fodc_rpc_pb2
+    type_enum = {
+        "gauge": f.METRIC_TYPE_GAUGE,
+        "counter": f.METRIC_TYPE_COUNTER,
+        "histogram": f.METRIC_TYPE_HISTOGRAM,
+        "summary": f.METRIC_TYPE_SUMMARY,
+    }.get(m.type, f.METRIC_TYPE_UNTYPED)
+    out = f.Metric(name=m.name, value=m.value, type=type_enum)
+    for k, v in m.labels:
+        out.labels[str(k)] = str(v)
+    if m.ts_millis:
+        out.timestamp.FromMilliseconds(m.ts_millis)
+    return out
+
+
+def generic_handler(state: FodcProxyState):
+    """Build the FODCService generic handler for a grpc server
+    (co-hosted on the proxy's GrpcBusServer via extra_handlers)."""
+    import grpc
+
+    from banyandb_tpu.api import pb
+
+    f = pb.fodc_rpc_pb2
+
+    def register_agent(req_iter, context):
+        first = next(req_iter, None)
+        if first is None:
+            return
+        st = state.register(
+            {
+                "node_role": first.node_role,
+                "labels": dict(first.labels),
+                "pod_name": first.pod_name,
+                "container_names": list(first.container_names),
+            }
+        )
+        yield f.RegisterAgentResponse(
+            success=True,
+            message="registered",
+            heartbeat_interval_seconds=HEARTBEAT_S,
+            agent_id=st.agent_id,
+        )
+        for _hb in req_iter:  # subsequent requests are heartbeats
+            st.last_seen = time.time()
+            yield f.RegisterAgentResponse(success=True, agent_id=st.agent_id)
+
+    def stream_metrics(req_iter, context):
+        st = _agent_from_context(state, context)
+        if st is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "agent not registered")
+        for req in req_iter:
+            state.record_metrics(st, [_metric_to_raw(m) for m in req.metrics])
+        return
+        yield  # pragma: no cover - makes this a generator
+
+    def stream_topology(req_iter, context):
+        st = _agent_from_context(state, context)
+        if st is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "agent not registered")
+        # prompt once, then consume pushes
+        yield f.StreamClusterTopologyResponse(request_topology=True)
+        for req in req_iter:
+            st.topology = {
+                "nodes": [
+                    {"name": n.metadata.name, "roles": list(n.roles)}
+                    for n in req.topology.nodes
+                ],
+                "calls": [
+                    {"id": c.id, "source": c.source, "target": c.target}
+                    for c in req.topology.calls
+                ],
+            }
+            st.last_seen = time.time()
+
+    def stream_lifecycle(req_iter, context):
+        st = _agent_from_context(state, context)
+        if st is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "agent not registered")
+        for req in req_iter:
+            st.lifecycle = {
+                "pod_name": req.pod_name,
+                "groups": [
+                    {
+                        "name": g.name,
+                        "catalog": g.catalog,
+                        "errors": list(g.errors),
+                        "data_info_count": len(g.data_info),
+                    }
+                    for g in req.lifecycle_data.groups
+                ],
+                "reports": [
+                    {"filename": r.filename}
+                    for r in req.lifecycle_data.reports
+                ],
+            }
+            st.last_seen = time.time()
+        return
+        yield  # pragma: no cover
+
+    def stream_crash(req_iter, context):
+        st = _agent_from_context(state, context)
+        if st is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "agent not registered")
+        yield f.StreamCrashDiagnosticsResponse(request_diagnostics=True)
+        for req in req_iter:
+            rec = {
+                "artifact_dir": req.artifact_dir,
+                "files": list(req.files),
+                "component": req.panic_record.component,
+                "panic_value": req.panic_record.panic_value,
+                "recovered": req.panic_record.recovered,
+            }
+            st.crashes.append(rec)
+            del st.crashes[:-32]  # bounded
+            st.last_seen = time.time()
+
+    def stream_pressure(req_iter, context):
+        st = _agent_from_context(state, context)
+        if st is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, "agent not registered")
+        st.pp_connected = True
+
+        def reader():
+            try:
+                for req in req_iter:
+                    which = req.WhichOneof("payload")
+                    if which == "record":
+                        rec = req.record
+                        payload = {
+                            "profile_id": rec.profile_id,
+                            "rss_bytes": rec.rss_bytes,
+                            "cgroup_limit_bytes": rec.cgroup_limit_bytes,
+                            "trigger_percent": rec.trigger_percent,
+                            "threshold_bytes": rec.threshold_bytes,
+                            "profiles": [
+                                {
+                                    "type": p.type,
+                                    "filename": p.filename,
+                                    "filepath": p.filepath,
+                                    "format": p.format,
+                                    "size_bytes": p.size_bytes,
+                                }
+                                for p in rec.profiles
+                            ],
+                        }
+                        for w in list(st.pp_waiters.values()):
+                            w.put(("record", payload))
+                    elif which == "list_complete":
+                        w = st.pp_waiters.get(req.list_complete.request_id)
+                        if w is not None:
+                            w.put(("done", None))
+                    elif which == "chunk":
+                        ch = req.chunk
+                        w = st.pp_waiters.get(ch.request_id)
+                        if w is not None:
+                            if ch.error:
+                                w.put(("error", ch.error))
+                            else:
+                                w.put(("chunk", ch.data))
+                                if ch.last:
+                                    w.put(("done", None))
+            except Exception:  # noqa: BLE001 - stream cancel at teardown
+                pass
+            finally:
+                st.pp_connected = False
+                st.pp_commands.put(None)  # unblock the writer
+
+        t = threading.Thread(target=reader, daemon=True, name="fodc-pp-reader")
+        t.start()
+        while True:
+            cmd = st.pp_commands.get()
+            if cmd is None:
+                return
+            yield cmd
+
+    def h(fn, req_cls):
+        return grpc.stream_stream_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+
+    return grpc.method_handlers_generic_handler(
+        SERVICE,
+        {
+            "RegisterAgent": h(register_agent, f.RegisterAgentRequest),
+            "StreamMetrics": h(stream_metrics, f.StreamMetricsRequest),
+            "StreamClusterTopology": h(stream_topology, f.StreamClusterTopologyRequest),
+            "StreamLifecycle": h(stream_lifecycle, f.StreamLifecycleRequest),
+            "StreamCrashDiagnostics": h(stream_crash, f.StreamCrashDiagnosticsRequest),
+            "StreamPressureProfiles": h(stream_pressure, f.StreamPressureProfilesRequest),
+        },
+    )
+
+
+# -- proxy-driven pressure-profile commands (used by the REST API) ----------
+
+
+def list_pressure_profiles(st: AgentState, timeout: float = 10.0) -> list[dict]:
+    """Ask one agent for all capture-event metadata (ListProfiles)."""
+    from banyandb_tpu.api import pb
+
+    f = pb.fodc_rpc_pb2
+    if not st.pp_connected:
+        raise ConnectionError(f"agent {st.agent_id} pressure stream not connected")
+    rid = uuid.uuid4().hex
+    w: "queue.Queue" = queue.Queue()
+    st.pp_waiters[rid] = w
+    try:
+        st.pp_commands.put(
+            f.StreamPressureProfilesResponse(
+                list_profiles=f.ListProfiles(request_id=rid)
+            )
+        )
+        records = []
+        deadline = time.monotonic() + timeout
+        while True:
+            kind, payload = w.get(timeout=max(0.0, deadline - time.monotonic()))
+            if kind == "done":
+                return records
+            if kind == "record":
+                records.append(payload)
+    finally:
+        st.pp_waiters.pop(rid, None)
+
+
+def fetch_pressure_profile(
+    st: AgentState, profile_id: str, kind: str, filepath: str = "", timeout: float = 30.0
+) -> bytes:
+    """Download one profile's bytes from an agent (FetchPressureProfile)."""
+    from banyandb_tpu.api import pb
+
+    f = pb.fodc_rpc_pb2
+    if not st.pp_connected:
+        raise ConnectionError(f"agent {st.agent_id} pressure stream not connected")
+    rid = uuid.uuid4().hex
+    w: "queue.Queue" = queue.Queue()
+    st.pp_waiters[rid] = w
+    try:
+        st.pp_commands.put(
+            f.StreamPressureProfilesResponse(
+                fetch_profile=f.FetchPressureProfile(
+                    request_id=rid,
+                    profile_id=profile_id,
+                    type=kind,
+                    filepath=filepath,
+                )
+            )
+        )
+        buf = bytearray()
+        deadline = time.monotonic() + timeout
+        while True:
+            k, payload = w.get(timeout=max(0.0, deadline - time.monotonic()))
+            if k == "done":
+                return bytes(buf)
+            if k == "chunk":
+                buf.extend(payload)
+            elif k == "error":
+                raise FileNotFoundError(payload)
+    finally:
+        st.pp_waiters.pop(rid, None)
+
+
+# -- agent side --------------------------------------------------------------
+
+
+class FodcAgentClient:
+    """Per-node client: registers with the proxy and keeps the push
+    streams alive (fodc agent's proxy client analog).
+
+    recorder: FlightRecorder to stream metric cycles from.
+    profiler: optional PressureProfiler answering list/fetch commands.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        *,
+        node_role: str,
+        pod_name: str,
+        labels: Optional[dict] = None,
+        recorder=None,
+        profiler=None,
+        push_interval_s: float = 5.0,
+    ):
+        import grpc
+
+        self.channel = grpc.insecure_channel(addr)
+        self.node_role = node_role
+        self.pod_name = pod_name
+        self.labels = dict(labels or {})
+        self.recorder = recorder
+        self.profiler = profiler
+        self.push_interval_s = push_interval_s
+        self.agent_id: Optional[str] = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def _method(self, name: str, md: bool = True):
+        from banyandb_tpu.api import pb
+
+        f = pb.fodc_rpc_pb2
+        resp_cls = {
+            "RegisterAgent": f.RegisterAgentResponse,
+            "StreamMetrics": f.StreamMetricsResponse,
+            "StreamPressureProfiles": f.StreamPressureProfilesResponse,
+        }[name]
+        kw = {}
+        if md and self.agent_id:
+            kw["metadata"] = ((AGENT_ID_MD, self.agent_id),)
+        mc = self.channel.stream_stream(
+            f"/{SERVICE}/{name}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return mc, kw
+
+    def register(self, timeout: float = 10.0) -> str:
+        from banyandb_tpu.api import pb
+
+        f = pb.fodc_rpc_pb2
+
+        def reqs():
+            yield f.RegisterAgentRequest(
+                node_role=self.node_role,
+                pod_name=self.pod_name,
+                labels=self.labels,
+            )
+            # keep the stream open for heartbeats until stopped
+            while not self._stop.wait(HEARTBEAT_S):
+                yield f.RegisterAgentRequest(node_role=self.node_role)
+
+        mc, kw = self._method("RegisterAgent", md=False)
+        resp_iter = mc(reqs(), **kw)
+        first = next(iter(resp_iter))
+        if not first.success:
+            raise ConnectionError(f"registration rejected: {first.message}")
+        self.agent_id = first.agent_id
+
+        def drain():
+            try:
+                for _ in resp_iter:
+                    pass
+            except Exception:  # noqa: BLE001 - stream teardown
+                pass
+
+        t = threading.Thread(target=drain, daemon=True, name="fodc-agent-reg")
+        t.start()
+        self._threads.append(t)
+        return self.agent_id
+
+    def start_metrics_push(self) -> None:
+        from banyandb_tpu.api import pb
+
+        f = pb.fodc_rpc_pb2
+
+        def reqs():
+            while not self._stop.wait(self.push_interval_s):
+                cycle = self.recorder.latest() if self.recorder else []
+                req = f.StreamMetricsRequest(
+                    metrics=[_raw_to_metric(m) for m in cycle]
+                )
+                req.timestamp.GetCurrentTime()
+                yield req
+
+        def run():
+            mc, kw = self._method("StreamMetrics")
+            try:
+                for _ in mc(reqs(), **kw):
+                    pass
+            except Exception:  # noqa: BLE001 - push loop dies with the channel
+                pass
+
+        t = threading.Thread(target=run, daemon=True, name="fodc-agent-metrics")
+        t.start()
+        self._threads.append(t)
+
+    def push_metrics_once(self) -> None:
+        """Synchronous single push (tests + low-rate deployments)."""
+        from banyandb_tpu.api import pb
+
+        f = pb.fodc_rpc_pb2
+        cycle = self.recorder.latest() if self.recorder else []
+        req = f.StreamMetricsRequest(metrics=[_raw_to_metric(m) for m in cycle])
+        req.timestamp.GetCurrentTime()
+        mc, kw = self._method("StreamMetrics")
+        for _ in mc(iter([req]), **kw):
+            pass
+
+    def start_pressure_serving(self) -> None:
+        """Answer the proxy's list/fetch commands from the local profiler."""
+        from banyandb_tpu.api import pb
+
+        f = pb.fodc_rpc_pb2
+        outq: "queue.Queue" = queue.Queue()
+
+        def reqs():
+            while True:
+                item = outq.get()
+                if item is None:
+                    return
+                yield item
+
+        def serve():
+            mc, kw = self._method("StreamPressureProfiles")
+            try:
+                for cmd in mc(reqs(), **kw):
+                    which = cmd.WhichOneof("command")
+                    if which == "list_profiles":
+                        rid = cmd.list_profiles.request_id
+                        for rec in (
+                            self.profiler.list_records() if self.profiler else []
+                        ):
+                            msg = f.StreamPressureProfilesRequest(
+                                record=f.PressureProfileRecord(
+                                    profile_id=rec["profile_id"],
+                                    rss_bytes=int(rec.get("rss_bytes", 0)),
+                                    cgroup_limit_bytes=int(
+                                        rec.get("cgroup_limit_bytes", 0)
+                                    ),
+                                    trigger_percent=int(
+                                        rec.get("trigger_percent", 0)
+                                    ),
+                                    threshold_bytes=int(
+                                        rec.get("threshold_bytes", 0)
+                                    ),
+                                    profiles=[
+                                        f.PressureProfileInfo(
+                                            type=p["type"],
+                                            filename=p["filename"],
+                                            filepath=p["filepath"],
+                                            format=p["format"],
+                                            size_bytes=int(p["size_bytes"]),
+                                        )
+                                        for p in rec.get("profiles", [])
+                                    ],
+                                )
+                            )
+                            outq.put(msg)
+                        outq.put(
+                            f.StreamPressureProfilesRequest(
+                                list_complete=f.ListComplete(request_id=rid)
+                            )
+                        )
+                    elif which == "fetch_profile":
+                        fp = cmd.fetch_profile
+                        try:
+                            data = self.profiler.read_profile(
+                                fp.profile_id, fp.type
+                            )
+                        except Exception as e:  # noqa: BLE001 - report over the wire
+                            outq.put(
+                                f.StreamPressureProfilesRequest(
+                                    chunk=f.PressureProfileChunk(
+                                        request_id=fp.request_id,
+                                        profile_id=fp.profile_id,
+                                        type=fp.type,
+                                        error=f"{type(e).__name__}: {e}",
+                                    )
+                                )
+                            )
+                            continue
+                        for off in range(0, max(len(data), 1), CHUNK_BYTES):
+                            part = data[off : off + CHUNK_BYTES]
+                            outq.put(
+                                f.StreamPressureProfilesRequest(
+                                    chunk=f.PressureProfileChunk(
+                                        request_id=fp.request_id,
+                                        profile_id=fp.profile_id,
+                                        type=fp.type,
+                                        data=part,
+                                        last=off + CHUNK_BYTES >= len(data),
+                                    )
+                                )
+                            )
+            except Exception:  # noqa: BLE001 - channel teardown ends serving
+                pass
+            finally:
+                outq.put(None)
+
+        t = threading.Thread(target=serve, daemon=True, name="fodc-agent-pp")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.channel.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -43,6 +43,7 @@ SUBTREES = [
     "banyandb/bydbql/v1",
     "banyandb/cluster/v1",
     "banyandb/schema/v1",
+    "banyandb/fodc/v1",
 ]
 
 _DROP_IMPORTS = (

@@ -9,14 +9,15 @@ of dictionary sizes.  Aggregation is then a dense segment reduction:
 - ``scatter``: jax.ops.segment_sum/min/max (XLA scatter).
 - ``matmul``: one-hot(keys) @ values on the MXU in one shot — for modest
   group counts (<= ~4096) and row counts that fit a single operand.
-- ``matmul_tiled``: lax.scan over row tiles of MXU one-hot contractions —
-  the TPU path for large N where one-shot matmul won't fit and scatter
-  underuses the hardware.
+- ``matmul_tiled``: lax.scan over row tiles of MXU one-hot contractions.
+  Kept as an oracle/fallback; measured slower than pallas on real TPU
+  (docs/tpu_measurements.md) so ``auto`` never picks it.
 - ``pallas``: the hand-tiled Pallas kernel (ops.pallas_kernels) for
   count/sums; min/max still ride XLA scatter.
 
 All produce identical results; ``method="auto"`` picks per shape and
-backend (TPU prefers the MXU paths).
+backend from the measured crossovers (TPU: pallas for bounded group
+counts, else scatter; off-TPU: matmul for small operands, else scatter).
 
 Precision contract (tested by tests/test_precision.py): per-group sums
 accumulate in f32 *within* a bounded row tile (<= 65536 rows for scatter,
@@ -143,7 +144,10 @@ def _pick_method(nrows: int, num_groups: int) -> str:
     # TPU always takes it (group-tiled: any G compiles).  Off-TPU, pallas
     # only interprets; one-hot matmul wins small operands, scatter the
     # rest (measured 35x over matmul_tiled on CPU, BENCH_r02).
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu" and num_groups <= 4 * 2048:
+        # bounded at 4 group tiles (GTILE=2048): each extra tile
+        # re-streams the whole input from HBM, so huge-G workloads fall
+        # back to one-pass scatter (roofline-bound inside a fused jit)
         return "pallas"
     if num_groups <= 4096 and nrows * (num_groups + 1) <= 2**25:
         return "matmul"

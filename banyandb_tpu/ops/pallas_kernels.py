@@ -2,12 +2,15 @@
 
 The XLA path (ops.group_reduce) already fuses mask+reduce well; these
 hand-written kernels exist for the cases where explicit control of VMEM
-tiling wins: one pass over HBM-resident row tiles computing the
-filtered per-group sums/count for ALL fields at once without
-materializing the one-hot operand in HBM.  Grid = row tiles; the
-accumulators live in the output blocks (revisited by every grid step —
-TPU grids execute sequentially, so read-modify-write accumulation
-across steps is sound).
+tiling wins: streaming HBM-resident row tiles through MXU one-hot
+contractions computing the filtered per-group sums/count for ALL fields
+at once without materializing the one-hot operand in HBM.  Grid =
+(group tiles, row tiles), rows innermost: for each group tile the full
+row stream is revisited (so G > GTILE costs one extra HBM pass per
+additional group tile — the picker bounds this), and the accumulators
+live in output blocks indexed by the group tile only (revisited by
+every row step — TPU grids execute sequentially, so read-modify-write
+accumulation across steps is sound).
 
 Precision contract (shared with ops.group_reduce): each row tile's
 partial is an f32 MXU contraction over TILE=2048 rows; tile partials are
@@ -123,6 +126,14 @@ def fused_group_multi(
     n = codes.shape[0]
     assert n % TILE == 0, f"N={n} must be a multiple of {TILE}"
     nf = values.shape[0]
+    if n == 0:
+        # a zero-size grid dimension never invokes the kernel, so the
+        # @pl.when init would never run and the outputs would be
+        # whatever the allocator held — return real zeros instead
+        return (
+            jnp.zeros(num_groups, jnp.float32),
+            jnp.zeros((nf, num_groups), jnp.float32),
+        )
     if nf == 0:
         # zero-dim blocks don't lower; run a dummy field and drop it
         count, _ = fused_group_multi(

@@ -153,11 +153,35 @@ class StandaloneServer:
             from banyandb_tpu.admin.profiling import ProfilingServer
 
             self.pprof = ProfilingServer(port=pprof_port)
+        # FODC agent plane (fodc/agent analog): watchdog feeds a flight
+        # recorder from the node's meter + process stats; the pressure
+        # profiler rides it, capturing artifacts when RSS crosses the
+        # cgroup-derived threshold.  A FodcAgentClient (admin/fodc_wire)
+        # can stream both to a proxy; on-demand pprof capture is served
+        # over the bus (PPROF_TOPIC).
+        from banyandb_tpu.admin import fodc_agent
+
+        self.flight_recorder = fodc_agent.FlightRecorder()
+        self.watchdog = fodc_agent.Watchdog(
+            self.flight_recorder,
+            [fodc_agent.meter_source(self.meter), fodc_agent.process_source],
+            node_role="standalone",
+        )
+        self.pressure_profiler = None
+        if self.protector.limit:
+            self.pressure_profiler = fodc_agent.PressureProfiler(
+                self.root / "pressure-profiles",
+                limit_bytes=self.protector.limit,
+            )
+            self.watchdog.add_post_poll_hook(self.pressure_profiler.hook)
 
     # -- wiring -------------------------------------------------------------
     def _register(self) -> None:
         b = self.bus
         b.subscribe(Topic.HEALTH, lambda env: {"status": "ok", "role": "standalone"})
+        from banyandb_tpu.admin import fodc_agent as _fa
+
+        b.subscribe(_fa.PPROF_TOPIC, _fa.pprof_capture_handler)
         b.subscribe(Topic.MEASURE_WRITE, self._measure_write)
         b.subscribe(Topic.MEASURE_QUERY_RAW, self._measure_query)
         b.subscribe(Topic.STREAM_WRITE, self._stream_write)
@@ -532,6 +556,7 @@ class StandaloneServer:
             ),
         )
         self.grpc.start()
+        self.watchdog.start()
         if self.wire is not None:
             self.wire.start()
         if self.http is not None:
@@ -554,6 +579,7 @@ class StandaloneServer:
 
     def stop(self) -> None:
         self.measure.stop_lifecycle()
+        self.watchdog.stop()
         self.grpc.stop()
         if self.wire is not None:
             self.wire.stop()

@@ -1,0 +1,270 @@
+"""FODC depth: watchdog, flight recorder, pressure profiler, wire, REST.
+
+Covers the round-3 FODC build-out (reference: fodc/agent/internal/
+watchdog/watchdog.go, fodc/agent/internal/pressureprofiler,
+fodc/internal/pprofcapture, fodc/proxy/internal/api/server.go:869,
+api/proto/banyandb/fodc/v1/rpc.proto:29).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from banyandb_tpu.admin.fodc_agent import (
+    GAUGE,
+    PPROF_TOPIC,
+    FlightRecorder,
+    PressureProfiler,
+    RawMetric,
+    Watchdog,
+    meter_source,
+    pprof_capture_handler,
+    process_source,
+)
+
+
+# -- agent core --------------------------------------------------------------
+
+
+def test_flight_recorder_window_and_eviction():
+    fr = FlightRecorder(window_s=1e9, max_cycles=3)
+    for i in range(5):
+        fr.update([RawMetric("m", (), float(i))])
+    assert len(fr.window(0, time.time() + 1)) == 3  # max_cycles enforced
+    assert fr.latest()[0].value == 4.0
+
+
+def test_watchdog_poll_stamps_identity_and_retries():
+    fr = FlightRecorder()
+    fails = {"n": 0}
+
+    def flaky():
+        fails["n"] += 1
+        if fails["n"] < 3:
+            raise RuntimeError("scrape failed")
+        return [RawMetric("up", (), 1.0, GAUGE)]
+
+    wd = Watchdog(fr, [flaky], node_role="data")
+    wd.INITIAL_BACKOFF_S = 0.001  # keep the test fast
+    cycle = wd.poll_once()
+    assert fails["n"] == 3  # two retries before success
+    assert ("node_role", "data") in cycle[0].labels
+    assert fr.latest() == cycle
+
+
+def test_watchdog_identity_sticks_after_regression():
+    fr = FlightRecorder()
+    wd = Watchdog(fr, [lambda: [RawMetric("x", (), 1.0)]], node_role="")
+    state = {"role": "liaison"}
+    wd.set_node_info_provider(lambda: (state["role"], {"zone": "a"}))
+    c1 = wd.poll_once()
+    assert ("node_role", "liaison") in c1[0].labels
+    state["role"] = "unspecified"  # provider regresses
+    c2 = wd.poll_once()
+    # sticky: no ghost series under the unresolved identity
+    assert ("node_role", "liaison") in c2[0].labels
+
+
+def test_watchdog_defers_while_unresolved():
+    fr = FlightRecorder()
+    wd = Watchdog(
+        fr, [lambda: [RawMetric("x", (), 1.0)]], node_role="", resolve_grace_s=60
+    )
+    wd.set_node_info_provider(lambda: ("", {}))
+    assert wd.poll_once() == []  # deferred, not recorded
+    assert fr.latest() == []
+    wd._start_time -= 120  # grace period elapses
+    assert wd.poll_once()  # recorded anyway (never-resolving node)
+
+
+def test_meter_and_process_sources():
+    from banyandb_tpu.admin.metrics import Meter
+
+    m = Meter("bydb")
+    m.counter_add("writes", 3, {"group": "g"})
+    m.gauge_set("parts", 7)
+    m.observe("lat", 0.5)
+    names = {s.name for s in meter_source(m)()}
+    assert {"bydb_writes_total", "bydb_parts", "bydb_lat_count", "bydb_lat_sum"} <= names
+    assert {s.name for s in process_source()} == {
+        "process_resident_memory_bytes",
+        "process_threads",
+    }
+
+
+def test_pressure_profiler_capture_and_validation(tmp_path):
+    pp = PressureProfiler(
+        tmp_path, limit_bytes=1000, trigger_percent=75, min_interval_s=0.0, max_events=2
+    )
+    assert pp.maybe_capture(700) is None  # under threshold (750)
+    ev = pp.maybe_capture(800)
+    assert ev is not None and (ev / "record.json").exists()
+    rec = pp.list_records()[0]
+    assert rec["rss_bytes"] == 800 and rec["threshold_bytes"] == 750
+    assert {p["type"] for p in rec["profiles"]} == {"threads", "heap", "runtime"}
+    assert b"thread" in pp.read_profile(rec["profile_id"], "threads")
+    with pytest.raises(PermissionError):
+        pp.read_profile("../..", "threads")
+    with pytest.raises(FileNotFoundError):
+        pp.read_profile(rec["profile_id"], "nope")
+    # retention: 2 more captures evict the oldest
+    pp.maybe_capture(900)
+    pp.maybe_capture(950)
+    assert len(pp.list_records()) == 2
+
+
+def test_capture_on_pressure_fires_from_watchdog(tmp_path):
+    """The VERDICT contract: capture-on-pressure fires in a test."""
+    pp = PressureProfiler(
+        tmp_path, limit_bytes=100, trigger_percent=1, min_interval_s=0.0
+    )  # threshold 1 byte -> any real RSS trips it
+    fr = FlightRecorder()
+    wd = Watchdog(fr, [process_source], node_role="data")
+    wd.add_post_poll_hook(pp.hook)
+    wd.poll_once()
+    assert pp.captured == 1 and len(pp.list_records()) == 1
+
+
+def test_pprof_capture_over_the_bus():
+    from banyandb_tpu.cluster.bus import LocalBus
+    from banyandb_tpu.cluster.rpc import LocalTransport
+
+    bus = LocalBus()
+    bus.subscribe(PPROF_TOPIC, pprof_capture_handler)
+    transport = LocalTransport()
+    addr = transport.register("n1", bus)
+    reply = transport.call(
+        addr, PPROF_TOPIC, {"kinds": ["threads", "runtime", "cpu"], "seconds": 0.05}
+    )
+    assert "samples over" in reply["profiles"]["cpu"]
+    assert "rss_bytes" in reply["profiles"]["runtime"]
+    assert "thread" in reply["profiles"]["threads"]
+
+
+def test_standalone_server_fodc_plane(tmp_path):
+    """The server boots with a live watchdog + bus pprof capture."""
+    from banyandb_tpu.server import StandaloneServer
+
+    srv = StandaloneServer(tmp_path / "srv", port=0)
+    try:
+        srv.start()
+        srv.watchdog.poll_once()  # deterministic cycle (loop runs too)
+        names = {m.name for m in srv.flight_recorder.latest()}
+        assert "process_resident_memory_bytes" in names
+        reply = srv.bus.handle(PPROF_TOPIC, {"kinds": ["runtime"]})
+        assert "rss_bytes" in reply["profiles"]["runtime"]
+    finally:
+        srv.stop()
+
+
+# -- wire + REST -------------------------------------------------------------
+
+
+@pytest.fixture
+def fodc_stack(tmp_path):
+    """Proxy grpc server (FODCService) + one registered agent + REST API."""
+    import grpc
+    from concurrent import futures as _f
+
+    from banyandb_tpu.admin import fodc_wire
+    from banyandb_tpu.admin.fodc_api import FodcApiServer
+
+    state = fodc_wire.FodcProxyState()
+    server = grpc.server(_f.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((fodc_wire.generic_handler(state),))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+
+    pp = PressureProfiler(
+        tmp_path / "pp", limit_bytes=1, trigger_percent=1, min_interval_s=0.0
+    )
+    pp.capture(rss_bytes=123456)
+    fr = FlightRecorder()
+    fr.update(
+        [
+            RawMetric("bydb_writes_total", (("group", "g1"),), 42.0, "counter"),
+            RawMetric("bydb_parts", (), 7.0, "gauge"),
+        ]
+    )
+    agent = fodc_wire.FodcAgentClient(
+        f"127.0.0.1:{port}",
+        node_role="data",
+        pod_name="pod-a",
+        labels={"zone": "z1"},
+        recorder=fr,
+        profiler=pp,
+    )
+    agent.register()
+    agent.start_pressure_serving()
+    api = FodcApiServer(state)
+    api.start()
+    try:
+        yield state, agent, api, pp
+    finally:
+        api.stop()
+        agent.stop()
+        server.stop(grace=0.2)
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def test_fodc_wire_register_and_metrics(fodc_stack):
+    state, agent, api, pp = fodc_stack
+    assert agent.agent_id
+    st = state.get(agent.agent_id)
+    assert st.identity["pod_name"] == "pod-a"
+    agent.push_metrics_once()
+    deadline = time.monotonic() + 5
+    while not st.metrics and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert {m.name for m in st.metrics} == {"bydb_writes_total", "bydb_parts"}
+    assert st.metric_history  # windowed mirror for /metrics-windows
+
+
+def test_fodc_pressure_profiles_over_wire(fodc_stack):
+    state, agent, api, pp = fodc_stack
+    from banyandb_tpu.admin import fodc_wire
+
+    st = state.get(agent.agent_id)
+    deadline = time.monotonic() + 5
+    while not st.pp_connected and time.monotonic() < deadline:
+        time.sleep(0.02)
+    recs = fodc_wire.list_pressure_profiles(st)
+    assert len(recs) == 1 and recs[0]["rss_bytes"] == 123456
+    data = fodc_wire.fetch_pressure_profile(st, recs[0]["profile_id"], "threads")
+    assert b"thread" in data
+    with pytest.raises(FileNotFoundError):
+        fodc_wire.fetch_pressure_profile(st, recs[0]["profile_id"], "nope")
+
+
+def test_fodc_rest_api(fodc_stack):
+    state, agent, api, pp = fodc_stack
+    agent.push_metrics_once()
+    st = state.get(agent.agent_id)
+    deadline = time.monotonic() + 5
+    while (not st.metrics or not st.pp_connected) and time.monotonic() < deadline:
+        time.sleep(0.02)
+
+    prom = _get(api.addr + "/metrics").decode()
+    assert "# TYPE bydb_writes_total counter" in prom
+    assert 'bydb_writes_total{group="g1",node_role="data",pod="pod-a"} 42' in prom
+
+    health = json.loads(_get(api.addr + "/health"))
+    assert health["status"] == "ok" and health["agents"][0]["pod"] == "pod-a"
+
+    windows = json.loads(_get(api.addr + "/metrics-windows?start=0"))
+    assert windows and windows[-1]["pod"] == "pod-a"
+
+    profs = json.loads(_get(api.addr + "/pressure-profiles"))
+    assert profs and profs[0]["pod_name"] == "pod-a"
+    pid = profs[0]["profile_id"]
+    body = _get(f"{api.addr}/pressure-profiles/pod-a/{pid}/heap")
+    assert b"tracemalloc" in body or b"total traced" in body
+
+    with pytest.raises(urllib.error.HTTPError):
+        _get(api.addr + "/nope")

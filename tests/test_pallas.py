@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from banyandb_tpu.ops.pallas_kernels import TILE, fused_group_sum
+from banyandb_tpu.ops.pallas_kernels import TILE, fused_group_multi, fused_group_sum
 
 RNG = np.random.default_rng(33)
 
@@ -39,3 +39,35 @@ def test_fused_group_sum_rejects_ragged():
             jnp.zeros(100, jnp.float32), jnp.ones(100, bool),
             num_groups=4, interpret=True,
         )
+
+
+def test_fused_group_multi_zero_rows_returns_zeros():
+    # zero-size grid dims never invoke the kernel (init included), so the
+    # wrapper must short-circuit to real zeros
+    count, sums = fused_group_multi(
+        jnp.zeros(0, jnp.int32), jnp.zeros(0, bool),
+        jnp.zeros((2, 0), jnp.float32), jnp.zeros(0, bool),
+        num_groups=16, interpret=True,
+    )
+    assert count.shape == (16,) and sums.shape == (2, 16)
+    assert float(jnp.abs(count).sum()) == 0 and float(jnp.abs(sums).sum()) == 0
+
+
+def test_fused_group_multi_large_group_count():
+    # G spanning multiple group tiles (GTILE) must still match the oracle
+    rng = np.random.default_rng(3)
+    n, g = 4096, 5000
+    codes = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.normal(size=(1, n)).astype(np.float32)
+    count, sums = fused_group_multi(
+        jnp.asarray(codes), jnp.ones(n, bool), jnp.asarray(vals),
+        jnp.ones(n, bool), num_groups=g, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(count), np.bincount(codes, minlength=g)
+    )
+    np.testing.assert_allclose(
+        np.asarray(sums)[0],
+        np.bincount(codes, weights=vals[0].astype(np.float64), minlength=g),
+        atol=1e-2,
+    )
