@@ -60,6 +60,8 @@ def _abort(context, e: Exception):
         context.abort(grpc.StatusCode.NOT_FOUND, str(e))
     if isinstance(e, NotImplementedError):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, str(e))
+    if isinstance(e, FileExistsError):
+        context.abort(grpc.StatusCode.ALREADY_EXISTS, str(e))
     if isinstance(e, (ValueError, TypeError)):
         context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
     log.exception("internal error")
@@ -844,6 +846,160 @@ class WireServices:
         finally:
             self._barrier_slots.release()
 
+    # -- node schema status (cluster/v1/node_schema_status.proto:29) -------
+    def _schema_key_lookup(self, key) -> dict:
+        kind = _BARRIER_KINDS.get(key.kind)
+        if kind is None:
+            raise ValueError(f"unknown schema kind {key.kind!r}")
+        k = key.name if kind == "group" else f"{key.group}/{key.name}"
+        return self.registry.stored_object_hash(kind, k)
+
+    def node_schema_max_revision(self, req, context):
+        return pb.cluster_node_schema_status_pb2.GetMaxRevisionResponse(
+            max_mod_revision=self.registry.revision
+        )
+
+    def node_schema_key_revisions(self, req, context):
+        try:
+            out = pb.cluster_node_schema_status_pb2.GetKeyRevisionsResponse()
+            for key in req.keys:  # response order mirrors request order
+                st = self._schema_key_lookup(key)
+                kr = out.revisions.add()
+                kr.key.CopyFrom(key)
+                kr.mod_revision = st["rev"]
+                kr.present = st["hash"] is not None
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def node_schema_absent_keys(self, req, context):
+        try:
+            out = pb.cluster_node_schema_status_pb2.GetAbsentKeysResponse()
+            for key in req.keys:
+                st = self._schema_key_lookup(key)
+                (
+                    out.still_present_keys
+                    if st["hash"] is not None
+                    else out.absent_keys
+                ).add().CopyFrom(key)
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    # -- trace pipeline registry (pipeline/v1/trace_pipeline.proto:87) -----
+    # The shipped proto's TracePipelineConfig carries no identity (the
+    # design doc's metadata.group field was dropped: "group-scoped,
+    # name-less", common.proto:156), yet Create/Update requests carry only
+    # the config.  Callers therefore scope Create/Update with an
+    # 'x-banyandb-group' gRPC metadata header; Get/Delete/Exist/List use
+    # the group from their request as specified.
+    def _tp_group_from_md(self, context) -> str:
+        for k, v in context.invocation_metadata():
+            if k == "x-banyandb-group":
+                return v
+        raise ValueError(
+            "TracePipelineConfig carries no identity; pass the target "
+            "group in 'x-banyandb-group' request metadata"
+        )
+
+    def _tp_upsert(self, req, context, create: bool):
+        from google.protobuf import json_format
+
+        from banyandb_tpu.api.schema import TracePipelineConfig
+
+        group = self._tp_group_from_md(context)
+        self.registry.get_group(group)  # admission: group must exist
+        cfg_json = json_format.MessageToJson(req.trace_pipeline_config)
+        # one config per group: Create is an atomic create-if-absent
+        # (the check lives under the registry lock)
+        return self.registry.create_trace_pipeline(
+            TracePipelineConfig(group=group, config_json=cfg_json),
+            exclusive=create,
+        )
+
+    def trace_pipeline_create(self, req, context):
+        try:
+            rev = self._tp_upsert(req, context, create=True)
+            return pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceCreateResponse(
+                mod_revision=rev
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def trace_pipeline_update(self, req, context):
+        try:
+            rev = self._tp_upsert(req, context, create=False)
+            return pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceUpdateResponse(
+                mod_revision=rev
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def trace_pipeline_get(self, req, context):
+        from google.protobuf import json_format
+
+        try:
+            c = self.registry.get_trace_pipeline(req.metadata.group)
+            out = pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceGetResponse()
+            json_format.Parse(c.config_json, out.trace_pipeline_config)
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def trace_pipeline_delete(self, req, context):
+        import time as _time
+
+        try:
+            self.registry.delete_trace_pipeline(req.metadata.group)
+            return pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceDeleteResponse(
+                deleted=True,
+                delete_time=_time.time_ns(),
+                mod_revision=self.registry.revision,
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def trace_pipeline_list(self, req, context):
+        from google.protobuf import json_format
+
+        try:
+            out = pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceListResponse()
+            for c in self.registry.list_trace_pipelines(req.group):
+                json_format.Parse(c.config_json, out.trace_pipeline_config.add())
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    def trace_pipeline_exist(self, req, context):
+        try:
+            has_group = True
+            try:
+                self.registry.get_group(req.metadata.group)
+            except KeyError:
+                has_group = False
+            return pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceExistResponse(
+                has_group=has_group,
+                has_trace_pipeline_config=bool(
+                    self.registry.list_trace_pipelines(req.metadata.group)
+                ),
+            )
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
+    # -- fodc group lifecycle (fodc/v1/rpc.proto:257) ----------------------
+    def group_lifecycle_inspect_all(self, req, context):
+        try:
+            out = pb.fodc_rpc_pb2.InspectAllResponse()
+            for g in self.registry.list_groups():
+                info = out.groups.add()
+                gpb = wire.group_to_pb(g)
+                info.name = g.name
+                info.catalog = pb.common_common_pb2.Catalog.Name(gpb.catalog)
+                info.resource_opts.CopyFrom(gpb.resource_opts)
+            return out
+        except Exception as e:  # noqa: BLE001
+            _abort(context, e)
+
     # -- schema plane (schema/v1/internal.proto) ---------------------------
     @staticmethod
     def _fill_schema_doc(prop_msg, kind: str, key: str, payload: str) -> None:
@@ -1259,6 +1415,61 @@ class WireServer:
                     "AwaitSchemaDeleted": _unary(
                         s.barrier_await_deleted,
                         pb.schema_barrier_pb2.AwaitSchemaDeletedRequest,
+                    ),
+                },
+            ),
+            (
+                "banyandb.cluster.v1.NodeSchemaStatusService",
+                {
+                    "GetMaxRevision": _unary(
+                        s.node_schema_max_revision,
+                        pb.cluster_node_schema_status_pb2.GetMaxRevisionRequest,
+                    ),
+                    "GetKeyRevisions": _unary(
+                        s.node_schema_key_revisions,
+                        pb.cluster_node_schema_status_pb2.GetKeyRevisionsRequest,
+                    ),
+                    "GetAbsentKeys": _unary(
+                        s.node_schema_absent_keys,
+                        pb.cluster_node_schema_status_pb2.GetAbsentKeysRequest,
+                    ),
+                },
+            ),
+            (
+                "banyandb.pipeline.v1.TracePipelineRegistryService",
+                {
+                    "Create": _unary(
+                        s.trace_pipeline_create,
+                        pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceCreateRequest,
+                    ),
+                    "Update": _unary(
+                        s.trace_pipeline_update,
+                        pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceUpdateRequest,
+                    ),
+                    "Delete": _unary(
+                        s.trace_pipeline_delete,
+                        pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceDeleteRequest,
+                    ),
+                    "Get": _unary(
+                        s.trace_pipeline_get,
+                        pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceGetRequest,
+                    ),
+                    "List": _unary(
+                        s.trace_pipeline_list,
+                        pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceListRequest,
+                    ),
+                    "Exist": _unary(
+                        s.trace_pipeline_exist,
+                        pb.pipeline_trace_pipeline_pb2.TracePipelineRegistryServiceExistRequest,
+                    ),
+                },
+            ),
+            (
+                "banyandb.fodc.v1.GroupLifecycleService",
+                {
+                    "InspectAll": _unary(
+                        s.group_lifecycle_inspect_all,
+                        pb.fodc_rpc_pb2.InspectAllRequest,
                     ),
                 },
             ),

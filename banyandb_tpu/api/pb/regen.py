@@ -44,6 +44,7 @@ SUBTREES = [
     "banyandb/cluster/v1",
     "banyandb/schema/v1",
     "banyandb/fodc/v1",
+    "banyandb/pipeline/v1",
 ]
 
 _DROP_IMPORTS = (

@@ -213,6 +213,21 @@ class TopNAggregation:
     source_group: str = ""
 
 
+@dataclasses.dataclass(frozen=True)
+class TracePipelineConfig:
+    """pipeline/v1 TracePipelineConfig: group-scoped, name-less tail-
+    sampling config (one per group by construction — common.proto:156).
+    The proto body is stored as canonical protobuf-JSON: the registry
+    versions/persists/gossips it; the trace engine interprets it."""
+
+    group: str
+    config_json: str = "{}"
+
+    @property
+    def name(self) -> str:  # registry key: one config per group
+        return "_pipeline"
+
+
 _KINDS = {
     "group": Group,
     "measure": Measure,
@@ -222,6 +237,7 @@ _KINDS = {
     "index_rule": IndexRule,
     "index_rule_binding": IndexRuleBinding,
     "topn": TopNAggregation,
+    "trace_pipeline": TracePipelineConfig,
 }
 
 
@@ -388,10 +404,14 @@ class SchemaRegistry:
                 for w in targets:
                     w(kind, payload, rev)
 
-    def _put(self, kind: str, obj) -> int:
+    def _put(self, kind: str, obj, *, exclusive: bool = False) -> int:
         with self._lock:
-            self._revision += 1
             key = self._key(obj)
+            if exclusive and key in self._store[kind]:
+                # atomic create-if-absent: the existence check must live
+                # under the same lock as the insert (concurrent Creates)
+                raise FileExistsError(f"{kind} {key} already exists")
+            self._revision += 1
             self._store[kind][key] = obj
             self._obj_revs[(kind, key)] = self._revision
             self._obj_hashes[(kind, key)] = self.object_hash(obj)
@@ -616,6 +636,24 @@ class SchemaRegistry:
             b
             for b in self._store["index_rule_binding"].values()
             if b.group == group
+        ]
+
+    def create_trace_pipeline(
+        self, c: TracePipelineConfig, *, exclusive: bool = False
+    ) -> int:
+        return self._put("trace_pipeline", c, exclusive=exclusive)
+
+    def get_trace_pipeline(self, group: str) -> TracePipelineConfig:
+        return self._get("trace_pipeline", f"{group}/_pipeline")
+
+    def delete_trace_pipeline(self, group: str) -> None:
+        self._delete("trace_pipeline", f"{group}/_pipeline")
+
+    def list_trace_pipelines(self, group: str) -> list[TracePipelineConfig]:
+        return [
+            c
+            for c in self._store["trace_pipeline"].values()
+            if c.group == group
         ]
 
     def create_topn(self, t: TopNAggregation) -> int:

@@ -53,3 +53,8 @@ class LocalBus:
         if h is None:
             raise KeyError(f"no handler for topic {topic}")
         return h(envelope)
+
+    def topics(self) -> list[str]:
+        """Registered topic names (HealthCheck's service inventory)."""
+        with self._lock:
+            return list(self._handlers)

@@ -124,12 +124,70 @@ class GrpcBusServer:
                 )
             },
         )
+
+        # The reference-shaped internal fabric (cluster/v1/rpc.proto:188,
+        # banyand/queue/sub): Send is a bidi stream of topic-addressed
+        # envelopes (bodies are this bus's JSON envelopes), HealthCheck
+        # answers per-service status.  Wire shape matches upstream; the
+        # body codec is this framework's envelope JSON rather than the
+        # per-topic protos of api/data.
+        from banyandb_tpu.api import pb as _pb
+
+        cl = _pb.cluster_rpc_pb2
+        wr = _pb.model_write_pb2
+
+        def send_behavior(req_iter, context):
+            for req in req_iter:
+                try:
+                    reply = self.bus.handle(
+                        req.topic, json.loads(req.body or b"{}")
+                    )
+                    yield cl.SendResponse(
+                        message_id=req.message_id,
+                        body=json.dumps(reply).encode(),
+                        status=wr.STATUS_SUCCEED,
+                    )
+                except Exception as e:  # noqa: BLE001 - errors cross the wire
+                    shed = type(e).__name__ in _SHED_TYPES
+                    yield cl.SendResponse(
+                        message_id=req.message_id,
+                        error=f"{type(e).__name__}: {e}",
+                        status=(
+                            wr.STATUS_INTERNAL_ERROR
+                            if not shed
+                            else wr.STATUS_DISK_FULL
+                        ),
+                    )
+
+        def health_behavior(req, context):
+            known = req.service_name in self.bus.topics() or not req.service_name
+            return cl.HealthCheckResponse(
+                service_name=req.service_name,
+                status=wr.STATUS_SUCCEED if known else wr.STATUS_NOT_FOUND,
+                error="" if known else f"unknown topic {req.service_name}",
+            )
+
+        cluster_service = grpc.method_handlers_generic_handler(
+            "banyandb.cluster.v1.Service",
+            {
+                "Send": grpc.stream_stream_rpc_method_handler(
+                    send_behavior,
+                    request_deserializer=cl.SendRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
+                "HealthCheck": grpc.unary_unary_rpc_method_handler(
+                    health_behavior,
+                    request_deserializer=cl.HealthCheckRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
+            },
+        )
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=8),
             options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
                      ("grpc.max_send_message_length", 64 * 1024 * 1024)],
         )
-        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_generic_rpc_handlers((handler, cluster_service))
         if sync_install is not None:
             from banyandb_tpu.cluster import chunked_sync
 

@@ -1,0 +1,185 @@
+"""Round-3 wire completion: NodeSchemaStatusService, TracePipeline
+registry, fodc GroupLifecycleService, and the reference-shaped cluster
+Send/HealthCheck fabric (cluster/v1/rpc.proto:188,
+cluster/v1/node_schema_status.proto:29, pipeline/v1/trace_pipeline.proto:87,
+fodc/v1/rpc.proto:257)."""
+
+import json
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from banyandb_tpu.api import pb  # noqa: E402
+from banyandb_tpu.api.grpc_server import WireServer, WireServices  # noqa: E402
+from banyandb_tpu.api.schema import SchemaRegistry  # noqa: E402
+from banyandb_tpu.models.measure import MeasureEngine  # noqa: E402
+from banyandb_tpu.models.stream import StreamEngine  # noqa: E402
+
+from tests.test_wire_cluster_services import _create_group, _method  # noqa: E402
+
+
+@pytest.fixture()
+def server(tmp_path):
+    registry = SchemaRegistry(tmp_path)
+    measure = MeasureEngine(registry, tmp_path / "data")
+    stream = StreamEngine(registry, tmp_path / "data")
+    srv = WireServer(WireServices(registry, measure, stream), port=0)
+    srv.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+    yield chan, registry
+    chan.close()
+    srv.stop()
+
+
+def test_node_schema_status_service(server):
+    chan, registry = server
+    _create_group(chan, "gns")
+    ns = pb.cluster_node_schema_status_pb2
+    S = "banyandb.cluster.v1.NodeSchemaStatusService"
+
+    max_rev = _method(chan, S, "GetMaxRevision", ns.GetMaxRevisionRequest,
+                      ns.GetMaxRevisionResponse)(ns.GetMaxRevisionRequest())
+    assert max_rev.max_mod_revision == registry.revision > 0
+
+    req = ns.GetKeyRevisionsRequest()
+    k1 = req.keys.add()
+    k1.kind, k1.name = "group", "gns"
+    k2 = req.keys.add()
+    k2.kind, k2.group, k2.name = "measure", "gns", "absent"
+    revs = _method(chan, S, "GetKeyRevisions", ns.GetKeyRevisionsRequest,
+                   ns.GetKeyRevisionsResponse)(req).revisions
+    assert [r.present for r in revs] == [True, False]
+    assert revs[0].mod_revision > 0 and revs[0].key.name == "gns"
+
+    areq = ns.GetAbsentKeysRequest()
+    areq.keys.extend([k1, k2])
+    aresp = _method(chan, S, "GetAbsentKeys", ns.GetAbsentKeysRequest,
+                    ns.GetAbsentKeysResponse)(areq)
+    assert [k.name for k in aresp.still_present_keys] == ["gns"]
+    assert [k.name for k in aresp.absent_keys] == ["absent"]
+
+
+def test_trace_pipeline_registry_crud(server):
+    chan, registry = server
+    _create_group(chan, "gtp")
+    tp = pb.pipeline_trace_pipeline_pb2
+    S = "banyandb.pipeline.v1.TracePipelineRegistryService"
+    md = (("x-banyandb-group", "gtp"),)
+
+    cfg = pb.common_common_pb2.TracePipelineConfig(
+        enabled=True, schema_name_regex=".*"
+    )
+    cfg.merge_grace.seconds = 30
+
+    create = _method(chan, S, "Create", tp.TracePipelineRegistryServiceCreateRequest,
+                     tp.TracePipelineRegistryServiceCreateResponse, metadata=md)
+    resp = create(tp.TracePipelineRegistryServiceCreateRequest(trace_pipeline_config=cfg))
+    assert resp.mod_revision > 0
+
+    # one config per group by construction: second Create conflicts
+    with pytest.raises(grpc.RpcError) as ei:
+        create(tp.TracePipelineRegistryServiceCreateRequest(trace_pipeline_config=cfg))
+    assert ei.value.code() in (grpc.StatusCode.ALREADY_EXISTS, grpc.StatusCode.ABORTED)
+
+    # Create without the group header is rejected (config has no identity)
+    with pytest.raises(grpc.RpcError):
+        _method(chan, S, "Create", tp.TracePipelineRegistryServiceCreateRequest,
+                tp.TracePipelineRegistryServiceCreateResponse)(
+            tp.TracePipelineRegistryServiceCreateRequest(trace_pipeline_config=cfg))
+
+    getreq = tp.TracePipelineRegistryServiceGetRequest()
+    getreq.metadata.group = "gtp"
+    got = _method(chan, S, "Get", tp.TracePipelineRegistryServiceGetRequest,
+                  tp.TracePipelineRegistryServiceGetResponse)(getreq)
+    assert got.trace_pipeline_config.enabled is True
+    assert got.trace_pipeline_config.merge_grace.seconds == 30
+
+    cfg.enabled = False
+    upd = _method(chan, S, "Update", tp.TracePipelineRegistryServiceUpdateRequest,
+                  tp.TracePipelineRegistryServiceUpdateResponse, metadata=md)
+    assert upd(tp.TracePipelineRegistryServiceUpdateRequest(
+        trace_pipeline_config=cfg)).mod_revision > resp.mod_revision
+
+    lst = _method(chan, S, "List", tp.TracePipelineRegistryServiceListRequest,
+                  tp.TracePipelineRegistryServiceListResponse)(
+        tp.TracePipelineRegistryServiceListRequest(group="gtp"))
+    assert len(lst.trace_pipeline_config) == 1
+    assert lst.trace_pipeline_config[0].enabled is False
+
+    exreq = tp.TracePipelineRegistryServiceExistRequest()
+    exreq.metadata.group = "gtp"
+    ex = _method(chan, S, "Exist", tp.TracePipelineRegistryServiceExistRequest,
+                 tp.TracePipelineRegistryServiceExistResponse)(exreq)
+    assert ex.has_group and ex.has_trace_pipeline_config
+
+    delreq = tp.TracePipelineRegistryServiceDeleteRequest()
+    delreq.metadata.group = "gtp"
+    dl = _method(chan, S, "Delete", tp.TracePipelineRegistryServiceDeleteRequest,
+                 tp.TracePipelineRegistryServiceDeleteResponse)(delreq)
+    assert dl.deleted and dl.delete_time > 0
+
+    ex2 = _method(chan, S, "Exist", tp.TracePipelineRegistryServiceExistRequest,
+                  tp.TracePipelineRegistryServiceExistResponse)(exreq)
+    assert ex2.has_group and not ex2.has_trace_pipeline_config
+
+    # the registry survives restart with the config (persistence check)
+    upd(tp.TracePipelineRegistryServiceUpdateRequest(trace_pipeline_config=cfg))
+    re_read = SchemaRegistry(registry._root.parent)
+    assert len(re_read.list_trace_pipelines("gtp")) == 1
+
+
+def test_group_lifecycle_inspect_all(server):
+    chan, registry = server
+    _create_group(chan, "glc")
+    f = pb.fodc_rpc_pb2
+    resp = _method(chan, "banyandb.fodc.v1.GroupLifecycleService", "InspectAll",
+                   f.InspectAllRequest, f.InspectAllResponse)(f.InspectAllRequest())
+    groups = {g.name: g for g in resp.groups}
+    assert "glc" in groups
+    assert groups["glc"].catalog == "CATALOG_TRACE"
+    assert groups["glc"].resource_opts.shard_num == 1
+
+
+def test_cluster_send_and_healthcheck_on_reference_proto(tmp_path):
+    from banyandb_tpu.cluster.bus import LocalBus
+    from banyandb_tpu.cluster.rpc import GrpcBusServer
+
+    bus = LocalBus()
+    bus.subscribe("echo", lambda env: {"got": env})
+    srv = GrpcBusServer(bus, port=0)
+    srv.start()
+    try:
+        chan = grpc.insecure_channel(srv.addr)
+        cl = pb.cluster_rpc_pb2
+        wr = pb.model_write_pb2
+
+        send = chan.stream_stream(
+            "/banyandb.cluster.v1.Service/Send",
+            request_serializer=cl.SendRequest.SerializeToString,
+            response_deserializer=cl.SendResponse.FromString,
+        )
+        reqs = [
+            cl.SendRequest(topic="echo", message_id=1,
+                           body=json.dumps({"x": 1}).encode(), batch_mod=True),
+            cl.SendRequest(topic="nope", message_id=2, body=b"{}"),
+        ]
+        resps = list(send(iter(reqs)))
+        assert [r.message_id for r in resps] == [1, 2]
+        assert resps[0].status == wr.STATUS_SUCCEED
+        assert json.loads(resps[0].body) == {"got": {"x": 1}}
+        assert resps[1].status == wr.STATUS_INTERNAL_ERROR
+        assert "no handler" in resps[1].error
+
+        hc = chan.unary_unary(
+            "/banyandb.cluster.v1.Service/HealthCheck",
+            request_serializer=cl.HealthCheckRequest.SerializeToString,
+            response_deserializer=cl.HealthCheckResponse.FromString,
+        )
+        ok = hc(cl.HealthCheckRequest(service_name="echo"))
+        assert ok.status == wr.STATUS_SUCCEED
+        missing = hc(cl.HealthCheckRequest(service_name="ghost"))
+        assert missing.status == wr.STATUS_NOT_FOUND
+        chan.close()
+    finally:
+        srv.stop()
