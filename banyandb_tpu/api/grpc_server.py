@@ -991,6 +991,8 @@ class WireServices:
         try:
             out = pb.fodc_rpc_pb2.InspectAllResponse()
             for g in self.registry.list_groups():
+                if g.name.startswith("_"):
+                    continue  # same public surface as GroupRegistry.List
                 info = out.groups.add()
                 gpb = wire.group_to_pb(g)
                 info.name = g.name

@@ -50,8 +50,21 @@ class _HTTPContext:
 _GRPC_TO_HTTP = {
     "NOT_FOUND": 404,
     "INVALID_ARGUMENT": 400,
+    "ALREADY_EXISTS": 409,
     "UNIMPLEMENTED": 501,
     "INTERNAL": 500,
+}
+
+# resource kinds -> registry service stems, keyed by their upstream route
+# segment (database/v1/rpc.proto google.api.http paths)
+_KIND_SERVICES = {
+    "measure": "Measure",
+    "stream": "Stream",
+    "trace": "Trace",
+    "property": "Property",
+    "index-rule": "IndexRule",
+    "index-rule-binding": "IndexRuleBinding",
+    "topn-agg": "TopNAggregation",
 }
 
 
@@ -137,6 +150,9 @@ class HttpGateway:
             def do_POST(self):
                 self._dispatch("POST")
 
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
             def do_GET(self):
                 if self.path == "/api/healthz":
                     return self._send(200, {"status": "ok"})
@@ -213,6 +229,33 @@ class HttpGateway:
             "PropertyRegistryService", "property", "property_schema",
             _wire.property_schema_to_internal, _wire.property_schema_to_pb,
         )
+        # spec registries under their upstream route segments
+        # (rpc.proto:261 /v1/index-rule, :175 /v1/index-rule-binding,
+        # :701 /v1/topn-agg)
+        self._reg["index-rule"] = s._spec_registry_handlers(
+            "IndexRuleRegistryService", "index_rule", "index_rule",
+            _wire.index_rule_to_internal, _wire.index_rule_to_pb,
+        )
+        self._reg["index-rule-binding"] = s._spec_registry_handlers(
+            "IndexRuleBindingRegistryService", "index_rule_binding",
+            "index_rule_binding",
+            _wire.index_rule_binding_to_internal,
+            _wire.index_rule_binding_to_pb,
+        )
+        self._reg["topn-agg"] = s._spec_registry_handlers(
+            "TopNAggregationRegistryService", "top_n_aggregation", "topn",
+            _wire.topn_to_internal, _wire.topn_to_pb,
+            reg_list="list_topn",
+        )
+        for seg, svc in (
+            ("index-rule", "IndexRule"),
+            ("index-rule-binding", "IndexRuleBinding"),
+            ("topn-agg", "TopNAggregation"),
+        ):
+            self._post[("v1", seg, "schema")] = (
+                self._reg[seg]["Create"].unary_unary,
+                getattr(rpc, f"{svc}RegistryServiceCreateRequest"),
+            )
         self._post[("v1", "trace", "schema")] = (
             self._reg["trace"]["Create"].unary_unary,
             rpc.TraceRegistryServiceCreateRequest,
@@ -244,11 +287,17 @@ class HttpGateway:
         if method == "POST":
             hit = self._post.get(tuple(parts))
             return (hit[0], hit[1]()) if hit else None
-        hit = self._get_plain.get(tuple(parts))
-        if hit:
-            return (hit[0], hit[1]())
-        # GET routes with path params
+        if method == "GET":  # read-only endpoints never answer DELETE
+            hit = self._get_plain.get(tuple(parts))
+            if hit:
+                return (hit[0], hit[1]())
+        # routes with path params
         if len(parts) == 4 and parts[:3] == ["v1", "group", "schema"]:
+            if method == "DELETE":
+                return (
+                    self._reg["group"]["Delete"].unary_unary,
+                    rpc.GroupRegistryServiceDeleteRequest(group=parts[3]),
+                )
             if parts[3] == "lists":
                 return (
                     self._reg["group"]["List"].unary_unary,
@@ -258,9 +307,13 @@ class HttpGateway:
                 self._reg["group"]["Get"].unary_unary,
                 rpc.GroupRegistryServiceGetRequest(group=parts[3]),
             )
-        for kind in ("measure", "stream", "trace", "property"):
+        for kind, svc in _KIND_SERVICES.items():
             if len(parts) == 5 and parts[:3] == ["v1", kind, "schema"]:
-                P = f"{kind.capitalize()}RegistryService"
+                P = f"{svc}RegistryService"
+                if method == "DELETE":
+                    req = getattr(rpc, f"{P}DeleteRequest")()
+                    req.metadata.group, req.metadata.name = parts[3], parts[4]
+                    return (self._reg[kind]["Delete"].unary_unary, req)
                 if parts[3] == "lists":
                     return (
                         self._reg[kind]["List"].unary_unary,

@@ -548,6 +548,19 @@ class SchemaRegistry:
             # backing store) must not be deletable: dropping _schema would
             # break every subsequent schema mutation's persistence
             raise ValueError(f"group {name} is internal and cannot be deleted")
+        # cascade: child resources die with the group (the reference
+        # orchestrates this in liaison/grpc/deletion.go) — otherwise they
+        # orphan and resurrect when the group name is reused
+        for kind in _KINDS:
+            if kind == "group":
+                continue
+            doomed = [
+                key
+                for key, obj in self._store[kind].items()
+                if getattr(obj, "group", None) == name
+            ]
+            for key in doomed:
+                self._delete(kind, key)
         self._delete("group", name)
 
     def create_measure(self, m: Measure) -> int:

@@ -51,6 +51,57 @@ _TOKEN = re.compile(
 _AGG_FNS = {"sum", "count", "min", "max", "mean", "avg", "percentile"}
 
 
+_DUR_UNITS = {
+    "ms": 1,
+    "s": 1000,
+    "m": 60_000,
+    "h": 3_600_000,
+    "d": 86_400_000,
+    "w": 604_800_000,
+}
+_DUR_PIECE = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h|d|w)")
+
+
+def _time_millis(v) -> int:
+    """TIME bound literal -> epoch millis.
+
+    Mirrors the reference transformer (pkg/bydbql/transformer.go:1362):
+    int millis pass through; then RFC3339 absolute timestamps; then
+    'now' and signed compound durations relative to now ('-2h',
+    '-1h30m', '15m') per str2duration.
+    """
+    import datetime
+    import time as _time
+
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        pass
+    s = str(v).strip()
+    low = s.lower()
+    if low == "now":
+        return int(_time.time() * 1000)
+    sign, body = 1, s
+    if s[:1] in "+-":
+        sign, body = (-1 if s[0] == "-" else 1), s[1:]
+    pieces = _DUR_PIECE.findall(body)
+    if pieces and "".join(n + u for n, u in pieces) == body:
+        delta = sum(float(n) * _DUR_UNITS[u] for n, u in pieces)
+        return int(_time.time() * 1000) + sign * int(delta)
+    try:
+        dt = datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
+    except ValueError:
+        raise QLError(
+            f"bad time literal {s!r} (millis, RFC3339, 'now', or "
+            "signed duration like '-1h30m')"
+        ) from None
+    if dt.tzinfo is None:
+        # RFC3339 requires an offset; a naive literal would silently
+        # bind to the server's local zone and differ per node
+        raise QLError(f"time literal {s!r} needs a UTC offset (RFC3339)")
+    return int(dt.timestamp() * 1000)
+
+
 class QLError(ValueError):
     pass
 
@@ -195,19 +246,19 @@ def parse_with_catalog(text: str, params=()) -> tuple[str, QueryRequest]:
         if kw == "time":
             kind, op = p.next()
             if kind == "word" and op.lower() == "between":
-                begin = int(p.literal())
+                begin = _time_millis(p.literal())
                 p.expect_word("and")
-                end = int(p.literal()) + 1
+                end = _time_millis(p.literal()) + 1
             elif op in (">", ">="):
-                begin = int(p.literal()) + (1 if op == ">" else 0)
+                begin = _time_millis(p.literal()) + (1 if op == ">" else 0)
                 if p.accept_word("and"):
                     p.expect_word("time")
                     _, op2 = p.next()
                     if op2 not in ("<", "<="):
                         raise QLError("expected TIME < upper bound")
-                    end = int(p.literal()) + (1 if op2 == "<=" else 0)
+                    end = _time_millis(p.literal()) + (1 if op2 == "<=" else 0)
             elif op in ("<", "<="):
-                end = int(p.literal()) + (1 if op == "<=" else 0)
+                end = _time_millis(p.literal()) + (1 if op == "<=" else 0)
             else:
                 raise QLError(f"bad TIME operator {op!r}")
         elif kw == "where":

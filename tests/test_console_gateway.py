@@ -1,0 +1,163 @@
+"""Console + gateway round-3 additions: spec-registry HTTP routes,
+DELETE routes, the SPA page, and BydbQL relative time literals
+(reference: banyand/liaison/http, pkg/bydbql/transformer.go:1362)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from banyandb_tpu.bydbql import QLError, _time_millis
+
+
+# -- QL time literals --------------------------------------------------------
+
+
+def test_time_millis_forms():
+    now = time.time() * 1000
+    assert _time_millis(1234) == 1234
+    assert _time_millis("1234") == 1234
+    assert abs(_time_millis("now") - now) < 2000
+    assert abs(_time_millis("-2h") - (now - 7_200_000)) < 2000
+    assert abs(_time_millis("-1h30m") - (now - 5_400_000)) < 2000
+    assert abs(_time_millis("15m") - (now + 900_000)) < 2000
+    assert _time_millis("2026-07-29T00:00:00Z") == 1785283200000
+    with pytest.raises(QLError):
+        _time_millis("yesterday-ish")
+
+
+def test_ql_relative_time_end_to_end(tmp_path):
+    from banyandb_tpu.server import StandaloneServer
+    from banyandb_tpu.api.schema import (
+        Catalog, Entity, FieldSpec, FieldType, Group, ResourceOpts, TagSpec,
+        TagType, Measure,
+    )
+
+    srv = StandaloneServer(tmp_path / "srv", port=0)
+    srv.registry.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    srv.registry.create_measure(Measure(
+        group="g", name="m", tags=(TagSpec("svc", TagType.STRING),),
+        fields=(FieldSpec("v", FieldType.INT),), entity=Entity(("svc",))))
+    srv.start()
+    try:
+        now = int(time.time() * 1000)
+        pts = [{"ts": now - i * 1000, "tags": {"svc": "a"}, "fields": {"v": i}}
+               for i in range(5)]
+        srv.bus.handle("measure-write",
+                       {"request": {"group": "g", "name": "m", "points": pts}})
+        res = srv.bus.handle("bydbql", {
+            "ql": "SELECT svc, sum(v) FROM MEASURE m IN g "
+                  "TIME BETWEEN '-1h' AND 'now' GROUP BY svc"})
+        result = res["result"]
+        assert result["groups"] == [["a"]]
+        assert result["values"]["sum(v)"] == [float(sum(range(5)))]
+        assert result["values"]["count"] == [5.0]
+    finally:
+        srv.stop()
+
+
+# -- gateway routes ----------------------------------------------------------
+
+
+@pytest.fixture()
+def gw(tmp_path):
+    from banyandb_tpu.server import StandaloneServer
+    from banyandb_tpu.api.schema import Catalog, Group, ResourceOpts
+
+    srv = StandaloneServer(tmp_path / "srv", port=0, http_port=0)
+    srv.registry.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    srv.start()
+    yield f"http://127.0.0.1:{srv.http.port}"
+    srv.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url, obj):
+    req = urllib.request.Request(url, data=json.dumps(obj).encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _delete(url):
+    req = urllib.request.Request(url, method="DELETE")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_gateway_spec_registry_routes(gw):
+    # index-rule CRUD over the upstream route segments (rpc.proto:261)
+    _post(gw + "/api/v1/index-rule/schema",
+          {"index_rule": {"metadata": {"group": "g", "name": "r1"},
+                          "tags": ["svc"], "type": "TYPE_INVERTED"}})
+    lst = _get(gw + "/api/v1/index-rule/schema/lists/g")
+    assert [r["metadata"]["name"] for r in lst["index_rule"]] == ["r1"]
+    got = _get(gw + "/api/v1/index-rule/schema/g/r1")
+    assert got["index_rule"]["tags"] == ["svc"]
+    _delete(gw + "/api/v1/index-rule/schema/g/r1")
+    lst2 = _get(gw + "/api/v1/index-rule/schema/lists/g")
+    assert not lst2.get("index_rule")
+
+    # topn-agg list route exists (empty group)
+    assert _get(gw + "/api/v1/topn-agg/schema/lists/g") == {}
+
+    # binding create + get
+    _post(gw + "/api/v1/index-rule-binding/schema",
+          {"index_rule_binding": {"metadata": {"group": "g", "name": "b1"},
+                                  "rules": ["r1"],
+                                  "subject": {"catalog": "CATALOG_MEASURE",
+                                              "name": "m"}}})
+    got = _get(gw + "/api/v1/index-rule-binding/schema/g/b1")
+    assert got["index_rule_binding"]["rules"] == ["r1"]
+
+
+def test_gateway_group_delete_route(gw):
+    _post(gw + "/api/v1/group/schema",
+          {"group": {"metadata": {"name": "tmpg"}, "catalog": "CATALOG_MEASURE",
+                     "resource_opts": {"shard_num": 1}}})
+    _delete(gw + "/api/v1/group/schema/tmpg")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(gw + "/api/v1/group/schema/tmpg")
+    assert ei.value.code == 404
+
+
+def test_console_page_served(gw):
+    with urllib.request.urlopen(gw + "/console", timeout=10) as r:
+        page = r.read().decode()
+    # the SPA's four workspaces are present
+    for anchor in ("#/schema", "#/query", "#/properties", "#/cluster"):
+        assert anchor in page
+    assert "BydbQL workspace" in page and "Property browser" in page
+
+
+def test_time_millis_rejects_naive_iso():
+    with pytest.raises(QLError, match="offset"):
+        _time_millis("2026-07-29T00:00:00")
+
+
+def test_group_delete_cascades(gw):
+    _post(gw + "/api/v1/group/schema",
+          {"group": {"metadata": {"name": "casc"}, "catalog": "CATALOG_MEASURE",
+                     "resource_opts": {"shard_num": 1}}})
+    _post(gw + "/api/v1/index-rule/schema",
+          {"index_rule": {"metadata": {"group": "casc", "name": "r1"},
+                          "tags": ["svc"], "type": "TYPE_INVERTED"}})
+    _delete(gw + "/api/v1/group/schema/casc")
+    # recreate: children must NOT resurrect
+    _post(gw + "/api/v1/group/schema",
+          {"group": {"metadata": {"name": "casc"}, "catalog": "CATALOG_MEASURE",
+                     "resource_opts": {"shard_num": 1}}})
+    lst = _get(gw + "/api/v1/index-rule/schema/lists/casc")
+    assert not lst.get("index_rule")
+
+
+def test_delete_on_readonly_routes_is_404(gw):
+    for path in ("/api/v1/cluster/state", "/api/v1/common/api/version"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _delete(gw + path)
+        assert ei.value.code == 404

@@ -214,7 +214,7 @@ def test_console_served_and_no_thread_leaks(tmp_path):
             f"http://127.0.0.1:{srv.http.port}/console"
         ) as r:
             body = r.read().decode()
-        assert "BydbQL console" in body and "banyandb-tpu" in body
+        assert "BydbQL workspace" in body and "BanyanDB-TPU" in body
     finally:
         srv.stop()
     import time
