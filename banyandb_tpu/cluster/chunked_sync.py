@@ -37,6 +37,39 @@ def _crc(data: bytes) -> str:
     return f"{zlib.crc32(data) & 0xFFFFFFFF:08x}"
 
 
+# -- failure injection (test-only) ------------------------------------------
+#
+# Deterministic fault injection for sync paths, mirroring the reference's
+# queue.ChunkedSyncFailureInjector contract (banyand/queue/queue.go:230):
+# tests register an injector; production code never does.
+
+
+class SyncFailureInjector:
+    """Override any subset; the default injects nothing."""
+
+    def before_sync(self, part_dirs) -> tuple[bool, str]:
+        """-> (short_circuit, error): True fails the sync before the
+        stream opens (queue.go:234 BeforeSync analog)."""
+        return (False, "")
+
+    def mutate_request(self, req):
+        """Per-chunk hook: return a (possibly corrupted) request, or
+        raise to kill the stream mid-flight (wire-level fault)."""
+        return req
+
+
+_failure_injector: SyncFailureInjector | None = None
+
+
+def register_failure_injector(inj: SyncFailureInjector | None) -> None:
+    global _failure_injector
+    _failure_injector = inj
+
+
+def clear_failure_injector() -> None:
+    register_failure_injector(None)
+
+
 # -- server ----------------------------------------------------------------
 
 
@@ -200,12 +233,16 @@ def sync_part_dirs(
     from banyandb_tpu.cluster.rpc import TransportError
 
     rpcpb = pb.cluster_rpc_pb2
+    part_dirs = [Path(p) for p in part_dirs]
+    if _failure_injector is not None:
+        short, err = _failure_injector.before_sync(part_dirs)
+        if short:
+            raise TransportError(f"sync failure injected: {err}")
     session = uuid.uuid4().hex
     parts_info = []
     file_lists: list[list[Path]] = []
     total_bytes = 0
     for pd in part_dirs:
-        pd = Path(pd)
         files, paths, nbytes = _part_layout(pd)
         meta = {}
         try:
@@ -250,6 +287,8 @@ def sync_part_dirs(
                 req.metadata.total_parts = len(parts_info)
                 req.metadata.sender_node = sender_node
             idx += 1
+            if _failure_injector is not None:
+                req = _failure_injector.mutate_request(req)
             return req
 
         buf = bytearray()
@@ -275,6 +314,8 @@ def sync_part_dirs(
         fin.completion.total_bytes_sent = total_bytes
         fin.completion.total_parts_sent = len(parts_info)
         fin.completion.total_chunks = idx + 1
+        if _failure_injector is not None:
+            fin = _failure_injector.mutate_request(fin)
         yield fin
 
     call = channel.stream_stream(

@@ -1,0 +1,399 @@
+"""Protocol-level object-store tests: in-process HTTP fakes that verify
+each auth scheme by recomputation (SigV4, Azure SharedKey, GCS Bearer) —
+the stdlib analog of the reference's dockertest minio/fake-gcs/azurite
+suites (test/integration/dockertesthelper/minio_init.go)."""
+
+import datetime
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+import pytest
+
+from banyandb_tpu.utils.object_store import (
+    HttpAzureBlobFS,
+    HttpGcsFS,
+    HttpS3FS,
+    ObjectStoreError,
+    azure_sharedkey_auth,
+    sigv4_headers,
+)
+
+ACCESS, SECRET = "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+AZ_ACCOUNT, AZ_KEY = "devacct", "a2V5a2V5a2V5a2V5a2V5a2V5a2V5a2V5"  # b64("keykey...")
+GCS_TOKEN = "tok-123"
+
+
+class _Store:
+    def __init__(self):
+        self.objects: dict[str, bytes] = {}
+        self.auth_failures = 0
+
+
+def _serve(handler_cls):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+# -- S3 fake: recomputes SigV4 ----------------------------------------------
+
+
+def _s3_fake(store: _Store):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _verify(self, payload: bytes) -> bool:
+            amz_date = self.headers.get("x-amz-date", "")
+            try:
+                now = datetime.datetime.strptime(
+                    amz_date, "%Y%m%dT%H%M%SZ"
+                ).replace(tzinfo=datetime.timezone.utc)
+            except ValueError:
+                return False
+            url = f"http://{self.headers['Host']}{self.path}"
+            want = sigv4_headers(
+                self.command, url,
+                access_key=ACCESS, secret_key=SECRET, payload=payload, now=now,
+            )["Authorization"]
+            if want != self.headers.get("Authorization", ""):
+                store.auth_failures += 1
+                return False
+            return True
+
+        def _reply(self, code, body=b"", ctype="application/xml"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = self.rfile.read(n)
+            if not self._verify(payload):
+                return self._reply(403, b"<Error>SignatureDoesNotMatch</Error>")
+            key = urllib.parse.unquote(self.path.split("/", 2)[2])
+            store.objects[key] = payload
+            self._reply(200)
+
+        def do_GET(self):
+            if not self._verify(b""):
+                return self._reply(403, b"<Error>SignatureDoesNotMatch</Error>")
+            u = urllib.parse.urlsplit(self.path)
+            q = dict(urllib.parse.parse_qsl(u.query))
+            if q.get("list-type") == "2":
+                prefix = q.get("prefix", "")
+                keys = sorted(k for k in store.objects if k.startswith(prefix))
+                xml = (
+                    '<?xml version="1.0"?>'
+                    '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                    + "".join(
+                        f"<Contents><Key>{escape(k)}</Key></Contents>" for k in keys
+                    )
+                    + "</ListBucketResult>"
+                )
+                return self._reply(200, xml.encode())
+            key = urllib.parse.unquote(u.path.split("/", 2)[2])
+            if key not in store.objects:
+                return self._reply(404, b"<Error>NoSuchKey</Error>")
+            self._reply(200, store.objects[key], "application/octet-stream")
+
+        def do_DELETE(self):
+            if not self._verify(b""):
+                return self._reply(403, b"<Error>SignatureDoesNotMatch</Error>")
+            key = urllib.parse.unquote(self.path.split("/", 2)[2])
+            store.objects.pop(key, None)
+            self._reply(204)
+
+    return Handler
+
+
+@pytest.fixture()
+def s3(tmp_path):
+    store = _Store()
+    httpd = _serve(_s3_fake(store))
+    fs = HttpS3FS(
+        f"http://127.0.0.1:{httpd.server_port}", "bkt",
+        access_key=ACCESS, secret_key=SECRET, prefix="base",
+    )
+    yield fs, store, tmp_path
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def test_s3_sigv4_roundtrip(s3):
+    fs, store, tmp = s3
+    src = tmp / "a.txt"
+    src.write_bytes(b"hello sigv4")
+    fs.put("dir/a.txt", src)
+    assert list(store.objects) == ["base/dir/a.txt"]
+    dst = tmp / "out" / "a.txt"
+    fs.get("dir/a.txt", dst)
+    assert dst.read_bytes() == b"hello sigv4"
+    assert fs.list("dir") == ["dir/a.txt"]
+    assert fs.list("dir-sibling") == []  # directory semantics
+    fs.delete("dir/a.txt")
+    assert fs.list("dir") == []
+    assert store.auth_failures == 0
+
+
+def test_s3_wrong_secret_rejected_at_wire(s3):
+    fs, store, tmp = s3
+    bad = HttpS3FS(
+        fs.endpoint, "bkt", access_key=ACCESS, secret_key="wrong", prefix="base"
+    )
+    src = tmp / "b.txt"
+    src.write_bytes(b"x")
+    with pytest.raises(ObjectStoreError) as ei:
+        bad.put("b.txt", src)
+    assert ei.value.status == 403
+    assert store.auth_failures == 1
+    assert not store.objects  # nothing stored on auth failure
+
+
+def test_s3_backup_restore_through_wire(s3):
+    from banyandb_tpu.admin import backup as bk
+
+    fs, store, tmp = s3
+    data = tmp / "data"
+    (data / "seg").mkdir(parents=True)
+    (data / "seg" / "part.bin").write_bytes(b"\x01" * 2048)
+    (data / "meta.json").write_text("{}")
+    name = bk.backup(data, fs)
+    assert any(k.startswith(f"base/{name}/") for k in store.objects)
+    out = tmp / "restored"
+    bk.restore(fs, name, out)
+    assert (out / "seg" / "part.bin").read_bytes() == b"\x01" * 2048
+    assert (out / "meta.json").read_text() == "{}"
+
+
+# -- GCS fake: bearer token --------------------------------------------------
+
+
+def _gcs_fake(store: _Store):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _auth(self) -> bool:
+            ok = self.headers.get("Authorization") == f"Bearer {GCS_TOKEN}"
+            if not ok:
+                store.auth_failures += 1
+            return ok
+
+        def _reply(self, code, body=b"", ctype="application/json"):
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Type", ctype)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if not self._auth():
+                return self._reply(401, b'{"error":"unauthorized"}')
+            n = int(self.headers.get("Content-Length") or 0)
+            q = dict(urllib.parse.parse_qsl(urllib.parse.urlsplit(self.path).query))
+            store.objects[q["name"]] = self.rfile.read(n)
+            self._reply(200, b"{}")
+
+        def do_GET(self):
+            if not self._auth():
+                return self._reply(401, b'{"error":"unauthorized"}')
+            u = urllib.parse.urlsplit(self.path)
+            q = dict(urllib.parse.parse_qsl(u.query))
+            if u.path.endswith("/o") and "prefix" in q:
+                items = [
+                    {"name": k}
+                    for k in sorted(store.objects)
+                    if k.startswith(q["prefix"])
+                ]
+                return self._reply(200, json.dumps({"items": items}).encode())
+            name = urllib.parse.unquote(u.path.rsplit("/o/", 1)[1])
+            if name not in store.objects:
+                return self._reply(404, b'{"error":"notFound"}')
+            self._reply(200, store.objects[name], "application/octet-stream")
+
+    return Handler
+
+
+def test_gcs_json_api_roundtrip(tmp_path):
+    store = _Store()
+    httpd = _serve(_gcs_fake(store))
+    try:
+        fs = HttpGcsFS(
+            f"http://127.0.0.1:{httpd.server_port}", "bkt",
+            token_fn=lambda: GCS_TOKEN, prefix="p",
+        )
+        src = tmp_path / "x.bin"
+        src.write_bytes(b"gcs-bytes")
+        fs.put("d/x.bin", src)
+        assert list(store.objects) == ["p/d/x.bin"]
+        dst = tmp_path / "out.bin"
+        fs.get("d/x.bin", dst)
+        assert dst.read_bytes() == b"gcs-bytes"
+        assert fs.list("d") == ["d/x.bin"]
+
+        bad = HttpGcsFS(
+            f"http://127.0.0.1:{httpd.server_port}", "bkt",
+            token_fn=lambda: "stale", prefix="p",
+        )
+        with pytest.raises(ObjectStoreError) as ei:
+            bad.list("d")
+        assert ei.value.status == 401 and store.auth_failures == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# -- Azure fake: SharedKey recomputation -------------------------------------
+
+
+def _azure_fake(store: _Store):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _verify(self, content_length: int) -> bool:
+            url = f"http://{self.headers['Host']}{self.path}"
+            hdrs = {
+                k.lower(): v
+                for k, v in self.headers.items()
+                if k.lower().startswith("x-ms-")
+            }
+            want = azure_sharedkey_auth(
+                self.command, url,
+                account=AZ_ACCOUNT, key_b64=AZ_KEY,
+                content_length=content_length, extra_headers=hdrs,
+            )
+            ok = want == self.headers.get("Authorization", "")
+            if not ok:
+                store.auth_failures += 1
+            return ok
+
+        def _reply(self, code, body=b""):
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            payload = self.rfile.read(n)
+            if not self._verify(n):
+                return self._reply(403, b"auth failed")
+            key = urllib.parse.unquote(self.path.split("/", 2)[2])
+            store.objects[key] = payload
+            self._reply(201)
+
+        def do_GET(self):
+            if not self._verify(0):
+                return self._reply(403, b"auth failed")
+            u = urllib.parse.urlsplit(self.path)
+            q = dict(urllib.parse.parse_qsl(u.query))
+            if q.get("comp") == "list":
+                prefix = q.get("prefix", "")
+                xml = (
+                    '<?xml version="1.0"?><EnumerationResults><Blobs>'
+                    + "".join(
+                        f"<Blob><Name>{escape(k)}</Name></Blob>"
+                        for k in sorted(store.objects)
+                        if k.startswith(prefix)
+                    )
+                    + "</Blobs></EnumerationResults>"
+                )
+                return self._reply(200, xml.encode())
+            key = urllib.parse.unquote(u.path.split("/", 2)[2])
+            if key not in store.objects:
+                return self._reply(404)
+            self._reply(200, store.objects[key])
+
+    return Handler
+
+
+def test_azure_sharedkey_roundtrip(tmp_path):
+    store = _Store()
+    httpd = _serve(_azure_fake(store))
+    try:
+        fs = HttpAzureBlobFS(
+            f"http://127.0.0.1:{httpd.server_port}", "cont",
+            account=AZ_ACCOUNT, key_b64=AZ_KEY, prefix="pre",
+        )
+        src = tmp_path / "z.bin"
+        src.write_bytes(b"azure-bytes")
+        fs.put("d/z.bin", src)
+        assert list(store.objects) == ["pre/d/z.bin"]
+        dst = tmp_path / "back.bin"
+        fs.get("d/z.bin", dst)
+        assert dst.read_bytes() == b"azure-bytes"
+        assert fs.list("d") == ["d/z.bin"]
+        assert store.auth_failures == 0
+
+        bad = HttpAzureBlobFS(
+            f"http://127.0.0.1:{httpd.server_port}", "cont",
+            account=AZ_ACCOUNT, key_b64="d3Jvbmd3cm9uZw==", prefix="pre",
+        )
+        with pytest.raises(ObjectStoreError) as ei:
+            bad.put("d/w.bin", src)
+        assert ei.value.status == 403 and store.auth_failures == 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_s3_key_with_space_single_encoded(s3):
+    """The canonical URI must be the as-sent (once-encoded) path; a key
+    needing escaping exercises that (double-encoding would 403 here if
+    the fake signed the raw path, and on real S3 either way)."""
+    fs, store, tmp = s3
+    src = tmp / "sp.txt"
+    src.write_bytes(b"spaced")
+    fs.put("dir/a b+c.txt", src)
+    assert list(store.objects) == ["base/dir/a b+c.txt"]
+    dst = tmp / "sp-out.txt"
+    fs.get("dir/a b+c.txt", dst)
+    assert dst.read_bytes() == b"spaced"
+    assert store.auth_failures == 0
+
+
+def test_drivers_paginate_listings(tmp_path):
+    """GCS nextPageToken and Azure NextMarker are followed (silent
+    truncation at the provider page size would corrupt restores)."""
+    store = _Store()
+
+    # GCS fake that serves 2-item pages
+    base = _gcs_fake(store)
+
+    class Paged(base):
+        def do_GET(self):
+            if not self._auth():
+                return self._reply(401, b"{}")
+            u = urllib.parse.urlsplit(self.path)
+            q = dict(urllib.parse.parse_qsl(u.query))
+            if u.path.endswith("/o") and "prefix" in q:
+                keys = sorted(
+                    k for k in store.objects if k.startswith(q["prefix"])
+                )
+                start = int(q.get("pageToken") or 0)
+                page = keys[start : start + 2]
+                body = {"items": [{"name": k} for k in page]}
+                if start + 2 < len(keys):
+                    body["nextPageToken"] = str(start + 2)
+                return self._reply(200, json.dumps(body).encode())
+            return base.do_GET(self)
+
+    httpd = _serve(Paged)
+    try:
+        fs = HttpGcsFS(
+            f"http://127.0.0.1:{httpd.server_port}", "bkt",
+            token_fn=lambda: GCS_TOKEN,
+        )
+        for i in range(5):
+            store.objects[f"d/k{i}"] = b"x"
+        assert fs.list("d") == [f"d/k{i}" for i in range(5)]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
