@@ -297,7 +297,36 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
         for fname, fv in row.get("fields", {}).items():
             f = dp.fields.add(name=fname)
             f.value.CopyFrom(py_to_field_value(fv))
+    fill_trace(out, res)
     return out
+
+
+def fill_trace(out, res) -> None:
+    """Attach in-band query-trace spans to a QueryResponse proto
+    (common/v1 Trace; the reference threads pkg/query/tracer spans back
+    the same way — dquery/measure.go:104).  Each key of the internal
+    trace dict becomes one span; the plan rendering rides the span
+    message so `trace=true` clients see the plan tree on the wire."""
+    tr = getattr(res, "trace", None)
+    if not tr or not hasattr(out, "trace"):
+        return
+
+    def add_span(message: str, fields: dict) -> None:
+        span = out.trace.spans.add()
+        span.message = message
+        for k, v in fields.items():
+            span.tags.add(key=str(k), value=str(v))
+
+    for key, val in tr.items():
+        if isinstance(val, list) and all(isinstance(x, dict) for x in val):
+            # per-phase span lists (measure _trace_spans): one proto span
+            # each, named by the entry's own name where present
+            for i, entry in enumerate(val):
+                add_span(str(entry.get("name", f"{key}[{i}]")), entry)
+        elif isinstance(val, dict):
+            add_span(key, val)
+        else:
+            add_span(f"{key}: {val}", {})
 
 
 def _has_tag(spec, name: str) -> bool:
@@ -399,6 +428,7 @@ def stream_result_to_pb(res):
         for t, v in row.get("tags", {}).items():
             tag = fam.tags.add(key=t)
             tag.value.CopyFrom(py_to_tag_value(v))
+    fill_trace(out, res)
     return out
 
 

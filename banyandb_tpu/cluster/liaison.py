@@ -431,7 +431,12 @@ class Liaison:
             from banyandb_tpu.parallel.mesh_query import MeshUnsupported
 
             try:
-                return mesh_exec.execute(m, req, assignment)
+                res = mesh_exec.execute(m, req, assignment)
+                self._attach_distributed_plan(
+                    res, m, req, assignment,
+                    combine="mesh psum/pmin/pmax collectives (fast path)",
+                )
+                return res
             except MeshUnsupported:
                 pass  # general scatter path below
 
@@ -456,6 +461,9 @@ class Liaison:
             _sort_merged_rows(rows, req)
             res = QueryResult()
             res.data_points = rows[off : off + limit]
+            self._attach_distributed_plan(
+                res, m, req, assignment, combine="row merge (host ts sort)"
+            )
             return res
 
         want_percentile = bool(req.agg and req.agg.function == "percentile")
@@ -476,7 +484,32 @@ class Liaison:
             hist_range = (lo, max(hi - lo, 1e-6))
 
         partials = self._scatter_partials(req, assignment, hist_range)
-        return measure_exec.finalize_partials(m, req, partials)
+        res = measure_exec.finalize_partials(m, req, partials)
+        self._attach_distributed_plan(
+            res, m, req, assignment,
+            combine="host combine_partials (f64 Kahan)",
+            percentile="two-round range agreement" if want_percentile else "",
+        )
+        return res
+
+    def _attach_distributed_plan(
+        self, res, m, req, assignment, *, combine: str, percentile: str = ""
+    ) -> None:
+        """Distributed plan tree rides the in-band trace, labeled with the
+        combine leg that ACTUALLY ran (measure_plan_distributed.go +
+        dquery/measure.go:104 analog)."""
+        if not req.trace:
+            return
+        from banyandb_tpu.query import logical
+
+        plan = logical.analyze_measure_distributed(
+            m, req, [n.name for n in assignment]
+        )
+        plan.props["combine"] = combine
+        if percentile:
+            plan.props["percentile"] = percentile
+        res.trace = dict(res.trace or {})
+        res.trace["plan"] = plan.explain()
 
 
     def _route_items(self, items, shard_of) -> tuple[dict, dict, dict]:

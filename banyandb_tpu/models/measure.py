@@ -281,12 +281,21 @@ class MeasureEngine:
     # -- query path (query.go:88 analog) -----------------------------------
     def query(self, req: QueryRequest, shard_ids=None) -> QueryResult:
         """Execute; when req.trace is set, attach in-band trace spans
-        (pkg/query/tracer.go analog: spans ride back in the response)."""
+        (pkg/query/tracer.go analog: spans ride back in the response).
+
+        Routing decisions come off the logical plan tree
+        (query/logical.py, measure_analyzer.go:70 analog): the analyzer
+        is the single owner of index-mode short-circuit and aggregate-vs-
+        raw selection; this method lowers the tree onto the fused
+        executors."""
+        from banyandb_tpu.query import logical
+
         t_start = time.perf_counter()
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
-        if m.index_mode:
+        plan = logical.analyze_measure(m, req)
+        if plan.leaf().kind == "IndexModeScan":
             # Short-circuit: whole measure lives in the series index
             # (SearchWithoutSeries, measure/query.go:506,559).
             sources = self._index_sources(db, m, req, shard_ids)
@@ -302,7 +311,7 @@ class MeasureEngine:
                     if attempt == 2:
                         raise
         t_gather = time.perf_counter()
-        if req.agg or req.group_by or req.top:
+        if plan.find("GroupByAggregate") is not None:
             res = measure_exec.execute_aggregate(
                 m, req, sources, dict_state=self._dict_state(group, req.name)
             )
@@ -310,6 +319,7 @@ class MeasureEngine:
             res = _raw_rows(m, req, sources)
         if req.trace:
             res.trace = _trace_spans(t_start, t_gather, sources, m.index_mode)
+            res.trace["plan"] = plan.explain()
         return res
 
     def query_partials(

@@ -204,6 +204,13 @@ class StreamEngine:
                     "body": body,
                 }
             )
+        if req.trace:
+            from banyandb_tpu.query import logical
+
+            res.trace = {
+                "plan": logical.analyze_stream(s, req).explain(),
+                "rows_scanned": len(rows),
+            }
         return res
 
     def _scan(

@@ -46,6 +46,10 @@ def _http(req: urllib.request.Request) -> bytes:
             return r.read()
     except urllib.error.HTTPError as e:
         raise ObjectStoreError(e.code, e.read().decode("utf-8", "replace")) from e
+    except (urllib.error.URLError, OSError) as e:
+        # connection-level failures (refused, DNS, TLS, socket timeout)
+        # surface as the module's error type; status 0 = no HTTP reply
+        raise ObjectStoreError(0, f"connection failed: {e}") from e
 
 
 # -- AWS Signature Version 4 -------------------------------------------------

@@ -1,0 +1,136 @@
+"""Logical plan trees (query/logical.py; reference pkg/query/logical
+analyzers + plan String() rendering in the in-band query trace)."""
+
+import pytest
+
+from banyandb_tpu.api.model import (
+    Aggregation,
+    Condition,
+    GroupBy,
+    LogicalExpression,
+    QueryRequest,
+    TimeRange,
+    Top,
+)
+from banyandb_tpu.query import logical
+
+
+class _M:
+    group, name, index_mode = "g", "m", False
+
+
+class _MIdx(_M):
+    index_mode = True
+
+
+def _req(**kw):
+    base = dict(
+        groups=("g",), name="m", time_range=TimeRange(0, 1000), limit=100
+    )
+    base.update(kw)
+    return QueryRequest(**base)
+
+
+def test_measure_aggregate_plan_shape():
+    req = _req(
+        criteria=LogicalExpression(
+            "or", Condition("svc", "eq", "a"), Condition("svc", "eq", "b")
+        ),
+        group_by=GroupBy(("svc",)),
+        agg=Aggregation("sum", "v"),
+        top=Top(5, "sum(v)"),
+        offset=10,
+    )
+    plan = logical.analyze_measure(_M(), req)
+    # OffsetLimit -> Top -> GroupByAggregate -> IndexScan
+    kinds = []
+    n = plan
+    while True:
+        kinds.append(n.kind)
+        if not n.children:
+            break
+        n = n.children[0]
+    assert kinds == ["OffsetLimit", "Top", "GroupByAggregate", "IndexScan"]
+    text = plan.explain()
+    assert "sum(v)" in text
+    assert "(svc eq 'a' OR svc eq 'b')" in text
+    assert "fused jit PlanSpec" in text
+    assert text.splitlines()[0].startswith("OffsetLimit")
+    # indentation deepens down the chain
+    assert text.splitlines()[-1].startswith("      IndexScan")
+
+
+def test_measure_index_mode_short_circuit_in_plan():
+    plan = logical.analyze_measure(_MIdx(), _req())
+    assert plan.leaf().kind == "IndexModeScan"
+    assert "SearchWithoutSeries" in plan.explain()
+
+
+def test_raw_scan_plan_has_sort_not_aggregate():
+    plan = logical.analyze_measure(_M(), _req(order_by_ts="desc"))
+    assert plan.find("GroupByAggregate") is None
+    assert plan.find("Sort").props["order"] == "ts desc"
+
+
+def test_distributed_plan_wraps_local():
+    req = _req(agg=Aggregation("mean", "v"), group_by=GroupBy(("svc",)))
+    plan = logical.analyze_measure_distributed(_M(), req, ["dn1", "dn2"])
+    assert plan.kind == "DistributedMerge" and plan.props["nodes"] == 2
+    assert plan.find("GroupByAggregate") is not None
+    # the combine label defaults to the host leg; callers relabel with
+    # the leg that actually ran (liaison._attach_distributed_plan)
+    assert "host combine_partials" in plan.props["combine"]
+
+
+def test_stream_plan_order_by_index_fork():
+    class _S:
+        group, name = "g", "s"
+
+    by_idx = logical.analyze_stream(_S(), _req(order_by_tag="svc"))
+    assert by_idx.find("SortByIndex") is not None
+    by_ts = logical.analyze_stream(_S(), _req())
+    assert by_ts.find("SortByIndex") is None
+    assert "ts desc" in by_ts.find("Sort").props["order"]
+
+
+def test_trace_plan_forks_on_lookup_kind():
+    class _T:
+        group, name = "g", "t"
+
+    by_id = logical.analyze_trace(_T(), trace_id="abc", limit=10)
+    assert by_id.find("TraceIDScan") is not None
+    assert "bloom" in by_id.explain()
+    ordered = logical.analyze_trace(_T(), order_by_key=True)
+    assert ordered.find("SidxScan") is not None
+
+
+def test_plan_execute_raises_without_executor():
+    plan = logical.analyze_measure(_M(), _req())
+    with pytest.raises(RuntimeError, match="no executor"):
+        plan.execute()
+
+
+def test_engine_attaches_plan_to_trace(tmp_path):
+    """End-to-end: the measure engine routes via the plan and returns the
+    explain rendering in the in-band trace."""
+    from banyandb_tpu.api.schema import (
+        Catalog, Entity, FieldSpec, FieldType, Group, Measure, ResourceOpts,
+        SchemaRegistry, TagSpec, TagType,
+    )
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(Measure(
+        group="g", name="m", tags=(TagSpec("svc", TagType.STRING),),
+        fields=(FieldSpec("v", FieldType.INT),), entity=Entity(("svc",))))
+    eng = MeasureEngine(reg, tmp_path / "data")
+    from banyandb_tpu.api.model import DataPointValue, WriteRequest
+
+    eng.write(WriteRequest("g", "m", tuple(
+        DataPointValue(100 + i, {"svc": "a"}, {"v": i}) for i in range(4))))
+    res = eng.query(_req(
+        agg=Aggregation("sum", "v"), group_by=GroupBy(("svc",)), trace=True))
+    assert res.values["sum(v)"] == [6.0]
+    assert "GroupByAggregate" in res.trace["plan"]
+    assert "IndexScan" in res.trace["plan"]

@@ -360,8 +360,8 @@ def test_s3_key_with_space_single_encoded(s3):
 
 
 def test_drivers_paginate_listings(tmp_path):
-    """GCS nextPageToken and Azure NextMarker are followed (silent
-    truncation at the provider page size would corrupt restores)."""
+    """GCS nextPageToken is followed (silent truncation at the provider
+    page size would corrupt restores); S3/Azure below."""
     store = _Store()
 
     # GCS fake that serves 2-item pages
@@ -397,3 +397,102 @@ def test_drivers_paginate_listings(tmp_path):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_s3_list_follows_continuation_token():
+    store = _Store()
+    base = _s3_fake(store)
+
+    class Paged(base):
+        def do_GET(self):
+            u = urllib.parse.urlsplit(self.path)
+            q = dict(urllib.parse.parse_qsl(u.query))
+            if q.get("list-type") == "2":
+                if not self._verify(b""):
+                    return self._reply(403, b"<Error/>")
+                keys = sorted(
+                    k for k in store.objects if k.startswith(q.get("prefix", ""))
+                )
+                start = int(q.get("continuation-token") or 0)
+                page = keys[start : start + 2]
+                nxt = (
+                    f"<NextContinuationToken>{start + 2}</NextContinuationToken>"
+                    if start + 2 < len(keys)
+                    else ""
+                )
+                xml = (
+                    '<?xml version="1.0"?>'
+                    '<ListBucketResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                    + "".join(f"<Contents><Key>{escape(k)}</Key></Contents>" for k in page)
+                    + nxt + "</ListBucketResult>"
+                )
+                return self._reply(200, xml.encode())
+            return base.do_GET(self)
+
+    httpd = _serve(Paged)
+    try:
+        fs = HttpS3FS(
+            f"http://127.0.0.1:{httpd.server_port}", "bkt",
+            access_key=ACCESS, secret_key=SECRET,
+        )
+        for i in range(5):
+            store.objects[f"d/k{i}"] = b"x"
+        assert fs.list("d") == [f"d/k{i}" for i in range(5)]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_azure_list_follows_next_marker():
+    store = _Store()
+    base = _azure_fake(store)
+
+    class Paged(base):
+        def do_GET(self):
+            u = urllib.parse.urlsplit(self.path)
+            q = dict(urllib.parse.parse_qsl(u.query))
+            if q.get("comp") == "list":
+                if not self._verify(0):
+                    return self._reply(403, b"auth failed")
+                keys = sorted(
+                    k for k in store.objects if k.startswith(q.get("prefix", ""))
+                )
+                start = int(q.get("marker") or 0)
+                page = keys[start : start + 2]
+                nxt = (
+                    f"<NextMarker>{start + 2}</NextMarker>"
+                    if start + 2 < len(keys)
+                    else ""
+                )
+                xml = (
+                    '<?xml version="1.0"?><EnumerationResults><Blobs>'
+                    + "".join(f"<Blob><Name>{escape(k)}</Name></Blob>" for k in page)
+                    + "</Blobs>" + nxt + "</EnumerationResults>"
+                )
+                return self._reply(200, xml.encode())
+            return base.do_GET(self)
+
+    httpd = _serve(Paged)
+    try:
+        fs = HttpAzureBlobFS(
+            f"http://127.0.0.1:{httpd.server_port}", "cont",
+            account=AZ_ACCOUNT, key_b64=AZ_KEY,
+        )
+        for i in range(5):
+            store.objects[f"d/k{i}"] = b"x"
+        assert fs.list("d") == [f"d/k{i}" for i in range(5)]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_connection_failure_is_object_store_error():
+    from banyandb_tpu.utils.object_store import ObjectStoreError
+
+    fs = HttpS3FS(
+        "http://127.0.0.1:9",  # discard port: connection refused
+        "bkt", access_key=ACCESS, secret_key=SECRET,
+    )
+    with pytest.raises(ObjectStoreError) as ei:
+        fs.list("x")
+    assert ei.value.status == 0
