@@ -114,13 +114,42 @@ class InvertedIndex:
         self._postings: dict[tuple[str, bytes], np.ndarray] = {}
         self._numeric: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         self._all_ids: np.ndarray = np.zeros(0, dtype=np.int64)
+        # set by reclaim(): in-memory state dropped, reload before any op
+        self._released = False
         if self.path and self.path.exists():
             self._load()
+
+    def reclaim(self) -> None:
+        """Persist, then release all in-memory state (idle-segment memory
+        reclaim, segment.go:334 closeIdleSegments analog).
+
+        The index object stays valid — every operation lazily reloads from
+        the persisted file first — so concurrent holders of this instance
+        never observe a dropped index, only a reload cost."""
+        with self._lock:
+            if not self.path or self._released:
+                return  # memory-only indexes have no file to reload from
+            self.persist()
+            self._docs = {}
+            self._pending = {}
+            self._postings = {}
+            self._numeric = {}
+            self._all_ids = np.zeros(0, dtype=np.int64)
+            self._dirty = True
+            self._released = True
+
+    def _ensure_loaded(self) -> None:
+        """Reload after reclaim(). Caller holds self._lock."""
+        if self._released:
+            self._released = False
+            if self.path.exists():
+                self._load()
 
     # -- mutation ----------------------------------------------------------
     def insert(self, docs: Iterable[Doc]) -> None:
         """Insert or overwrite by doc_id (ModRevision-style last-write-wins)."""
         with self._lock:
+            self._ensure_loaded()
             for d in docs:
                 if not self._dirty and d.doc_id in self._docs and d.doc_id not in self._pending:
                     # overwrite of a built doc: postings hold stale entries
@@ -135,6 +164,7 @@ class InvertedIndex:
     ) -> bool:
         """Atomic check-and-insert: keep the doc with the higher version."""
         with self._lock:
+            self._ensure_loaded()
             old = self._docs.get(doc.doc_id)
             if old is not None and old.numerics.get(version_field, 0) >= doc.numerics.get(version_field, 0):
                 return False
@@ -143,13 +173,16 @@ class InvertedIndex:
 
     def delete(self, doc_ids: Iterable[int]) -> None:
         with self._lock:
+            self._ensure_loaded()
             for i in doc_ids:
                 if self._docs.pop(i, None) is not None:
                     self._pending.pop(i, None)
                     self._dirty = True
 
     def __len__(self) -> int:
-        return len(self._docs)
+        with self._lock:
+            self._ensure_loaded()
+            return len(self._docs)
 
     # -- build -------------------------------------------------------------
     def _rebuild(self) -> None:
@@ -175,6 +208,7 @@ class InvertedIndex:
         self._dirty = False
 
     def _ensure(self) -> None:
+        self._ensure_loaded()
         if self._dirty:
             self._rebuild()
 
@@ -267,19 +301,27 @@ class InvertedIndex:
 
     def get(self, doc_id: int) -> Optional[Doc]:
         with self._lock:
+            self._ensure_loaded()
             return self._docs.get(doc_id)
 
     def get_many(self, doc_ids: Sequence[int]) -> list[Doc]:
         with self._lock:
+            self._ensure_loaded()
             return [self._docs[i] for i in doc_ids if i in self._docs]
 
     # -- persistence -------------------------------------------------------
-    _MAGIC = b"BTIX1\n"
+    # v2: keyword columns carry presence bitmaps like numeric ones, so an
+    # explicitly-empty keyword value (b"") survives the persist/_load round
+    # trip — routine since idle reclaim, not just restart
+    _MAGIC = b"BTIX2\n"
 
     def persist(self) -> None:
         if not self.path:
             return
         with self._lock:
+            if self._released:
+                return  # state already on disk; persisting now would
+                # truncate the file to the (empty) in-memory doc set
             ids = sorted(self._docs.keys())
             kw_names = sorted({f for d in self._docs.values() for f in d.keywords})
             num_names = sorted({f for d in self._docs.values() for f in d.numerics})
@@ -291,6 +333,14 @@ class InvertedIndex:
                 blobs.append(
                     enc.encode_strings(
                         [self._docs[i].keywords.get(f, b"") for i in ids]
+                    )
+                )
+                blobs.append(
+                    enc.encode_int64(
+                        np.asarray(
+                            [1 if f in self._docs[i].keywords else 0 for i in ids],
+                            dtype=np.int64,
+                        )
                     )
                 )
             for f in num_names:
@@ -335,7 +385,11 @@ class InvertedIndex:
         kw_names = [b.decode() for b in enc.decode_strings(next(it))]
         num_names = [b.decode() for b in enc.decode_strings(next(it))]
         # decode kw columns first to learn n
-        kw_cols = {f: enc.decode_strings(next(it)) for f in kw_names}
+        kw_cols = {}
+        kw_present = {}
+        for f in kw_names:
+            kw_cols[f] = enc.decode_strings(next(it))
+            kw_present[f] = enc.decode_int64(next(it), len(kw_cols[f]))
         n = len(next(iter(kw_cols.values()))) if kw_cols else None
         num_cols = {}
         num_present = {}
@@ -356,7 +410,7 @@ class InvertedIndex:
             self._docs[int(ids[i])] = Doc(
                 doc_id=int(ids[i]),
                 keywords={
-                    f: kw_cols[f][i] for f in kw_names if kw_cols[f][i] != b""
+                    f: kw_cols[f][i] for f in kw_names if kw_present[f][i]
                 },
                 numerics={
                     f: int(num_cols[f][i])

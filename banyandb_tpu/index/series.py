@@ -58,5 +58,9 @@ class SeriesIndex:
     def persist(self) -> None:
         self._idx.persist()
 
+    def reclaim(self) -> None:
+        """Persist + release memory; reloads lazily on next access."""
+        self._idx.reclaim()
+
     def __len__(self) -> int:
         return len(self._idx)

@@ -80,6 +80,7 @@ class LifecycleLoops:
         flush_min_rows: int = 1,
         retention_interval_s: float = 60.0,
         merge_sweep_interval_s: float = 10.0,
+        idle_timeout_s: float = 600.0,
         clock: Callable[[], float] = time.time,
         extra_tick: Optional[Callable[[], None]] = None,
         pre_flush: Optional[Callable[[], None]] = None,
@@ -90,6 +91,8 @@ class LifecycleLoops:
         self.flush_min_rows = flush_min_rows
         self.retention_interval_s = retention_interval_s
         self.merge_sweep_interval_s = merge_sweep_interval_s
+        self.idle_timeout_s = idle_timeout_s
+        self._last_idle_check = 0.0
         self._clock = clock
         self._extra_tick = extra_tick
         self._stop = threading.Event()
@@ -170,6 +173,31 @@ class LifecycleLoops:
         self._last_retention = now
         return retired
 
+    def rotation_stage(self) -> int:
+        """Pre-create upcoming segments + reclaim idle ones
+        (rotation.go:52 startRotationTask body).
+
+        Runs on the retainer thread each pass: ticks are driven by each
+        TSDB's write-event high-water mark — NOT wall clock — matching the
+        reference (rotation.go Tick fires from write timestamps), so a
+        write-idle group stops accreting empty segments.  TSDB.tick
+        throttles itself (tick_snap); the idle check fires at most once
+        per timeout interval (the 10-minute idleCheckTicker analog)."""
+        now = self._clock()
+        created = 0
+        for db in self._tsdbs():
+            if db.tick(db.max_event_ms):
+                created += 1
+        if self.idle_timeout_s > 0 and (
+            now - self._last_idle_check >= self.idle_timeout_s
+        ):
+            self._last_idle_check = now
+            for db in self._tsdbs():
+                # no now_s: each TSDB compares against its own clock, the
+                # same domain its segments' touch() timestamps come from
+                db.close_idle_segments(self.idle_timeout_s)
+        return created
+
     def tick(self) -> dict:
         """One synchronous round of every stage (tests/manual driving)."""
         stats = {"flushed": 0, "merged": 0, "retired": 0}
@@ -183,6 +211,7 @@ class LifecycleLoops:
             stats["merged"] += self.merge_shard(shard)
         stats["merged"] += self.merge_sweep()
         stats["retired"] = self.retention_stage(force=False)
+        stats["precreated"] = self.rotation_stage()
         return stats
 
     # -- threads ------------------------------------------------------------
@@ -214,6 +243,7 @@ class LifecycleLoops:
     def _retainer(self) -> None:
         while not self._stop.wait(min(self.retention_interval_s, 5.0)):
             self._guard(lambda: self.retention_stage(False), "retention")
+            self._guard(lambda: self.rotation_stage(), "rotation")
 
     def start(self) -> None:
         if self._threads:

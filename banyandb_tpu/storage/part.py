@@ -177,15 +177,28 @@ class Part:
     def max_ts(self) -> int:
         return self.meta["max_ts"]
 
+    def release_cached(self) -> None:
+        """Drop lazily-decoded dictionaries (idle-segment reclaim).
+
+        Decoded column blocks live in the byte-budgeted serving cache and
+        age out on their own; the per-part dict cache is the only unbounded
+        in-object state, so it is what segment reclaim releases."""
+        self._dicts.clear()
+
     def dict_for(self, tag: str) -> list[bytes]:
-        if tag not in self._dicts:
+        # single dict.get / dict.set ops only (atomic under the GIL):
+        # a concurrent release_cached() clear between them just costs a
+        # reload, never a KeyError for the in-flight reader
+        d = self._dicts.get(tag)
+        if d is None:
             path = self.dir / f"tag_{tag}.dict"
             if not path.exists():
-                self._dicts[tag] = []
+                d = []
             else:
                 with open(path, "rb") as f:
-                    self._dicts[tag] = enc.decode_strings(f.read())
-        return self._dicts[tag]
+                    d = enc.decode_strings(f.read())
+            self._dicts[tag] = d
+        return d
 
     def select_blocks(
         self,

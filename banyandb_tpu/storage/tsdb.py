@@ -20,6 +20,7 @@ from __future__ import annotations
 import datetime as dt
 import os
 import threading
+import time
 from pathlib import Path
 from typing import Callable, Iterator, Optional
 
@@ -303,10 +304,12 @@ class Segment:
         mem_factory: Callable[[], MemTable],
         merge_filter_provider: Optional[Callable] = None,
         part_built_provider: Optional[Callable] = None,
+        clock: Callable[[], float] = time.time,
     ):
         self.root = root
         self.start = start_millis
         self.end = start_millis + interval_millis
+        self._clock = clock
         self.shards = [
             Shard(
                 root / f"shard-{i}",
@@ -318,6 +321,34 @@ class Segment:
         ]
         self._sidx = None
         self._sidx_lock = threading.Lock()
+        # idle-reclaim clock (segment.go:81 lastAccessed analog): bumped by
+        # real read/write touches, NOT by background loops walking segments
+        self.last_accessed = clock()
+        self._reclaimed = False
+
+    def touch(self) -> None:
+        self.last_accessed = self._clock()
+        # caches may repopulate from here on: eligible for reclaim again
+        self._reclaimed = False
+
+    def reset_index(self) -> None:
+        """Persist + release the series index's memory and per-part
+        dictionary caches (segmentController.closeIdleSegments /
+        segment.resetIndex analog, rotation.go:134, segment.go:334).
+
+        The reference's motivation transfers directly: without reclaim,
+        per-segment index writers accumulate across rotations.  `_sidx`
+        keeps its identity (never reset to None) — concurrent holders see
+        the same object, whose internal lock serializes reclaim against
+        in-flight inserts/searches and lazily reloads on next use."""
+        with self._sidx_lock:
+            sidx = self._sidx
+        if sidx is not None:
+            sidx.reclaim()
+        for shard in self.shards:
+            for part in shard.parts:
+                part.release_cached()
+        self._reclaimed = True
 
     @property
     def series_index(self):
@@ -346,10 +377,12 @@ class TSDB:
         group: str,
         opts: ResourceOpts,
         mem_factory: Callable[[], MemTable],
+        clock: Callable[[], float] = time.time,
     ):
         self.root = Path(root) / group
         self.opts = opts
         self.mem_factory = mem_factory
+        self._clock = clock
         self._lock = threading.Lock()
         self._segments: dict[int, Segment] = {}
         # Optional merge-time row filter: fn(kind, name, ColumnData) ->
@@ -361,6 +394,16 @@ class TSDB:
         # part is fully written (flush and merge) — the stream engine's
         # element-index/bloom sidecar builder (index/element.py).
         self.on_part_built = None
+        # rotation scheduler state (rotation.go:31-47 analog): ticks are
+        # throttled to one per snap window; pre-creation fires only inside
+        # the creation gap before the latest segment's end.
+        self.tick_snap_ms = 600_000  # timeEventSnapDuration (10 min)
+        self.creation_gap_ms = 3_600_000  # creationGap (1 h)
+        self._latest_tick_ms = 0
+        # high-water mark of write-event timestamps: rotation ticks derive
+        # from it (rotation.go Tick is fed by write events, NOT wall clock),
+        # so a write-idle group stops pre-creating segments
+        self.max_event_ms = 0
         self._reopen()
 
     def _reopen(self) -> None:
@@ -378,10 +421,16 @@ class TSDB:
             self._segments[start] = Segment(
                 seg_dir, start, iv.millis, self.opts.shard_num,
                 self.mem_factory, lambda: self.merge_filter,
-                lambda: self.on_part_built,
+                lambda: self.on_part_built, clock=self._clock,
             )
 
-    def segment_for(self, ts_millis: int, create: bool = True) -> Optional[Segment]:
+    def segment_for(
+        self, ts_millis: int, create: bool = True, event: bool = True
+    ) -> Optional[Segment]:
+        """event=False marks non-write callers (tick's own pre-creation):
+        they must not advance the write high-water mark, or a pre-created
+        segment's start would itself count as a "write" and chain into
+        runaway pre-creation on hour-interval segments."""
         iv = self.opts.segment_interval
         start = segment_start(ts_millis, iv.millis)
         with self._lock:
@@ -395,18 +444,76 @@ class TSDB:
                     self.mem_factory,
                     lambda: self.merge_filter,
                     lambda: self.on_part_built,
+                    clock=self._clock,
                 )
                 self._segments[start] = seg
+            if seg is not None:
+                seg.touch()
+                if create and event and ts_millis > self.max_event_ms:
+                    self.max_event_ms = ts_millis
             return seg
+
+    def tick(self, ts_millis: int) -> bool:
+        """Rotation tick (rotation.go:36 Tick + :52 startRotationTask).
+
+        Pre-creates the NEXT time segment once `ts` enters the creation
+        gap before the latest segment's end, so the first write landing in
+        a fresh time bucket never pays segment mkdir + shard + index-open
+        latency inline.  Ticks are throttled to one per `tick_snap_ms`.
+        Returns True when a segment was pre-created.
+        """
+        if ts_millis <= 0:
+            return False
+        if ts_millis - self.tick_snap_ms < self._latest_tick_ms:
+            return False
+        self._latest_tick_ms = ts_millis
+        with self._lock:
+            if not self._segments:
+                return False
+            latest = self._segments[max(self._segments)]
+            gap = latest.end - ts_millis
+        # gap <= 0: the event is from the future — the write path itself
+        # creates that segment directly (rotation.go:115 comment).  Once a
+        # pre-creation fires, `latest` advances to the new segment, so
+        # follow-up ticks in the same window see gap > interval and are
+        # no-ops: True really does mean "a segment was created".
+        if gap <= 0 or gap > min(self.creation_gap_ms, self.opts.segment_interval.millis):
+            return False
+        self.segment_for(latest.end, event=False)
+        return True
+
+    def close_idle_segments(self, idle_timeout_s: float, now_s: Optional[float] = None) -> int:
+        """Release index + cache memory of segments idle past the timeout
+        (segmentController.closeIdleSegments, segment.go:334 analog).
+
+        Reclaim is memory-only: parts and the persisted series index stay
+        on disk and reopen lazily, so reclaiming a segment a query is
+        about to touch costs a reload, never correctness."""
+        if idle_timeout_s <= 0:
+            return 0
+        # same clock domain as Segment.touch — callers normally omit now_s
+        now = self._clock() if now_s is None else now_s
+        closed = 0
+        for seg in self.segments:
+            # _reclaimed: nothing repopulated since the last reclaim (only
+            # a touch clears it) — skip, so a permanently idle segment is
+            # neither re-walked nor re-counted every pass
+            if not seg._reclaimed and now - seg.last_accessed >= idle_timeout_s:
+                seg.reset_index()
+                closed += 1
+        return closed
 
     def select_segments(self, begin: int, end: int) -> list[Segment]:
         """Segments overlapping [begin, end) (storage.go:118 analog)."""
         with self._lock:
-            return [
+            hit = [
                 s
                 for _, s in sorted(self._segments.items())
                 if s.overlaps(begin, end)
             ]
+        for s in hit:
+            s.touch()
+        return hit
 
     @property
     def segments(self) -> list[Segment]:

@@ -1,0 +1,200 @@
+"""Rotation scheduler: next-segment pre-creation + idle segment reclaim.
+
+Reference behavior under test (banyand/internal/storage/rotation.go:36-146):
+ticks are snap-throttled; a tick inside the creation gap before the latest
+segment's end pre-creates the next segment; an idle checker releases index
+memory of segments unaccessed past the idle timeout (segment.go:334).
+"""
+
+import numpy as np
+
+from banyandb_tpu.api.schema import IntervalRule, ResourceOpts
+from banyandb_tpu.storage.loops import LifecycleLoops
+from banyandb_tpu.storage.memtable import MemTable
+from banyandb_tpu.storage.tsdb import TSDB
+
+DAY = 24 * 3600 * 1000
+HOUR = 3600 * 1000
+T0 = 1_700_006_400_000  # aligned to a UTC day boundary
+MIN = 60 * 1000
+
+
+def _db(tmp_path, unit="day", clock=None):
+    kw = {"clock": clock} if clock else {}
+    return TSDB(
+        tmp_path,
+        "g",
+        ResourceOpts(shard_num=1, segment_interval=IntervalRule(1, unit)),
+        mem_factory=lambda: MemTable(["svc"], ["v"]),
+        **kw,
+    )
+
+
+def test_tick_precreates_next_segment_inside_gap(tmp_path):
+    db = _db(tmp_path)
+    db.segment_for(T0 + HOUR)  # write lands in [T0, T0+1d)
+    assert len(db.segments) == 1
+
+    # far from the boundary: no pre-creation (gap > creationGap)
+    assert db.tick(T0 + 2 * HOUR) is False
+    assert len(db.segments) == 1
+
+    # inside the last hour of the segment: next segment pre-created
+    assert db.tick(T0 + DAY - 30 * MIN) is True
+    starts = [s.start for s in db.segments]
+    assert starts == [T0, T0 + DAY]
+    # the pre-created segment exists on disk before any write touches it
+    assert (db.segments[1].root / "shard-0").exists()
+
+    # follow-up in-window tick: latest has advanced, no re-create, False
+    assert db.tick(T0 + DAY - 15 * MIN) is False
+    assert len(db.segments) == 2
+
+
+def test_tick_snap_throttle(tmp_path):
+    db = _db(tmp_path)
+    db.segment_for(T0)
+    # out-of-gap tick consumes the snap window
+    assert db.tick(T0 + DAY - 65 * MIN) is False
+    # in-gap but within tick_snap_ms of the last tick: suppressed
+    assert db.tick(T0 + DAY - 59 * MIN) is False
+    assert len(db.segments) == 1
+    # past the snap window: fires
+    assert db.tick(T0 + DAY - 54 * MIN) is True
+    assert len(db.segments) == 2
+
+
+def test_tick_ignores_future_and_empty(tmp_path):
+    db = _db(tmp_path)
+    assert db.tick(T0) is False  # no segments yet
+    db.segment_for(T0)
+    # event past the segment end: the write path creates that segment
+    # directly (rotation.go:115), tick must not
+    assert db.tick(T0 + DAY + MIN) is False
+    assert len(db.segments) == 1
+
+
+def test_idle_reclaim_releases_and_reloads_series_index(tmp_path):
+    now = [1000.0]
+    db = _db(tmp_path, clock=lambda: now[0])
+    seg = db.segment_for(T0)
+    seg.series_index.insert_series(7, {"svc": b"cart"})
+    assert not seg.series_index._idx._released
+
+    # still fresh: nothing reclaimed
+    assert db.close_idle_segments(60.0) == 0
+    assert not seg.series_index._idx._released
+
+    now[0] += 120
+    assert db.close_idle_segments(60.0) == 1
+    assert seg._sidx is not None  # identity stable for concurrent holders
+    assert seg.series_index._idx._released
+
+    # lazily reloads from the persisted file with the docs intact
+    hits = seg.series_index.search_entity({"svc": b"cart"})
+    assert np.asarray(hits).tolist() == [7]
+    assert not seg.series_index._idx._released
+
+
+def test_reclaimed_index_accepts_writes_without_losing_older_docs(tmp_path):
+    """insert-after-reclaim must reload first, else the next persist would
+    keep only the post-reclaim docs (silent series loss)."""
+    now = [1000.0]
+    db = _db(tmp_path, clock=lambda: now[0])
+    seg = db.segment_for(T0)
+    seg.series_index.insert_series(1, {"svc": b"a"})
+    now[0] += 120
+    assert db.close_idle_segments(60.0) == 1
+    seg.series_index.insert_series(2, {"svc": b"b"})
+    seg.series_index.reclaim()  # persist again via the reclaim path
+    hits = sorted(np.asarray(seg.series_index.search(None)).tolist())
+    assert hits == [1, 2]
+
+
+def test_idle_reclaim_skips_recently_touched(tmp_path):
+    now = [1000.0]
+    db = _db(tmp_path, clock=lambda: now[0])
+    seg = db.segment_for(T0)
+    seg.series_index.insert_series(1, {"svc": b"a"})
+    now[0] += 3000
+    # a read touch (select_segments) resets the idle clock
+    db.select_segments(T0, T0 + HOUR)
+    assert db.close_idle_segments(3600.0) == 0
+    assert not seg.series_index._idx._released
+
+
+def test_loops_rotation_stage_drives_tick_and_reclaim(tmp_path):
+    # one clock shared by the loops AND the TSDB (same idle domain)
+    now_s = [(T0 + DAY - 20 * MIN) / 1000.0]
+    clock = lambda: now_s[0]  # noqa: E731
+    db = _db(tmp_path, clock=clock)
+    # a real write near the boundary drives the event high-water mark —
+    # rotation is event-time, not wall-clock
+    db.segment_for(T0 + DAY - 20 * MIN)
+    loops = LifecycleLoops(lambda: [db], clock=clock, idle_timeout_s=0.0)
+    assert loops.rotation_stage() == 1
+    assert [s.start for s in db.segments] == [T0, T0 + DAY]
+
+    # idle reclaim path: advance the shared clock past the timeout
+    for s in db.segments:
+        s.series_index.insert_series(1, {"svc": b"x"})
+    loops.idle_timeout_s = 0.5
+    now_s[0] += 10
+    assert loops.rotation_stage() == 0  # latest advanced: no re-create
+    assert all(s.series_index._idx._released for s in db.segments)
+
+
+def test_write_idle_group_stops_precreating(tmp_path):
+    """A group that stops receiving writes must not accrete empty segments
+    from wall-clock passage (rotation ticks are event-time)."""
+    db = _db(tmp_path)
+    db.segment_for(T0 + DAY - 20 * MIN)  # last write, near the boundary
+    loops = LifecycleLoops(lambda: [db], idle_timeout_s=0.0)
+    created = sum(loops.rotation_stage() for _ in range(5))
+    assert created == 1  # exactly one pre-created successor, then silence
+    assert len(db.segments) == 2
+
+
+def test_hour_segments_no_precreation_chain(tmp_path):
+    """tick's own pre-creation must not count as a write event: on
+    hour-interval segments that would chain one new segment per tick."""
+    db = _db(tmp_path, unit="hour")
+    H0 = T0
+    db.segment_for(H0 + 10 * MIN)
+    db.tick_snap_ms = 0  # un-throttle to expose any chain immediately
+    assert db.tick(db.max_event_ms) is True  # in-gap (gap < 1h interval)
+    for _ in range(5):
+        db.tick(db.max_event_ms)
+    assert [s.start for s in db.segments] == [H0, H0 + HOUR]
+
+
+def test_idle_pass_does_not_recount_reclaimed_segments(tmp_path):
+    now = [1000.0]
+    db = _db(tmp_path, clock=lambda: now[0])
+    seg = db.segment_for(T0)
+    seg.series_index.insert_series(1, {"svc": b"a"})
+    now[0] += 120
+    assert db.close_idle_segments(60.0) == 1
+    # still idle, already reclaimed: neither re-walked nor re-counted
+    now[0] += 120
+    assert db.close_idle_segments(60.0) == 0
+    # a real touch re-arms it
+    seg.touch()
+    seg.series_index.insert_series(2, {"svc": b"b"})
+    now[0] += 120
+    assert db.close_idle_segments(60.0) == 1
+
+
+def test_empty_keyword_value_survives_reclaim_roundtrip(tmp_path):
+    """b'' keyword values must survive persist/_load (presence bitmaps) —
+    routine since idle reclaim, not just restart."""
+    now = [1000.0]
+    db = _db(tmp_path, clock=lambda: now[0])
+    seg = db.segment_for(T0)
+    seg.series_index.insert_series(3, {"svc": b"", "region": b"eu"})
+    now[0] += 120
+    assert db.close_idle_segments(60.0) == 1
+    hits = seg.series_index.search_entity({"svc": b""})
+    assert np.asarray(hits).tolist() == [3]
+    # absent keyword stays absent: a doc without "zone" must not gain one
+    assert seg.series_index.tags_of(3) == {"svc": b"", "region": b"eu"}
