@@ -24,6 +24,7 @@ class Topic(str, enum.Enum):
     MEASURE_QUERY_RAW = "measure-query-raw"
     STREAM_QUERY = "stream-query"
     TRACE_QUERY_BY_ID = "trace-query-by-id"
+    TRACE_QUERY_ORDERED = "trace-query-ordered"
     PROPERTY_QUERY = "property-query"
     # schema + control plane
     SCHEMA_SYNC = "schema-sync"

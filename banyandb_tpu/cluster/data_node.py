@@ -79,6 +79,7 @@ class DataNode:
         self.bus.subscribe(Topic.STREAM_QUERY, self._on_stream_query)
         self.bus.subscribe(Topic.TRACE_WRITE, self._on_trace_write)
         self.bus.subscribe(Topic.TRACE_QUERY_BY_ID, self._on_trace_query)
+        self.bus.subscribe(Topic.TRACE_QUERY_ORDERED, self._on_trace_query_ordered)
         self.bus.subscribe(
             Topic.HEALTH,
             lambda env: {
@@ -180,6 +181,25 @@ class DataNode:
             env["group"], env["name"], env["trace_id"]
         )
         return {"spans": serde.spans_to_json(spans)}
+
+    def _on_trace_query_ordered(self, env: dict) -> dict:
+        """Ordered retrieval map phase: local sidx scan, results carry
+        their ordering keys for the liaison's k-way merge."""
+        from banyandb_tpu.api.model import TimeRange
+
+        try:
+            self.trace.get_trace(env["group"], env["name"])
+        except KeyError:
+            return {"results": []}
+        keyed = self.trace.query_ordered(
+            env["group"], env["name"], env["order_tag"],
+            TimeRange(env["begin"], env["end"]),
+            lo=env.get("lo"), hi=env.get("hi"),
+            asc=bool(env.get("asc", False)),
+            limit=int(env.get("limit", 20)),
+            with_keys=True,
+        )
+        return {"results": [[int(k), tid] for k, tid in keyed]}
 
     # -- write plane --------------------------------------------------------
     def _on_measure_write(self, env: dict) -> dict:

@@ -633,6 +633,51 @@ class Liaison:
             for s in r["spans"]
         ]
 
+    def query_trace_ordered(
+        self,
+        group: str,
+        name: str,
+        order_tag: str,
+        time_range,
+        *,
+        lo=None,
+        hi=None,
+        asc: bool = False,
+        limit: int = 20,
+        stages: tuple[str, ...] = (),
+    ) -> list[str]:
+        """Distributed ordered-trace retrieval (TraceService.Query with a
+        TYPE_TREE order, trace_analyzer.go:104 ordered path): scatter the
+        sidx scan to every data node, k-way merge per-node (key, id)
+        results at the liaison.  A trace lives wholly on one shard, so
+        cross-node duplicates only arise from replicas — dedup by id
+        keeps the first (correctly-ordered) occurrence."""
+        import heapq
+
+        assignment = self._shard_assignment(group, stages)
+        streams = []
+        for node in assignment:
+            r = self.transport.call(
+                node.addr,
+                Topic.TRACE_QUERY_ORDERED.value,
+                {
+                    "group": group, "name": name, "order_tag": order_tag,
+                    "begin": time_range.begin_millis,
+                    "end": time_range.end_millis,
+                    "lo": lo, "hi": hi, "asc": asc, "limit": limit,
+                },
+            )
+            streams.append([(int(k), tid) for k, tid in r["results"]])
+        merged = heapq.merge(*streams, key=lambda kt: kt[0] if asc else -kt[0])
+        out: list[str] = []
+        for _k, tid in merged:
+            if tid in out:
+                continue
+            out.append(tid)
+            if len(out) >= limit:
+                break
+        return out
+
 
 class ChunkedSyncClient:
     """Ship a sealed part to a data node (pub/chunked_sync.go analog):

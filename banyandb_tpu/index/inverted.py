@@ -367,9 +367,14 @@ class InvertedIndex:
             )
             fs.atomic_write(self.path, self._MAGIC + zst.compress(body))
 
+    _MAGIC_V1 = b"BTIX1\n"
+
     def _load(self) -> None:
         blob = self.path.read_bytes()
-        assert blob[: len(self._MAGIC)] == self._MAGIC, "bad index file"
+        magic = blob[: len(self._MAGIC)]
+        if magic not in (self._MAGIC, self._MAGIC_V1):
+            raise ValueError(f"bad index file magic {magic!r}: {self.path}")
+        v1 = magic == self._MAGIC_V1
         raw = zst.decompress(blob[len(self._MAGIC) :])
         off = 0
         blobs: list[bytes] = []
@@ -389,7 +394,11 @@ class InvertedIndex:
         kw_present = {}
         for f in kw_names:
             kw_cols[f] = enc.decode_strings(next(it))
-            kw_present[f] = enc.decode_int64(next(it), len(kw_cols[f]))
+            if v1:
+                # v1 had no keyword presence bitmaps: b"" meant absent
+                kw_present[f] = [1 if v != b"" else 0 for v in kw_cols[f]]
+            else:
+                kw_present[f] = enc.decode_int64(next(it), len(kw_cols[f]))
         n = len(next(iter(kw_cols.values()))) if kw_cols else None
         num_cols = {}
         num_present = {}

@@ -302,9 +302,14 @@ class TraceEngine:
         asc: bool = False,
         limit: int = 20,
         verify_live: bool = True,
-    ) -> list[str]:
+        with_keys: bool = False,
+    ) -> list:
         """Trace ids ordered by an indexed numeric tag (sidx TYPE_TREE
         retrieval: e.g. slowest traces in a window).
+
+        with_keys=True returns [(key, trace_id)] instead of bare ids —
+        the distributed path needs the ordering keys to k-way merge
+        per-node results at the liaison.
 
         verify_live drops ids whose spans were since removed by the
         sampler pipeline (the ordered index is ingest-time and is not
@@ -338,6 +343,7 @@ class TraceEngine:
                 *streams, key=lambda kp: kp[0] if asc else -kp[0]
             )
             seen: list[str] = []
+            keyed: list[tuple[int, str]] = []
             for _k, payload in merged:
                 tid, ts = sidx_decode_ref(payload)
                 if not (time_range.begin_millis <= ts < time_range.end_millis):
@@ -347,10 +353,11 @@ class TraceEngine:
                 if verify_live and not self.query_by_trace_id(group, name, tid):
                     continue
                 seen.append(tid)
+                keyed.append((int(_k), tid))
                 if len(seen) >= limit:
-                    return seen
+                    return keyed if with_keys else seen
             if not truncated:
-                return seen
+                return keyed if with_keys else seen
             fetch *= 4
 
     def _row_to_span(self, t: Trace, src: ColumnData, i: int) -> dict:

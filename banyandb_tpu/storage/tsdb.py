@@ -341,6 +341,10 @@ class Segment:
         keeps its identity (never reset to None) — concurrent holders see
         the same object, whose internal lock serializes reclaim against
         in-flight inserts/searches and lazily reloads on next use."""
+        # flag FIRST: a touch() racing with the release below clears it,
+        # keeping a just-repopulated segment eligible for the next idle
+        # pass (flag-last would clobber the touch and exempt it forever)
+        self._reclaimed = True
         with self._sidx_lock:
             sidx = self._sidx
         if sidx is not None:
@@ -348,7 +352,6 @@ class Segment:
         for shard in self.shards:
             for part in shard.parts:
                 part.release_cached()
-        self._reclaimed = True
 
     @property
     def series_index(self):

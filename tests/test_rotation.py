@@ -185,6 +185,36 @@ def test_idle_pass_does_not_recount_reclaimed_segments(tmp_path):
     assert db.close_idle_segments(60.0) == 1
 
 
+def test_v1_index_file_still_loads(tmp_path):
+    """Format bump BTIX1->BTIX2 must not brick previously-persisted
+    indexes: v1 files (no keyword presence bitmaps) load with the old
+    b''-means-absent semantics."""
+    from banyandb_tpu.index.inverted import Doc, InvertedIndex, TermQuery
+    from banyandb_tpu.utils import compress as zst
+    from banyandb_tpu.utils import encoding as enc
+
+    path = tmp_path / "old.idx"
+    ids = np.asarray([1, 2], dtype=np.int64)
+    blobs = [
+        enc.encode_int64(ids),
+        enc.encode_strings([b"svc"]),  # kw names
+        enc.encode_strings([]),  # numeric names
+        enc.encode_strings([b"cart", b""]),  # svc col, v1: no presence blob
+        enc.encode_strings([b"", b""]),  # payloads
+    ]
+    body = b"".join(len(b).to_bytes(4, "little") + b for b in blobs)
+    path.write_bytes(b"BTIX1\n" + zst.compress(body))
+
+    idx = InvertedIndex(path)
+    assert np.asarray(idx.search(TermQuery("svc", b"cart"))).tolist() == [1]
+    assert idx.get(2).keywords == {}  # v1 b"" decodes as absent
+    # re-persist upgrades to v2 in place; reload round-trips
+    idx.insert([Doc(doc_id=3, keywords={"svc": b""})])
+    idx.persist()
+    idx2 = InvertedIndex(path)
+    assert np.asarray(idx2.search(TermQuery("svc", b""))).tolist() == [3]
+
+
 def test_empty_keyword_value_survives_reclaim_roundtrip(tmp_path):
     """b'' keyword values must survive persist/_load (presence bitmaps) —
     routine since idle reclaim, not just restart."""
