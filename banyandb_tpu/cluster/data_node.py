@@ -267,15 +267,44 @@ class DataNode:
         if phase == "finish":
             # materialize the part dir, then introduce it into the shard
             # (FinishSync -> introduce, §3.2 of SURVEY.md)
+            import json as _json
+
             state = self._sync_sessions.pop(session)
-            for fname, buf in state["files"].items():
-                fs.atomic_write(state["dir"] / fname, bytes(buf))
-            part_name, _ = self._introduce_part_dir(
-                state["dir"],
-                state["group"],
-                int(state["shard"].split("-")[1]),
-                int(env["segment_start_millis"]),
+            group = state["group"]
+            shard_idx = int(state["shard"].split("-")[1])
+            # idempotence, same contract as the streaming path: a re-ship
+            # after a sender crash-before-progress-write installs nothing
+            files = {f: bytes(b) for f, b in state["files"].items()}
+            digest = f"{group}/{shard_idx}/{self._synced_part_digest(files)}"
+            with self._installed_lock:
+                if digest in self._installed:
+                    return {"introduced": "", "duplicate": True}
+                self._installed[digest] = None
+            try:
+                for fname, buf in files.items():
+                    fs.atomic_write(state["dir"] / fname, buf)
+                # catalog from the part's own metadata (parts carry their
+                # resource kind), mirroring the streaming install path
+                pmeta = _json.loads(files.get("metadata.json", b"{}"))
+                catalog = pmeta.get(
+                    "catalog",
+                    "stream" if "stream" in pmeta
+                    else ("trace" if "trace" in pmeta else "measure"),
+                )
+                if catalog not in ("measure", "stream", "trace"):
+                    raise ValueError(f"unsupported part catalog {catalog!r}")
+                min_ts = int(env["segment_start_millis"])
+                part_name, part_dir = self._introduce_part_dir(
+                    state["dir"], group, shard_idx, min_ts, catalog=catalog
+                )
+            except BaseException:
+                with self._installed_lock:
+                    self._installed.pop(digest, None)
+                raise
+            self._post_install_aux(
+                catalog, group, pmeta, min_ts, shard_idx, part_name, part_dir
             )
+            self._persist_installed_digests()
             return {"introduced": part_name}
         raise ValueError(f"bad sync phase {phase}")
 
@@ -393,15 +422,25 @@ class DataNode:
             with self._installed_lock:
                 self._installed.pop(digest, None)
             raise
+        self._post_install_aux(
+            catalog, group, pmeta, min_ts, int(meta.shard_id), part_name, part_dir
+        )
+        return True
+
+    def _post_install_aux(
+        self, catalog, group, pmeta, min_ts, shard_idx, part_name, part_dir
+    ) -> None:
+        """Auxiliary rebuilds every installed part needs, whatever wire it
+        arrived on (streaming chunked sync or the JSON SYNC_PART path):
+        trace bloom+sidx, stream element-index sidecars, measure TopN
+        observation."""
+        import logging
+
         if catalog == "trace":
             try:
-                self._index_trace_part(
-                    group, pmeta, min_ts, int(meta.shard_id), part_dir
-                )
+                self._index_trace_part(group, pmeta, min_ts, shard_idx, part_dir)
             except Exception:  # noqa: BLE001 - retrieval stays correct
                 # via full scans; ordered/bloom pruning degrades
-                import logging
-
                 logging.getLogger("banyandb.datanode").exception(
                     "trace index build failed for installed part %s",
                     part_dir,
@@ -412,16 +451,11 @@ class DataNode:
                 self.stream._build_part_index(group, part_dir, pmeta)
             except Exception:  # noqa: BLE001 - pruning is optional,
                 # but silent degradation to full scans is not
-                import logging
-
                 logging.getLogger("banyandb.datanode").exception(
                     "sidecar build failed for installed part %s", part_dir
                 )
         else:
-            self._observe_topn_part(
-                group, pmeta, min_ts, int(meta.shard_id), part_name
-            )
-        return True
+            self._observe_topn_part(group, pmeta, min_ts, shard_idx, part_name)
 
     def _index_trace_part(
         self, group: str, pmeta: dict, min_ts: int, shard_idx: int, part_dir

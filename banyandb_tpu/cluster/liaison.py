@@ -360,36 +360,74 @@ class Liaison:
     def _shard_assignment(
         self, group: str, stages: tuple[str, ...] = ()
     ) -> dict[NodeInfo, list[int]]:
-        """Per-shard primary-alive nodes, optionally restricted to nodes
-        serving the requested lifecycle stages (ResolveStage analog:
-        a query naming stages=('warm',) only consults warm-tier nodes)."""
-        shard_num = self.registry.get_group(group).resource_opts.shard_num
-        eligible = self.alive
-        if stages:
-            eligible = {
+        """Per-shard node assignment, stage-aware (ResolveStage analog).
+
+        Untiered groups (no stages configured or requested): each shard
+        goes to its replica-chain primary — one node per shard, so
+        replicated data is never read twice.
+
+        Tiered groups: every requested stage (default: all the group's
+        configured stages) contributes its own full shard assignment over
+        that stage's nodes — tier migration MOVES rows between tiers, so
+        a row lives in exactly one tier and the cross-tier union stays
+        duplicate-free.  Within a stage, shard -> replica-chain primary
+        when the chain reaches the stage; otherwise a deterministic
+        spread over the stage's nodes (migrated shards need not follow
+        the write-time chain)."""
+        opts = self.registry.get_group(group).resource_opts
+        shard_num = opts.shard_num
+        stage_list = tuple(stages) or tuple(opts.stages)
+
+        def stage_nodes(stage: Optional[str]) -> set[str]:
+            return {
                 n.name
                 for n in self.selector.nodes
                 if n.name in self.alive
-                and any(n.serves_stage(s) for s in stages)
+                and (stage is None or n.serves_stage(stage))
             }
-            if not eligible:
-                raise TransportError(
-                    f"no alive node serves stages {list(stages)}"
-                )
+
+        def assign_into(
+            assignment, eligible: set[str], label: str, fallback: bool
+        ) -> None:
+            ordered = sorted(eligible)
+            for shard in range(shard_num):
+                try:
+                    node = self.selector.primary(shard, eligible)
+                except RuntimeError:
+                    # off-chain spread is only sound for tiered stages,
+                    # where migration places shards off the write-time
+                    # chain; untiered data lives on chain nodes only, so
+                    # a dead chain must error, not silently return less
+                    if not fallback or not ordered:
+                        raise TransportError(
+                            f"shard {shard} has no alive replica for {label}"
+                        ) from None
+                    node = next(
+                        n for n in self.selector.nodes
+                        if n.name == ordered[shard % len(ordered)]
+                    )
+                entry = assignment.setdefault(node.name, (node, []))
+                if shard not in entry[1]:
+                    entry[1].append(shard)
+
         assignment: dict[str, tuple[NodeInfo, list[int]]] = {}
-        for shard in range(shard_num):
-            try:
-                node = self.selector.primary(shard, eligible)
-            except RuntimeError as e:
-                # a shard whose whole replica set is outside the requested
-                # stage tier must fail with the stage named, not a
-                # confusing "no alive replica"
+        if not stage_list:
+            assign_into(assignment, stage_nodes(None), "any stage", fallback=False)
+        else:
+            missing = []
+            for stage in stage_list:
+                eligible = stage_nodes(stage)
+                if not eligible:
+                    missing.append(stage)
+                    continue
+                assign_into(assignment, eligible, f"stage {stage!r}", fallback=True)
+            if missing and (stages or not assignment):
+                # explicitly requested stages must not silently vanish;
+                # group-configured stages may have no nodes yet as long
+                # as SOME tier answered
                 raise TransportError(
-                    f"shard {shard} has no alive replica serving stages "
-                    f"{list(stages) or ['*']}"
-                ) from e
-            entry = assignment.setdefault(node.name, (node, []))
-            entry[1].append(shard)
+                    f"no alive node serves stages {missing}"
+                )
         return {node: shards for node, shards in assignment.values()}
 
     def _scatter_partials(
@@ -697,7 +735,13 @@ class ChunkedSyncClient:
         segment: str,
         segment_start_millis: int,
         shard: str,
+        meta_patch: Optional[dict] = None,
     ) -> str:
+        """meta_patch: extra keys merged into the shipped metadata.json
+        (not the on-disk original) — tier migration uses it to stamp
+        catalog/ordered_tags on engine-flushed parts so the receiver
+        routes and aux-indexes them like wqueue-sealed ones."""
+        import json as _json
         import zlib
         import base64
         from pathlib import Path
@@ -716,6 +760,10 @@ class ChunkedSyncClient:
         )
         for f in sorted(part_dir.iterdir()):
             data = f.read_bytes()
+            if meta_patch and f.name == "metadata.json":
+                data = _json.dumps(
+                    {**_json.loads(data), **meta_patch}
+                ).encode()
             for off in range(0, max(len(data), 1), self.CHUNK):
                 blob = data[off : off + self.CHUNK]
                 self.transport.call(

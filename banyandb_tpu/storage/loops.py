@@ -140,6 +140,13 @@ class LifecycleLoops:
             # deleted directory (zombie segment) — skip dead shards
             if not shard.root.exists():
                 return 0
+            # segments under tier migration are merge-frozen: compaction
+            # would rewrite the part names migration uses as resume keys,
+            # re-shipping already-installed rows under new names
+            from banyandb_tpu.storage.tsdb import MIGRATING_MARKER
+
+            if (shard.root.parent / MIGRATING_MARKER).exists():
+                return 0
             while True:
                 if not shard.merge():
                     break

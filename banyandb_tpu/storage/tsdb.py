@@ -32,6 +32,11 @@ from banyandb_tpu.storage.part import ColumnData, Part, PartWriter
 from banyandb_tpu.utils import fs
 
 SNAPSHOT = "snapshot.snp"
+# Segment-level marker: tier migration is shipping this segment's parts.
+# Background merges skip marked segments (part names are the resumable
+# progress keys, so compaction must not rewrite them mid-migration); the
+# marker persists across crashes and leaves with the migrated segment.
+MIGRATING_MARKER = ".migrating"
 
 
 def segment_name(start_millis: int, interval_unit: str) -> str:
