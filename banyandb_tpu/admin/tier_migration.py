@@ -59,6 +59,21 @@ class TierMigrator:
             ("trace", self.node.trace),
         )
 
+    def _attach_disk_groups(self, engine) -> None:
+        """Engines open group TSDBs lazily on first write/query; an
+        offline agent (the lifecycle CLI) sees an empty map until the
+        on-disk groups are attached explicitly."""
+        root = getattr(engine, "root", None)
+        if root is None or not root.exists():
+            return
+        for gdir in root.iterdir():
+            if not gdir.is_dir():
+                continue
+            try:
+                engine._tsdb(gdir.name)
+            except KeyError:
+                continue  # directory for a group the registry dropped
+
     def _seal(self, catalog: str, engine, db) -> None:
         """Everything in memtables/mem-sidx must be on disk before the
         directory tree is shipped (lifecycle takes a snapshot first)."""
@@ -134,6 +149,7 @@ class TierMigrator:
         for catalog, engine in self._engines():
             if catalogs is not None and catalog not in catalogs:
                 continue
+            self._attach_disk_groups(engine)
             for group, db in list(engine._tsdbs.items()):
                 expired = [
                     seg for seg in db.segments if seg.end <= older_than_millis

@@ -93,6 +93,28 @@ def main(argv=None) -> int:
     ins.add_argument("--root", help="server root (offline mode)")
     ins.add_argument("--part", help="one part dir for column detail")
 
+    lc = sub.add_parser(
+        "lifecycle",
+        help="tier migration agent (banyand-lifecycle CLI analog)",
+    )
+    lc.add_argument("action", choices=["migrate"])
+    lc.add_argument(
+        "--node-root", required=True,
+        help="hot node root dir (holds the registry; data under <root>/data)",
+    )
+    lc.add_argument(
+        "--target", required=True, help="warm/cold node bus addr host:port"
+    )
+    lc.add_argument(
+        "--older-than", type=int, required=True,
+        help="migrate segments whose window ended before this epoch-ms cutoff",
+    )
+    lc.add_argument(
+        "--catalog", action="append", default=None,
+        choices=["measure", "stream", "trace"],
+        help="restrict to catalog(s) (repeatable)",
+    )
+
     args = ap.parse_args(argv)
 
     if args.cmd == "health":
@@ -190,6 +212,31 @@ def main(argv=None) -> int:
         else:
             print("inspect needs --root or --part", file=sys.stderr)
             return 2
+    elif args.cmd == "lifecycle":
+        # offline agent form, like the reference's standalone lifecycle
+        # CLI: open the node's storage directly (the node process must
+        # not be running against the same root) and ship over gRPC
+        from pathlib import Path
+
+        from banyandb_tpu.admin.tier_migration import TierMigrator
+        from banyandb_tpu.api.schema import SchemaRegistry
+        from banyandb_tpu.cluster.data_node import DataNode
+
+        root = Path(args.node_root)
+        if not (root / "data").exists():
+            # a typo'd root must not read as "ran, nothing expired"
+            print(f"no data dir under node root {root}", file=sys.stderr)
+            return 2
+        node = DataNode("lifecycle-agent", SchemaRegistry(root), root / "data")
+        transport = GrpcTransport()
+        try:
+            stats = TierMigrator(node, transport, args.target).run(
+                args.older_than,
+                catalogs=tuple(args.catalog) if args.catalog else None,
+            )
+        finally:
+            transport.close()
+        print(json.dumps(stats))
     return 0
 
 

@@ -138,6 +138,92 @@ def bench_merge():
     return {"merge_8x20k_parts": {"s": sec, "krows_per_s": 160 / sec}}
 
 
+def bench_stream_scan():
+    """Element-index filtered stream scan (stream/benchmark_test.go
+    analog): write 200k elements, query an indexed tag predicate."""
+    import tempfile
+
+    from banyandb_tpu.api import (
+        Catalog, Group, IndexRule, ResourceOpts, SchemaRegistry, Stream,
+        TagSpec, TagType,
+    )
+    from banyandb_tpu.api.model import Condition, QueryRequest, TimeRange
+    from banyandb_tpu.models.stream import ElementValue, StreamEngine
+
+    d = tempfile.mkdtemp()
+    reg = SchemaRegistry(d)
+    reg.create_group(Group("g", Catalog.STREAM, ResourceOpts(shard_num=2)))
+    reg.create_index_rule(IndexRule("g", "by-level", ("level",), "inverted"))
+    eng = StreamEngine(reg, d + "/data")
+    eng.create_stream(
+        Stream("g", "logs",
+               (TagSpec("svc", TagType.STRING), TagSpec("level", TagType.STRING)),
+               ("svc",))
+    )
+    n = 200_000
+    t0 = 1_700_000_000_000
+    batch = [
+        ElementValue(
+            f"e{i}", t0 + i,
+            {"svc": f"s{i % 50}", "level": "ERROR" if i % 20 == 0 else "INFO"},
+            b"payload",
+        )
+        for i in range(n)
+    ]
+    wsec = timeit(lambda: eng.write("g", "logs", batch), warmup=0, iters=1)
+    eng.flush()
+    req = QueryRequest(
+        ("g",), "logs", TimeRange(t0, t0 + n),
+        criteria=Condition("level", "eq", "ERROR"), limit=20_000,
+    )
+    first = eng.query(req)
+    qsec = timeit(lambda: eng.query(req), warmup=0, iters=5)
+    return {
+        "stream_write_200k": {"s": wsec, "kel_per_s": n / wsec / 1e3},
+        "stream_indexed_filter_200k": {
+            "s": qsec,
+            "hits": len(first.data_points),
+            "Mel_per_s": n / qsec / 1e6,
+        },
+    }
+
+
+def bench_trace_ordered():
+    """sidx ordered retrieval (sidx/query_benchmark_test.go analog):
+    40k spans / 10k traces, top-100 by duration."""
+    import tempfile
+
+    from banyandb_tpu.api import Catalog, Group, ResourceOpts, SchemaRegistry, TagSpec, TagType
+    from banyandb_tpu.api.model import TimeRange
+    from banyandb_tpu.api.schema import Trace
+    from banyandb_tpu.models.trace import SpanValue, TraceEngine
+
+    d = tempfile.mkdtemp()
+    reg = SchemaRegistry(d)
+    reg.create_group(Group("g", Catalog.TRACE, ResourceOpts(shard_num=2)))
+    eng = TraceEngine(reg, d + "/data")
+    eng.create_trace(
+        Trace("g", "spans",
+              (TagSpec("trace_id", TagType.STRING), TagSpec("dur", TagType.INT)),
+              trace_id_tag="trace_id")
+    )
+    rng = np.random.default_rng(2)
+    t0 = 1_700_000_000_000
+    spans = [
+        SpanValue(t0 + i, {"trace_id": f"t{i % 10_000}", "dur": int(rng.integers(1, 1_000_000))}, b"sp")
+        for i in range(40_000)
+    ]
+    eng.write("g", "spans", spans, ordered_tags=("dur",))
+    eng.maintain()
+    tr = TimeRange(t0, t0 + 50_000)
+    run = lambda: eng.query_ordered(  # noqa: E731
+        "g", "spans", "dur", tr, limit=100, verify_live=False
+    )
+    run()
+    sec = timeit(run, warmup=0, iters=5)
+    return {"trace_ordered_top100_of_40k": {"s": sec}}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true")
@@ -148,6 +234,8 @@ def main():
         ("group_reduce", bench_group_reduce),
         ("ingest", bench_ingest),
         ("merge", bench_merge),
+        ("stream_scan", bench_stream_scan),
+        ("trace_ordered", bench_trace_ordered),
     ):
         results.update(fn())
     if args.json:

@@ -269,3 +269,17 @@ def test_migration_is_idempotent_when_nothing_expired(cluster):
     again = m.run(T_OLD + DAY)
     assert again["shipped_parts"] == 0
     assert _measure_rows(liaison, ("warm",)) == [float(i) for i in range(N_OLD)]
+
+
+def test_offline_agent_attaches_disk_groups(cluster):
+    """The lifecycle CLI opens a node root cold: engines' lazy _tsdbs
+    maps are empty, so the migrator must attach on-disk groups itself."""
+    transport, hot, warm, liaison, hot_addr, warm_addr = cluster
+    _ingest(hot)
+    # a FRESH DataNode over the same root = the offline agent's view
+    reg = SchemaRegistry(hot.root.parent)
+    cold_open = DataNode("agent", reg, hot.root)
+    assert cold_open.measure._tsdbs == {}  # lazy: nothing attached yet
+    stats = TierMigrator(cold_open, transport, warm_addr).run(T_OLD + DAY)
+    assert len(stats["migrated_segments"]) == 3
+    assert _measure_rows(liaison, ("warm",)) == [float(i) for i in range(N_OLD)]
