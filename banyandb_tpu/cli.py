@@ -227,6 +227,28 @@ def main(argv=None) -> int:
             # a typo'd root must not read as "ran, nothing expired"
             print(f"no data dir under node root {root}", file=sys.stderr)
             return 2
+        # refuse a root whose owning node process is still alive: a
+        # second Shard owner over the same dirs loses in-flight writes
+        pid_file = root / "data" / ".bydb-node.pid"
+        if pid_file.exists():
+            import os
+
+            try:
+                owner = int(pid_file.read_text())
+            except ValueError:
+                owner = 0
+            if owner and owner != os.getpid():
+                try:
+                    os.kill(owner, 0)
+                except ProcessLookupError:
+                    pass  # stale record from a dead process
+                else:  # alive (PermissionError = alive under another uid)
+                    print(
+                        f"node process pid={owner} is still running on "
+                        f"{root}; stop it before offline migration",
+                        file=sys.stderr,
+                    )
+                    return 2
         node = DataNode("lifecycle-agent", SchemaRegistry(root), root / "data")
         transport = GrpcTransport()
         try:

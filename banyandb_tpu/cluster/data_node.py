@@ -28,6 +28,16 @@ class DataNode:
         self.name = name
         self.registry = registry
         self.root = Path(root)
+        # advisory owner record: offline tools (lifecycle CLI) refuse to
+        # open a root whose recorded owner process is still alive —
+        # two Shard owners over one directory lose writes
+        self.root.mkdir(parents=True, exist_ok=True)
+        try:
+            import os as _os
+
+            (self.root / ".bydb-node.pid").write_text(str(_os.getpid()))
+        except OSError:
+            pass
         self.measure = MeasureEngine(registry, self.root)
         self.stream = StreamEngine(registry, self.root)
         self.trace = TraceEngine(registry, self.root)

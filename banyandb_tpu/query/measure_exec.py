@@ -806,7 +806,18 @@ def finalize_partials(
             count = np.zeros(1)
             group_ids = np.asarray([0])
     else:
+        # Canonical lexicographic order for group lists.  The dense
+        # group-id layout is topology-dependent (dict-code order
+        # standalone vs combine order in the cluster), so positional
+        # order would (a) keep different groups per topology once LIMIT
+        # truncates and (b) break prefix-stability between pages issued
+        # with different limits.  A total order fixes both; the Python
+        # key sort costs O(G log G) only on the emit path — the combine
+        # plane stays vectorized.
         group_ids = np.nonzero(nonempty)[0]
+        group_ids = np.asarray(
+            sorted(group_ids.tolist(), key=lambda i: p.groups[i]), dtype=int
+        )
 
     # Top-N selection narrows the group id set.  Ranking field is
     # top.field_name; the ranking function is the request's aggregate when
@@ -819,13 +830,27 @@ def finalize_partials(
             else "mean"
         )
         metric = agg_values(fn, request.top.field_name)
-        metric = np.where(nonempty, metric, -np.inf if request.top.field_value_sort != "asc" else np.inf)
         k = min(request.top.number, int(nonempty.sum()))
-        if request.top.field_value_sort == "asc":
-            sel = np.argsort(metric, kind="stable")[:k]
+        if k <= 0 or metric.size == 0:
+            group_ids = np.zeros(0, dtype=int)
         else:
-            sel = np.argsort(-metric, kind="stable")[:k]
-        group_ids = sel
+            asc = request.top.field_value_sort == "asc"
+            metric = np.where(nonempty, metric, np.inf if asc else -np.inf)
+            order = np.argsort(metric if asc else -metric, kind="stable")[:k]
+            # Only the k-th-value boundary ties decide MEMBERSHIP of the
+            # top set; resolve exactly those by group key so selection is
+            # replay-identical across topologies without paying a Python
+            # sort over all G groups (vectorized argsort does the bulk).
+            kth_val = metric[order[k - 1]]
+            head = [int(i) for i in order if metric[i] != kth_val]
+            tied = sorted(
+                (
+                    int(i)
+                    for i in np.nonzero((metric == kth_val) & nonempty)[0]
+                ),
+                key=lambda i: p.groups[i],
+            )
+            group_ids = np.asarray(head + tied[: k - len(head)], dtype=int)
 
     # offset/limit paging over the (possibly top-N-ranked) group list —
     # offset semantics match the reference's QueryRequest.offset

@@ -1,0 +1,231 @@
+"""Standalone-vs-cluster replay soak (docs/soak/g5d + scripts/
+soak-vectorized.sh analog).
+
+The reference ran its vectorized engine 48 h against the row engine with
+byte-identical replay diffs (576 replays, 0 divergences).  This build's
+two independent execution topologies play the same role: a standalone
+engine and an N-node cluster hold identical data while randomized BydbQL
+queries — interleaved with fresh writes, flushes, and merges so
+snapshots move underneath — must return identical results from both.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python scripts/soak.py \
+        --seconds 300 --seed 7 --report soak-report.jsonl
+
+Every divergence is appended to the report as one JSON line with the
+query, both normalized results, and the dataset epoch; exit code 1 if
+any divergence occurred.  Importable: run_soak() powers the in-tree
+smoke test (tests/test_soak_smoke.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+T0 = 1_700_000_000_000
+GROUP, MEASURE = "sw", "cpm"
+SVCS = 8
+REGIONS = 3
+
+
+def _schema(reg, shard_num):
+    from banyandb_tpu.api import (
+        Catalog, Entity, FieldSpec, FieldType, Group, Measure,
+        ResourceOpts, TagSpec, TagType,
+    )
+
+    reg.create_group(
+        Group(GROUP, Catalog.MEASURE, ResourceOpts(shard_num=shard_num))
+    )
+    reg.create_measure(
+        Measure(
+            group=GROUP, name=MEASURE,
+            tags=(
+                TagSpec("svc", TagType.STRING),
+                TagSpec("region", TagType.STRING),
+                TagSpec("status", TagType.INT),
+            ),
+            fields=(FieldSpec("value", FieldType.INT),),
+            entity=Entity(("svc",)),
+        )
+    )
+
+
+def _points(rng, epoch, n):
+    from banyandb_tpu.api import DataPointValue
+
+    return tuple(
+        DataPointValue(
+            T0 + epoch * 100_000 + i,
+            {
+                "svc": f"s{rng.integers(0, SVCS)}",
+                "region": f"r{rng.integers(0, REGIONS)}",
+                "status": int((200, 404, 500)[rng.integers(0, 3)]),
+            },
+            {"value": int(rng.integers(0, 1000))},
+            version=1,
+        )
+        for i in range(n)
+    )
+
+
+def _random_ql(rng, epoch) -> str:
+    """One random query over everything written so far."""
+    t_end = T0 + (epoch + 1) * 100_000
+    agg = rng.choice(["count", "sum", "min", "max", "mean"])
+    parts = [f"SELECT {agg}(value) FROM MEASURE {MEASURE} IN {GROUP}"]
+    parts.append(f"TIME BETWEEN {T0} AND {t_end}")
+    r = rng.integers(0, 4)
+    if r == 1:
+        parts.append(f"WHERE region = 'r{rng.integers(0, REGIONS)}'")
+    elif r == 2:
+        parts.append(f"WHERE status >= {int(rng.choice([200, 404, 500]))}")
+    elif r == 3:
+        parts.append(
+            f"WHERE svc IN ('s{rng.integers(0, SVCS)}', 's{rng.integers(0, SVCS)}') "
+            f"OR region = 'r{rng.integers(0, REGIONS)}'"
+        )
+    if rng.integers(0, 2):
+        parts.append("GROUP BY " + rng.choice(["svc", "region", "svc, region"]))
+        if rng.integers(0, 3) == 0:
+            parts.append(f"TOP {int(rng.integers(2, 5))} BY value")
+    parts.append("LIMIT 200")
+    return " ".join(parts)
+
+
+def _norm(res) -> list:
+    """Order-independent comparable form with float rounding."""
+
+    def r(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(r(x) for x in v)
+        if isinstance(v, float):
+            return round(v, 6)
+        return v
+
+    if res.data_points:
+        return sorted(
+            (dp["timestamp"], tuple(sorted(dp["tags"].items())))
+            for dp in res.data_points
+        )
+    return sorted(
+        (tuple(g), tuple(r(res.values[k][i]) for k in sorted(res.values)))
+        for i, g in enumerate(res.groups)
+    )
+
+
+def run_soak(
+    *,
+    seconds: float = 0.0,
+    iterations: int = 0,
+    seed: int = 0,
+    report_path: str | None = None,
+    tmp_root: str | None = None,
+    write_every: int = 5,
+    batch: int = 400,
+) -> dict:
+    import tempfile
+
+    from banyandb_tpu import bydbql
+    from banyandb_tpu.api import SchemaRegistry, WriteRequest
+    from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo
+    from banyandb_tpu.cluster.rpc import LocalTransport
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    root = tmp_root or tempfile.mkdtemp(prefix="bydb-soak-")
+    rng = np.random.default_rng(seed)
+
+    sreg = SchemaRegistry(f"{root}/standalone")
+    _schema(sreg, shard_num=2)
+    standalone = MeasureEngine(sreg, f"{root}/standalone/data")
+
+    transport = LocalTransport()
+    nodes = []
+    for i in range(2):
+        reg = SchemaRegistry(f"{root}/n{i}")
+        _schema(reg, shard_num=4)
+        dn = DataNode(f"d{i}", reg, f"{root}/n{i}/data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+    lreg = SchemaRegistry(f"{root}/l")
+    _schema(lreg, shard_num=4)
+    liaison = Liaison(lreg, transport, nodes)
+
+    stats = {"queries": 0, "writes": 0, "divergences": 0, "errors": 0}
+    report = open(report_path, "a") if report_path else None
+    deadline = time.time() + seconds if seconds else None
+    epoch = 0
+    try:
+        while True:
+            if deadline and time.time() >= deadline:
+                break
+            if iterations and stats["queries"] >= iterations:
+                break
+            # mutate both topologies identically, keep snapshots moving
+            if stats["queries"] % write_every == 0:
+                pts = _points(rng, epoch, batch)
+                standalone.write(WriteRequest(GROUP, MEASURE, pts))
+                liaison.write_measure(WriteRequest(GROUP, MEASURE, pts))
+                if epoch % 2 == 0:
+                    standalone.flush()
+                if epoch % 3 == 0:
+                    for db in standalone._tsdbs.values():
+                        for seg in db.segments:
+                            for shard in seg.shards:
+                                shard.merge()
+                stats["writes"] += batch
+                epoch += 1
+            ql = _random_ql(rng, epoch)
+            try:
+                req = bydbql.parse(ql)
+                a = _norm(standalone.query(req))
+                b = _norm(liaison.query_measure(req))
+            except Exception as e:  # noqa: BLE001 - soak must survive
+                stats["errors"] += 1
+                if report:
+                    report.write(json.dumps({"ql": ql, "error": repr(e)}) + "\n")
+                    report.flush()
+                stats["queries"] += 1
+                continue
+            if a != b:
+                stats["divergences"] += 1
+                if report:
+                    report.write(
+                        json.dumps(
+                            {"ql": ql, "epoch": epoch,
+                             "standalone": a[:50], "cluster": b[:50]},
+                            default=str,
+                        )
+                        + "\n"
+                    )
+                    report.flush()
+            stats["queries"] += 1
+    finally:
+        if report:
+            report.close()
+    return stats
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("bydb soak (replay-diff harness)")
+    ap.add_argument("--seconds", type=float, default=60.0)
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="stop after N queries instead of a time budget")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--report", default="soak-report.jsonl")
+    args = ap.parse_args(argv)
+    stats = run_soak(
+        seconds=0 if args.iterations else args.seconds,
+        iterations=args.iterations,
+        seed=args.seed,
+        report_path=args.report,
+    )
+    print(json.dumps(stats))
+    return 1 if stats["divergences"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

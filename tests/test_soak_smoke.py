@@ -1,0 +1,38 @@
+"""Smoke run of the replay-diff soak harness (scripts/soak.py).
+
+The full soak runs for hours from the CLI; this pins the harness itself:
+a few hundred randomized queries under live writes/flushes/merges with
+zero standalone-vs-cluster divergences and zero harness errors.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import soak  # noqa: E402
+
+
+def test_soak_smoke_zero_divergence(tmp_path):
+    stats = soak.run_soak(
+        iterations=120,
+        seed=11,
+        report_path=str(tmp_path / "report.jsonl"),
+        tmp_root=str(tmp_path / "soak"),
+    )
+    assert stats["queries"] == 120
+    assert stats["writes"] > 0
+    assert stats["errors"] == 0, (tmp_path / "report.jsonl").read_text()
+    assert stats["divergences"] == 0, (tmp_path / "report.jsonl").read_text()
+
+
+def test_soak_different_seed_also_clean(tmp_path):
+    stats = soak.run_soak(
+        iterations=80,
+        seed=1234,
+        report_path=str(tmp_path / "report.jsonl"),
+        tmp_root=str(tmp_path / "soak"),
+    )
+    assert stats["divergences"] == 0 and stats["errors"] == 0, (
+        tmp_path / "report.jsonl"
+    ).read_text()
