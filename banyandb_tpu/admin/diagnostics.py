@@ -36,6 +36,22 @@ def runtime_params() -> dict:
     }
 
 
+def read_self_io() -> "tuple[int, int] | None":
+    """(read_bytes, write_bytes) of this process from /proc/self/io —
+    the one parser shared by diagnostics snapshots and the FODC agent's
+    IO telemetry source."""
+    try:
+        vals = {}
+        with open("/proc/self/io") as f:
+            for line in f:
+                k, _, v = line.partition(":")
+                if k in ("read_bytes", "write_bytes"):
+                    vals[k] = int(v)
+        return (vals.get("read_bytes", 0), vals.get("write_bytes", 0))
+    except (OSError, ValueError):
+        return None
+
+
 def process_stats() -> dict:
     out = {"uptime_s": time.monotonic()}
     try:
@@ -45,14 +61,9 @@ def process_stats() -> dict:
         out["vsz_bytes"] = int(pages[0]) * 4096
     except OSError:
         pass
-    try:
-        with open("/proc/self/io") as f:
-            for line in f:
-                k, _, v = line.partition(":")
-                if k in ("read_bytes", "write_bytes"):
-                    out[f"io_{k}"] = int(v)
-    except OSError:
-        pass
+    io = read_self_io()
+    if io is not None:
+        out["io_read_bytes"], out["io_write_bytes"] = io
     out["threads"] = threading.active_count()
     return out
 

@@ -77,6 +77,107 @@ def process_source() -> list[RawMetric]:
     ]
 
 
+def io_source() -> Callable[[], list[RawMetric]]:
+    """Host IO telemetry: the ktm eBPF io-monitor re-scoped to /proc
+    (fodc/agent/internal/ktm/iomonitor, loader.go:54 — kernel BPF IO
+    latency probes become /proc/diskstats + /proc/self/io delta rates).
+
+    Stateful: each poll reports rates/averages over the interval since
+    the previous poll.  Per physical device (partitions and loop/ram
+    devices skipped): iops, bytes/s, average await ms, utilization.
+    Process-level: read/write bytes/s of this node process.
+    """
+    from banyandb_tpu.admin.diagnostics import read_self_io
+
+    state: dict = {"ts": None, "disk": {}, "proc": None}
+
+    def whole_devices() -> Optional[set]:
+        """Whole block devices per the kernel (/sys/block lists exactly
+        those — partitions live underneath).  A name heuristic would
+        misclassify nvme0n1/mmcblk0/dm-0 as partitions."""
+        try:
+            import os as _os
+
+            return set(_os.listdir("/sys/block"))
+        except OSError:
+            return None
+
+    def read_diskstats() -> dict:
+        out = {}
+        whole = whole_devices()
+        try:
+            with open("/proc/diskstats") as f:
+                for line in f:
+                    p = line.split()
+                    if len(p) < 14:
+                        continue
+                    name = p[2]
+                    if name.startswith(("loop", "ram", "zram")):
+                        continue
+                    if whole is not None:
+                        if name not in whole:
+                            continue  # partition
+                    elif name[-1].isdigit() and not name.startswith(
+                        ("nvme", "mmcblk", "dm-", "md")
+                    ):
+                        continue  # fallback heuristic without /sys/block
+                    # fields: 4=reads 6=sectors_read 7=ms_reading
+                    #         8=writes 10=sectors_written 11=ms_writing
+                    #         13=ms_doing_io
+                    out[name] = (
+                        int(p[3]) + int(p[7]),           # ios completed
+                        (int(p[5]) + int(p[9])) * 512,   # bytes
+                        int(p[6]) + int(p[10]),          # ms waiting
+                        int(p[12]),                      # ms device busy
+                    )
+        except OSError:
+            pass
+        return out
+
+    read_proc_io = read_self_io
+
+    def poll() -> list[RawMetric]:
+        now_s = time.time()
+        now = int(now_s * 1000)
+        disk = read_diskstats()
+        proc = read_proc_io()
+        prev_ts = state["ts"]
+        out: list[RawMetric] = []
+        if prev_ts is not None and now_s > prev_ts:
+            dt = now_s - prev_ts
+            for name, cur in disk.items():
+                prev = state["disk"].get(name)
+                if prev is None:
+                    continue
+                d_ios = cur[0] - prev[0]
+                d_bytes = cur[1] - prev[1]
+                d_wait = cur[2] - prev[2]
+                d_busy = cur[3] - prev[3]
+                lbl = (("device", name),)
+                out.append(RawMetric("disk_iops", lbl, d_ios / dt, GAUGE, now))
+                out.append(RawMetric("disk_bytes_per_s", lbl, d_bytes / dt, GAUGE, now))
+                out.append(RawMetric(
+                    "disk_await_ms", lbl,
+                    (d_wait / d_ios) if d_ios else 0.0, GAUGE, now,
+                ))
+                out.append(RawMetric(
+                    "disk_util", lbl, min(1.0, d_busy / (dt * 1000.0)), GAUGE, now,
+                ))
+            if proc is not None and state["proc"] is not None:
+                out.append(RawMetric(
+                    "process_read_bytes_per_s", (),
+                    (proc[0] - state["proc"][0]) / dt, GAUGE, now,
+                ))
+                out.append(RawMetric(
+                    "process_write_bytes_per_s", (),
+                    (proc[1] - state["proc"][1]) / dt, GAUGE, now,
+                ))
+        state["ts"], state["disk"], state["proc"] = now_s, disk, proc
+        return out
+
+    return poll
+
+
 class FlightRecorder:
     """Windowed ring of metric cycles (fodc flight recorder analog).
 

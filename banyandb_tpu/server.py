@@ -164,7 +164,11 @@ class StandaloneServer:
         self.flight_recorder = fodc_agent.FlightRecorder()
         self.watchdog = fodc_agent.Watchdog(
             self.flight_recorder,
-            [fodc_agent.meter_source(self.meter), fodc_agent.process_source],
+            [
+                fodc_agent.meter_source(self.meter),
+                fodc_agent.process_source,
+                fodc_agent.io_source(),  # ktm io-monitor host re-scope
+            ],
             node_role="standalone",
         )
         self.pressure_profiler = None
