@@ -134,13 +134,12 @@ def io_source() -> Callable[[], list[RawMetric]]:
             pass
         return out
 
-    read_proc_io = read_self_io
 
     def poll() -> list[RawMetric]:
         now_s = time.time()
         now = int(now_s * 1000)
         disk = read_diskstats()
-        proc = read_proc_io()
+        proc = read_self_io()
         prev_ts = state["ts"]
         out: list[RawMetric] = []
         if prev_ts is not None and now_s > prev_ts:
@@ -153,6 +152,11 @@ def io_source() -> Callable[[], list[RawMetric]]:
                 d_bytes = cur[1] - prev[1]
                 d_wait = cur[2] - prev[2]
                 d_busy = cur[3] - prev[3]
+                if min(d_ios, d_bytes, d_wait, d_busy) < 0:
+                    # counter wrap (io_ticks wraps ~49 busy-days) or a
+                    # device reset under the same name: skip the interval
+                    # rather than publish negative rates
+                    continue
                 lbl = (("device", name),)
                 out.append(RawMetric("disk_iops", lbl, d_ios / dt, GAUGE, now))
                 out.append(RawMetric("disk_bytes_per_s", lbl, d_bytes / dt, GAUGE, now))
@@ -163,7 +167,12 @@ def io_source() -> Callable[[], list[RawMetric]]:
                 out.append(RawMetric(
                     "disk_util", lbl, min(1.0, d_busy / (dt * 1000.0)), GAUGE, now,
                 ))
-            if proc is not None and state["proc"] is not None:
+            if (
+                proc is not None
+                and state["proc"] is not None
+                and proc[0] >= state["proc"][0]
+                and proc[1] >= state["proc"][1]
+            ):
                 out.append(RawMetric(
                     "process_read_bytes_per_s", (),
                     (proc[0] - state["proc"][0]) / dt, GAUGE, now,

@@ -1,0 +1,229 @@
+"""Load/SLO harness (test/load analog, BASELINE-style workload shape).
+
+Boots a REAL standalone server (gRPC bus transport on a socket), then
+drives a sustained mixed workload from concurrent client threads:
+
+  - writer threads: batched measure writes (svc/region/status tags,
+    one numeric field) — the benchmark-single-model ingest shape,
+  - query threads: randomized BydbQL aggregates over the trailing
+    window — the 50-concurrent-query read side,
+
+while the server's own lifecycle loops flush/merge/retain underneath.
+Reports sustained write points/min, query throughput, and query latency
+p50/p90/p99 — the same metrics the reference's published benchmark
+tables carry (docs/operation/benchmark/benchmark-single-model.md) —
+plus SLO pass/fail against optional floors.
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python scripts/load.py \
+        --seconds 60 --writers 2 --queriers 4 \
+        --min-writes-per-min 1000000 --max-p99-ms 250
+
+Importable: run_load() powers tests/test_load_smoke.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+T0 = 1_700_000_000_000
+GROUP, MEASURE = "load", "cpm"
+
+
+def _percentile(xs: list, q: float) -> float:
+    return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+
+def run_load(
+    *,
+    seconds: float = 30.0,
+    writers: int = 2,
+    queriers: int = 4,
+    batch: int = 500,
+    seed: int = 0,
+    tmp_root: str | None = None,
+) -> dict:
+    import tempfile
+
+    from banyandb_tpu.cluster.rpc import GrpcTransport
+    from banyandb_tpu.server import TOPIC_REGISTRY, StandaloneServer
+
+    own_root = tmp_root is None
+    root = tmp_root or tempfile.mkdtemp(prefix="bydb-load-")
+    srv = StandaloneServer(root, port=0)
+    srv.start()
+    addr = srv.addr
+
+    def call(transport, topic, env, timeout=60.0):
+        return transport.call(addr, topic, env, timeout=timeout)
+
+    try:
+        setup = GrpcTransport()
+        try:
+            call(setup, TOPIC_REGISTRY, {"op": "create", "kind": "group", "item": {
+                "name": GROUP, "catalog": "measure",
+                "resource_opts": {
+                    "shard_num": 2, "replicas": 0,
+                    "segment_interval": {"num": 1, "unit": "day"},
+                    "ttl": {"num": 7, "unit": "day"}, "stages": [],
+                },
+            }})
+            call(setup, TOPIC_REGISTRY, {"op": "create", "kind": "measure", "item": {
+                "group": GROUP, "name": MEASURE,
+                "tags": [{"name": "svc", "type": "string"},
+                         {"name": "region", "type": "string"},
+                         {"name": "status", "type": "int"}],
+                "fields": [{"name": "value", "type": "float"}],
+                "entity": {"tag_names": ["svc"]}, "interval": "", "index_mode": False,
+            }})
+        finally:
+            setup.close()
+        return _drive_load(
+            call, seconds=seconds, writers=writers,
+            queriers=queriers, batch=batch, seed=seed,
+        )
+    finally:
+        srv.stop()
+        if own_root:
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+
+
+def _drive_load(call, *, seconds, writers, queriers, batch, seed) -> dict:
+    from banyandb_tpu.cluster.bus import Topic
+    from banyandb_tpu.cluster.rpc import GrpcTransport
+    from banyandb_tpu.server import TOPIC_QL
+
+    stop = threading.Event()
+    written = [0] * writers
+    write_errors = [0] * writers
+    q_lat_ms: list[list[float]] = [[] for _ in range(queriers)]
+    q_errors = [0] * queriers
+    clock0 = time.time()
+
+    def writer(wid: int):
+        rng = np.random.default_rng(seed + wid)
+        t = GrpcTransport()
+        try:
+            while not stop.is_set():
+                pts = [
+                    {
+                        # disjoint per-writer timestamp lanes: stride by
+                        # writer count so no two writers ever collide on
+                        # (series, ts) and silently overwrite each other
+                        "ts": T0 + ((written[wid] + j) * writers + wid) * 10,
+                        "tags": {
+                            "svc": f"s{rng.integers(0, 50)}",
+                            "region": f"r{rng.integers(0, 3)}",
+                            "status": int((200, 404, 500)[rng.integers(0, 3)]),
+                        },
+                        "fields": {"value": float(rng.integers(0, 1000))},
+                        "version": 1,
+                    }
+                    for j in range(batch)
+                ]
+                try:
+                    call(t, Topic.MEASURE_WRITE.value,
+                         {"request": {"group": GROUP, "name": MEASURE, "points": pts}})
+                    written[wid] += batch
+                except Exception:  # noqa: BLE001 - keep load flowing
+                    write_errors[wid] += 1
+        finally:
+            t.close()
+
+    AGGS = ("count", "sum", "mean", "max")
+
+    def querier(qid: int):
+        rng = np.random.default_rng(1000 + seed + qid)
+        t = GrpcTransport()
+        try:
+            while not stop.is_set():
+                agg = AGGS[rng.integers(0, len(AGGS))]
+                where = (
+                    f"WHERE region = 'r{rng.integers(0, 3)}' "
+                    if rng.integers(0, 2) else ""
+                )
+                group_by = "GROUP BY svc " if rng.integers(0, 2) else ""
+                ql = (
+                    f"SELECT {agg}(value) FROM MEASURE {MEASURE} IN {GROUP} "
+                    f"TIME BETWEEN {T0} AND {T0 + 10_000_000_000} "
+                    f"{where}{group_by}LIMIT 100"
+                )
+                t0 = time.perf_counter()
+                try:
+                    call(t, TOPIC_QL, {"ql": ql})
+                    q_lat_ms[qid].append((time.perf_counter() - t0) * 1000)
+                except Exception:  # noqa: BLE001
+                    q_errors[qid] += 1
+        finally:
+            t.close()
+
+    threads = [
+        threading.Thread(target=writer, args=(w,), daemon=True)
+        for w in range(writers)
+    ] + [
+        threading.Thread(target=querier, args=(q,), daemon=True)
+        for q in range(queriers)
+    ]
+    for th in threads:
+        th.start()
+    time.sleep(seconds)
+    stop.set()
+    for th in threads:
+        th.join(timeout=30)
+    elapsed = time.time() - clock0
+
+    lats = sorted(x for bucket in q_lat_ms for x in bucket)
+    total_written = sum(written)
+    return {
+        "seconds": round(elapsed, 1),
+        "writers": writers,
+        "queriers": queriers,
+        "points_written": total_written,
+        "write_points_per_min": round(total_written / elapsed * 60),
+        "write_errors": sum(write_errors),
+        "queries": len(lats),
+        "queries_per_s": round(len(lats) / elapsed, 1),
+        "query_errors": sum(q_errors),
+        "latency_ms": {
+            "p50": round(_percentile(lats, 50), 1),
+            "p90": round(_percentile(lats, 90), 1),
+            "p99": round(_percentile(lats, 99), 1),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("bydb load (throughput/SLO harness)")
+    ap.add_argument("--seconds", type=float, default=60.0)
+    ap.add_argument("--writers", type=int, default=2)
+    ap.add_argument("--queriers", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=500)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-writes-per-min", type=int, default=0)
+    ap.add_argument("--max-p99-ms", type=float, default=0.0)
+    args = ap.parse_args(argv)
+    stats = run_load(
+        seconds=args.seconds, writers=args.writers,
+        queriers=args.queriers, batch=args.batch, seed=args.seed,
+    )
+    slo_fail = []
+    if args.min_writes_per_min and stats["write_points_per_min"] < args.min_writes_per_min:
+        slo_fail.append("write_points_per_min")
+    if args.max_p99_ms and stats["latency_ms"]["p99"] > args.max_p99_ms:
+        slo_fail.append("p99")
+    if stats["write_errors"] or stats["query_errors"]:
+        slo_fail.append("errors")
+    stats["slo_fail"] = slo_fail
+    print(json.dumps(stats))
+    return 1 if slo_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,8 +114,10 @@ def test_io_source_reports_rate_deltas(tmp_path):
         os.fsync(f.fileno())
     after = read_self_io()
     metrics = {m.name: m for m in src()}
+    if before is None or after is None:
+        return  # kernel without task IO accounting: a supported path
     assert "process_write_bytes_per_s" in metrics
-    if before and after and after[1] > before[1]:
+    if after[1] > before[1]:
         # only when the kernel charged the write to the storage layer
         # (tmp_path on tmpfs never moves the counter)
         assert metrics["process_write_bytes_per_s"].value > 0

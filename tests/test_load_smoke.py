@@ -1,0 +1,22 @@
+"""Smoke run of the load/SLO harness (scripts/load.py): a short mixed
+write+query burst against a real gRPC-served standalone server must
+complete with zero errors and sane counters."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import load  # noqa: E402
+
+
+def test_load_smoke(tmp_path):
+    stats = load.run_load(
+        seconds=4.0, writers=1, queriers=2, batch=200, seed=3,
+        tmp_root=str(tmp_path / "srv"),
+    )
+    assert stats["write_errors"] == 0
+    assert stats["query_errors"] == 0
+    assert stats["points_written"] >= 200
+    assert stats["queries"] >= 4
+    assert stats["latency_ms"]["p99"] >= stats["latency_ms"]["p50"] > 0
