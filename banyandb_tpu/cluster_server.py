@@ -1,0 +1,240 @@
+"""Data- and liaison-role server processes (pkg/cmdsetup/{data,liaison}.go
+analog): the multi-process cluster form of the standalone server.
+
+Role topology mirrors the reference (SURVEY §1): liaisons are the user
+gateway — they own schema CRUD (pushed to data nodes over the schema
+plane), route writes by entity shard, and scatter/merge queries; data
+nodes own storage shards behind the gRPC bus.
+
+    # data nodes (one per process/host)
+    python -m banyandb_tpu.server --role data --root /var/n0 --port 18912
+
+    # discovery file listing the data nodes
+    [{"name": "n0", "addr": "10.0.0.1:18912", "roles": ["data"]}, ...]
+
+    # liaison (user gateway; bydbctl targets this address)
+    python -m banyandb_tpu.server --role liaison --root /var/l \
+        --port 17912 --discovery nodes.json --replicas 1
+
+Both classes are the in-process composition roots the reference builds
+in cmdsetup: tests boot real multi-node clusters by instantiating them
+directly (the pkg/test/setup trick), production runs one per process.
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.cluster.bus import LocalBus, Topic
+from banyandb_tpu.cluster.data_node import DataNode
+from banyandb_tpu.cluster.discovery import FileDiscovery
+from banyandb_tpu.cluster.liaison import Liaison
+from banyandb_tpu.cluster.rpc import GrpcBusServer, GrpcTransport
+
+
+class DataServer:
+    """Data role: a DataNode behind a gRPC bus + lifecycle loops."""
+
+    def __init__(self, root: str | Path, *, name: str = "", port: int = 0):
+        self.root = Path(root)
+        self.registry = SchemaRegistry(self.root)
+        self.name = name or self.root.name or "data"
+        self.node = DataNode(self.name, self.registry, self.root / "data")
+        self.grpc = GrpcBusServer(self.node.bus, port=port)
+
+    @property
+    def addr(self) -> str:
+        return self.grpc.addr
+
+    def start(self) -> "DataServer":
+        self.grpc.start()
+        self.node.start_lifecycle()
+        return self
+
+    def stop(self) -> None:
+        self.node.stop_lifecycle()
+        self.grpc.stop()
+
+
+class LiaisonServer:
+    """Liaison role: user-facing bus surface over the cluster fabric.
+
+    Serves the same user topics as the standalone server (health,
+    registry, writes, BydbQL, trace lookup) so bydbctl works unchanged —
+    but every handler delegates to the Liaison's distributed paths:
+    schema CRUD pushes to all data nodes, writes route by shard with
+    replica fan-out + handoff, queries scatter and merge.
+    """
+
+    PROBE_INTERVAL_S = 5.0
+
+    def __init__(
+        self,
+        root: str | Path,
+        discovery_file: str | Path,
+        *,
+        port: int = 0,
+        replicas: int = 0,
+    ):
+        self.root = Path(root)
+        self.registry = SchemaRegistry(self.root)
+        self.transport = GrpcTransport()
+        self.liaison = Liaison(
+            self.registry,
+            self.transport,
+            discovery=FileDiscovery(discovery_file),
+            replicas=replicas,
+            handoff_root=str(self.root / "handoff"),
+        )
+        self.bus = LocalBus()
+        self._register()
+        self.grpc = GrpcBusServer(self.bus, port=port)
+        self._stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+
+    @property
+    def addr(self) -> str:
+        return self.grpc.addr
+
+    # -- user surface -------------------------------------------------------
+    def _register(self) -> None:
+        from banyandb_tpu.server import TOPIC_QL, TOPIC_REGISTRY
+
+        b = self.bus
+        b.subscribe(
+            Topic.HEALTH,
+            lambda env: {
+                "status": "ok",
+                "role": "liaison",
+                "alive": sorted(self.liaison.alive),
+            },
+        )
+        b.subscribe(TOPIC_REGISTRY, self._registry_op)
+        b.subscribe(Topic.MEASURE_WRITE, self._measure_write)
+        b.subscribe(Topic.STREAM_WRITE, self._stream_write)
+        b.subscribe(Topic.TRACE_WRITE, self._trace_write)
+        b.subscribe(Topic.TRACE_QUERY_BY_ID, self._trace_query_by_id)
+        b.subscribe(TOPIC_QL, self._ql)
+
+    def _registry_op(self, env: dict):
+        """Schema CRUD lands in the liaison registry, then pushes to every
+        data node over the schema plane (liaison/grpc/registry.go analog;
+        down nodes converge via handoff replay / gossip)."""
+        from banyandb_tpu.api import schema as schema_mod
+        from banyandb_tpu.api.schema import Stream, Trace
+
+        op, kind = env["op"], env["kind"]
+        if op == "create":
+            cls = schema_mod._KINDS[kind]
+            obj = schema_mod._from_jsonable(cls, env["item"])
+            create = {
+                "group": self.registry.create_group,
+                "measure": self.registry.create_measure,
+                "index_rule": self.registry.create_index_rule,
+                "topn": self.registry.create_topn,
+            }[kind]
+            rev = create(obj)
+            acks = self.liaison.sync_schema(kind, obj)
+            return {"revision": rev, "acks": {n: a.get("revision") for n, a in acks.items()}}
+        if op == "create_stream":
+            obj = schema_mod._from_jsonable(Stream, env["item"])
+            rev = self.registry.create_stream(obj)
+            self.liaison.sync_schema("stream", obj)
+            return {"revision": rev}
+        if op == "create_trace":
+            obj = schema_mod._from_jsonable(Trace, env["item"])
+            rev = self.registry.create_trace(obj)
+            self.liaison.sync_schema("trace", obj)
+            return {"revision": rev}
+        if op == "list":
+            if kind == "group":
+                items = self.registry.list_groups()
+            elif kind == "measure":
+                items = self.registry.list_measures(env["group"])
+            else:
+                raise KeyError(kind)
+            return {"items": [schema_mod._to_jsonable(i) for i in items]}
+        raise KeyError(f"bad registry op {op}")
+
+    def _measure_write(self, env: dict):
+        from banyandb_tpu.cluster import serde
+
+        req = serde.write_request_from_json(env["request"])
+        return {"written": self.liaison.write_measure(req)}
+
+    def _stream_write(self, env: dict):
+        from banyandb_tpu.api.schema import _to_jsonable
+
+        n = self.liaison.write_stream(
+            env["group"], env["name"],
+            _to_jsonable(self.registry.get_stream(env["group"], env["name"])),
+            env["elements"],
+        )
+        return {"written": n}
+
+    def _trace_write(self, env: dict):
+        from banyandb_tpu.api.schema import _to_jsonable
+
+        n = self.liaison.write_trace(
+            env["group"], env["name"],
+            _to_jsonable(self.registry.get_trace(env["group"], env["name"])),
+            env["spans"],
+            ordered_tags=tuple(env.get("ordered_tags", ())),
+        )
+        return {"written": n}
+
+    def _trace_query_by_id(self, env: dict):
+        from banyandb_tpu.cluster import serde
+
+        spans = self.liaison.query_trace_by_id(
+            env["group"], env["name"], env["trace_id"]
+        )
+        return {"spans": serde.spans_to_json(spans)}
+
+    def _ql(self, env: dict):
+        from banyandb_tpu import bydbql
+        from banyandb_tpu.server import result_to_json
+
+        catalog, req = bydbql.parse_with_catalog(
+            env["ql"], env.get("params", ())
+        )
+        if catalog == "measure":
+            res = self.liaison.query_measure(req)
+        elif catalog == "stream":
+            res = self.liaison.query_stream(req)
+        else:
+            raise ValueError(
+                f"liaison QL serves measure/stream catalogs; {catalog} "
+                "queries use the dedicated topics"
+            )
+        return {"result": result_to_json(res)}
+
+    # -- lifecycle ----------------------------------------------------------
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.PROBE_INTERVAL_S):
+            try:
+                self.liaison.refresh_nodes()
+                self.liaison.probe()
+            except Exception:  # noqa: BLE001 - keep probing
+                import logging
+
+                logging.getLogger(__name__).exception("liaison probe failed")
+
+    def start(self) -> "LiaisonServer":
+        self.grpc.start()
+        self.liaison.probe()
+        self._stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="liaison-probe", daemon=True
+        )
+        self._probe_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=10)
+        self.grpc.stop()
+        self.transport.close()

@@ -612,6 +612,13 @@ def build_config():
     )
     cfg.register("http-port", 17913, "HTTP/JSON gateway; -1 disables", int)
     cfg.register("pprof-port", -1, "profiling endpoints; -1 disables", int)
+    # role topology (pkg/cmdsetup/root.go:89-91 standalone/data/liaison)
+    cfg.register("role", "standalone", "standalone | data | liaison", str)
+    cfg.register("name", "", "node name (data role)", str)
+    cfg.register(
+        "discovery", "", "node-list JSON file (liaison role)", str
+    )
+    cfg.register("replicas", 0, "replica count (liaison role)", int)
     return cfg
 
 
@@ -619,25 +626,86 @@ def main(argv=None) -> None:
     from banyandb_tpu.run import FuncUnit, Group
 
     s = build_config().load(argv)
-    srv = StandaloneServer(
-        s.root,
-        s.port,
-        wire_port=None if s.wire_port < 0 else s.wire_port,
-        http_port=None if s.http_port < 0 else s.http_port,
-        pprof_port=None if s.pprof_port < 0 else s.pprof_port,
-    )
+    # role-irrelevant flags must not silently do nothing (an operator
+    # passing --http-port to a liaison would wait on a port never bound)
+    _ignored = {
+        "data": [
+            ("wire-port", s.wire_port != 17914),
+            ("http-port", s.http_port != 17913),
+            ("pprof-port", s.pprof_port != -1),
+            ("discovery", bool(s.discovery)),
+            ("replicas", s.replicas != 0),
+        ],
+        "liaison": [
+            ("wire-port", s.wire_port != 17914),
+            ("http-port", s.http_port != 17913),
+            ("pprof-port", s.pprof_port != -1),
+            ("name", bool(s.name)),
+        ],
+        "standalone": [
+            ("discovery", bool(s.discovery)),
+            ("replicas", s.replicas != 0),
+            ("name", bool(s.name)),
+        ],
+    }.get(s.role, [])
+    for flag, was_set in _ignored:
+        if was_set:
+            import sys as _sys
 
-    def announce():
-        srv.start()
-        print(f"banyandb-tpu standalone listening on {srv.addr}", flush=True)
-        if srv.wire is not None:
-            print(f"wire gRPC (banyandb.*.v1) on :{srv.wire.port}", flush=True)
-        if srv.http is not None:
-            print(f"HTTP gateway + console on :{srv.http.port}", flush=True)
-        if srv.pprof is not None:
-            print(f"profiling endpoints on :{srv.pprof.port}", flush=True)
+            print(
+                f"warning: --{flag} has no effect with --role {s.role}",
+                file=_sys.stderr,
+                flush=True,
+            )
+    if s.role == "data":
+        from banyandb_tpu.cluster_server import DataServer
 
-    group = Group("standalone")
+        srv = DataServer(s.root, name=s.name, port=s.port)
+
+        def announce():
+            srv.start()
+            print(
+                f"banyandb-tpu data node {srv.name!r} on {srv.addr}",
+                flush=True,
+            )
+    elif s.role == "liaison":
+        from banyandb_tpu.cluster_server import LiaisonServer
+
+        if not s.discovery:
+            raise SystemExit("liaison role requires --discovery <nodes.json>")
+        srv = LiaisonServer(
+            s.root, s.discovery, port=s.port, replicas=s.replicas
+        )
+
+        def announce():
+            srv.start()
+            print(
+                f"banyandb-tpu liaison on {srv.addr} "
+                f"(data nodes alive: {sorted(srv.liaison.alive)})",
+                flush=True,
+            )
+    elif s.role != "standalone":
+        raise SystemExit(f"unknown role {s.role!r}")
+    else:
+        srv = StandaloneServer(
+            s.root,
+            s.port,
+            wire_port=None if s.wire_port < 0 else s.wire_port,
+            http_port=None if s.http_port < 0 else s.http_port,
+            pprof_port=None if s.pprof_port < 0 else s.pprof_port,
+        )
+
+        def announce():
+            srv.start()
+            print(f"banyandb-tpu standalone listening on {srv.addr}", flush=True)
+            if srv.wire is not None:
+                print(f"wire gRPC (banyandb.*.v1) on :{srv.wire.port}", flush=True)
+            if srv.http is not None:
+                print(f"HTTP gateway + console on :{srv.http.port}", flush=True)
+            if srv.pprof is not None:
+                print(f"profiling endpoints on :{srv.pprof.port}", flush=True)
+
+    group = Group(s.role)
     group.add(FuncUnit("server", serve=announce, stop=srv.stop))
     # panic supervisor: uncaught exceptions on any thread write a crash
     # artifact and trigger orderly teardown (supervisor.go analog)
