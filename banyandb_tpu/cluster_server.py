@@ -58,14 +58,90 @@ class DataServer:
         self.grpc.stop()
 
 
+class _LiaisonMeasureAdapter:
+    """Engine-shaped facade over the liaison's distributed measure plane,
+    so WireServices (built against engine call signatures) serves the
+    cluster unchanged — the liaison/grpc/measure.go role."""
+
+    def __init__(self, liaison):
+        self._l = liaison
+
+    def query(self, req, shard_ids=None):
+        return self._l.query_measure(req)
+
+    def write(self, req, _internal: bool = False) -> int:
+        return self._l.write_measure(req)
+
+    def flush(self, group=None) -> list:
+        # parts materialize on data nodes' own lifecycle loops; the
+        # liaison holds no local measure storage to flush
+        return []
+
+
+class _LiaisonStreamAdapter:
+    def __init__(self, liaison, registry):
+        self._l = liaison
+        self._reg = registry
+
+    def query(self, req, shard_ids=None):
+        return self._l.query_stream(req)
+
+    def write(self, group: str, name: str, elements) -> int:
+        import base64
+
+        from banyandb_tpu.api.schema import _to_jsonable
+
+        return self._l.write_stream(
+            group, name, _to_jsonable(self._reg.get_stream(group, name)),
+            [
+                {
+                    "element_id": e.element_id,
+                    "ts": e.ts_millis,
+                    "tags": e.tags,
+                    "body": base64.b64encode(e.body).decode(),
+                }
+                for e in elements
+            ],
+        )
+
+
+class _LiaisonTraceAdapter:
+    def __init__(self, liaison, registry):
+        self._l = liaison
+        self._reg = registry
+
+    def query_by_trace_id(self, group: str, name: str, trace_id: str):
+        return self._l.query_trace_by_id(group, name, trace_id)
+
+    def write(self, group: str, name: str, spans, *, ordered_tags=()) -> int:
+        import base64
+
+        from banyandb_tpu.api.schema import _to_jsonable
+
+        return self._l.write_trace(
+            group, name, _to_jsonable(self._reg.get_trace(group, name)),
+            [
+                {
+                    "ts": s.ts_millis,
+                    "tags": s.tags,
+                    "span": base64.b64encode(s.span).decode(),
+                }
+                for s in spans
+            ],
+            ordered_tags=tuple(ordered_tags),
+        )
+
+
 class LiaisonServer:
-    """Liaison role: user-facing bus surface over the cluster fabric.
+    """Liaison role: user-facing surfaces over the cluster fabric.
 
     Serves the same user topics as the standalone server (health,
-    registry, writes, BydbQL, trace lookup) so bydbctl works unchanged —
-    but every handler delegates to the Liaison's distributed paths:
-    schema CRUD pushes to all data nodes, writes route by shard with
-    replica fan-out + handoff, queries scatter and merge.
+    registry, writes, BydbQL, trace lookup) so bydbctl works unchanged,
+    plus — via engine-shaped adapters — the reference-proto gRPC wire
+    and the HTTP gateway/console.  Every handler delegates to the
+    Liaison's distributed paths: schema CRUD pushes to all data nodes,
+    writes route by shard with replica fan-out + handoff, queries
+    scatter and merge.
     """
 
     PROBE_INTERVAL_S = 5.0
@@ -77,6 +153,9 @@ class LiaisonServer:
         *,
         port: int = 0,
         replicas: int = 0,
+        wire_port: int | None = None,
+        http_port: int | None = None,
+        auth_file: str | None = None,
     ):
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
@@ -88,11 +167,78 @@ class LiaisonServer:
             replicas=replicas,
             handoff_root=str(self.root / "handoff"),
         )
+        # schema plane: EVERY create/update on this liaison's registry —
+        # whatever surface it arrived on (bus topic, proto wire, HTTP
+        # gateway) — pushes to all data nodes (liaison/grpc/registry.go
+        # behavior); acks are recorded per object for barrier callers
+        self._sync_acks: dict = {}
+        self.registry.watch(self._on_schema_put)
         self.bus = LocalBus()
         self._register()
         self.grpc = GrpcBusServer(self.bus, port=port)
+        self.wire = None
+        self.http = None
+        if wire_port is not None or http_port is not None:
+            from banyandb_tpu.api.grpc_server import WireServices
+
+            self._wire_services = WireServices(
+                self.registry,
+                _LiaisonMeasureAdapter(self.liaison),
+                _LiaisonStreamAdapter(self.liaison, self.registry),
+                trace_engine=_LiaisonTraceAdapter(self.liaison, self.registry),
+                node_info={"name": "liaison", "roles": ("liaison",)},
+                cluster_view_fn=self._cluster_view,
+            )
+        if wire_port is not None:
+            from banyandb_tpu.api.grpc_server import WireServer
+
+            self.wire = WireServer(
+                self._wire_services, port=wire_port, auth_file=auth_file
+            )
+        if http_port is not None:
+            from banyandb_tpu.api.auth import AuthReloader
+            from banyandb_tpu.api.http_gateway import HttpGateway
+
+            http_auth = None
+            if auth_file:
+                http_auth = (
+                    self.wire.auth
+                    if self.wire is not None and self.wire.auth is not None
+                    else AuthReloader(auth_file)
+                )
+            self.http = HttpGateway(
+                self._wire_services, port=http_port, auth=http_auth
+            )
         self._stop = threading.Event()
         self._probe_thread: threading.Thread | None = None
+
+    def _on_schema_put(self, kind: str, obj, revision: int) -> None:
+        try:
+            acks = self.liaison.sync_schema(kind, obj)
+            self._sync_acks[(kind, self.registry._key(obj))] = acks
+        except Exception:  # noqa: BLE001 - a down fabric must not fail
+            # the local registry write; nodes converge via handoff/gossip
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "schema push failed for %s", kind
+            )
+
+    def _cluster_view(self) -> dict:
+        nodes = [
+            {"name": n.name, "grpc_address": n.addr, "roles": list(n.roles)}
+            for n in self.liaison.selector.nodes
+        ]
+        return {
+            "tire2": {
+                "registered": nodes,
+                "active": sorted(self.liaison.alive),
+                "evictable": sorted(
+                    {n.name for n in self.liaison.selector.nodes}
+                    - self.liaison.alive
+                ),
+            }
+        }
 
     @property
     def addr(self) -> str:
@@ -136,18 +282,16 @@ class LiaisonServer:
                 "topn": self.registry.create_topn,
             }[kind]
             rev = create(obj)
-            acks = self.liaison.sync_schema(kind, obj)
+            # the registry watcher already pushed synchronously; surface
+            # its per-node acks to the caller
+            acks = self._sync_acks.get((kind, self.registry._key(obj)), {})
             return {"revision": rev, "acks": {n: a.get("revision") for n, a in acks.items()}}
         if op == "create_stream":
             obj = schema_mod._from_jsonable(Stream, env["item"])
-            rev = self.registry.create_stream(obj)
-            self.liaison.sync_schema("stream", obj)
-            return {"revision": rev}
+            return {"revision": self.registry.create_stream(obj)}
         if op == "create_trace":
             obj = schema_mod._from_jsonable(Trace, env["item"])
-            rev = self.registry.create_trace(obj)
-            self.liaison.sync_schema("trace", obj)
-            return {"revision": rev}
+            return {"revision": self.registry.create_trace(obj)}
         if op == "list":
             if kind == "group":
                 items = self.registry.list_groups()
@@ -224,6 +368,10 @@ class LiaisonServer:
 
     def start(self) -> "LiaisonServer":
         self.grpc.start()
+        if self.wire is not None:
+            self.wire.start()
+        if self.http is not None:
+            self.http.start()
         self.liaison.probe()
         self._stop.clear()
         self._probe_thread = threading.Thread(
@@ -236,5 +384,9 @@ class LiaisonServer:
         self._stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10)
+        if self.http is not None:
+            self.http.stop()
+        if self.wire is not None:
+            self.wire.stop()
         self.grpc.stop()
         self.transport.close()

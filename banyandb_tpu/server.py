@@ -637,8 +637,6 @@ def main(argv=None) -> None:
             ("replicas", s.replicas != 0),
         ],
         "liaison": [
-            ("wire-port", s.wire_port != 17914),
-            ("http-port", s.http_port != 17913),
             ("pprof-port", s.pprof_port != -1),
             ("name", bool(s.name)),
         ],
@@ -674,7 +672,9 @@ def main(argv=None) -> None:
         if not s.discovery:
             raise SystemExit("liaison role requires --discovery <nodes.json>")
         srv = LiaisonServer(
-            s.root, s.discovery, port=s.port, replicas=s.replicas
+            s.root, s.discovery, port=s.port, replicas=s.replicas,
+            wire_port=None if s.wire_port < 0 else s.wire_port,
+            http_port=None if s.http_port < 0 else s.http_port,
         )
 
         def announce():
@@ -684,6 +684,10 @@ def main(argv=None) -> None:
                 f"(data nodes alive: {sorted(srv.liaison.alive)})",
                 flush=True,
             )
+            if srv.wire is not None:
+                print(f"wire gRPC (banyandb.*.v1) on :{srv.wire.port}", flush=True)
+            if srv.http is not None:
+                print(f"HTTP gateway + console on :{srv.http.port}", flush=True)
     elif s.role != "standalone":
         raise SystemExit(f"unknown role {s.role!r}")
     else:

@@ -119,6 +119,103 @@ def test_role_topology_survives_data_node_loss(topology):
     assert res["values"]["count"][0] == 60
 
 
+def test_liaison_wire_and_http_surfaces(tmp_path):
+    """The liaison serves the reference-proto gRPC wire and the HTTP
+    gateway over the CLUSTER (liaison/grpc + liaison/http analog):
+    schema CRUD on any surface pushes to data nodes via the registry
+    watcher; writes/queries ride the distributed paths."""
+    import urllib.request
+
+    import grpc
+
+    from banyandb_tpu.api import pb
+
+    data = [
+        DataServer(tmp_path / f"n{i}", name=f"n{i}").start() for i in range(2)
+    ]
+    nodes_file = tmp_path / "nodes.json"
+    nodes_file.write_text(json.dumps([
+        {"name": d.name, "addr": d.addr, "roles": ["data"]} for d in data
+    ]))
+    liaison = LiaisonServer(
+        tmp_path / "liaison", nodes_file, replicas=1, wire_port=0, http_port=0
+    ).start()
+    try:
+        chan = grpc.insecure_channel(f"127.0.0.1:{liaison.wire.port}")
+        rpc = pb.database_rpc_pb2
+
+        def method(service, name, req_cls, resp_cls):
+            return chan.unary_unary(
+                f"/banyandb.database.v1.{service}/{name}",
+                request_serializer=req_cls.SerializeToString,
+                response_deserializer=resp_cls.FromString,
+            )
+
+        # group + measure CRUD over the proto wire
+        greq = rpc.GroupRegistryServiceCreateRequest()
+        greq.group.metadata.name = "sw"
+        greq.group.catalog = 1  # CATALOG_MEASURE
+        greq.group.resource_opts.shard_num = 4
+        greq.group.resource_opts.replicas = 1
+        method("GroupRegistryService", "Create",
+               rpc.GroupRegistryServiceCreateRequest,
+               rpc.GroupRegistryServiceCreateResponse)(greq)
+        mreq = rpc.MeasureRegistryServiceCreateRequest()
+        mreq.measure.metadata.group = "sw"
+        mreq.measure.metadata.name = "cpm"
+        t = mreq.measure.tag_families.add()
+        t.name = "default"
+        ts = t.tags.add(); ts.name = "svc"; ts.type = 1  # TAG_TYPE_STRING
+        f = mreq.measure.fields.add()
+        f.name = "value"; f.field_type = 2  # FIELD_TYPE_INT
+        mreq.measure.entity.tag_names.append("svc")
+        method("MeasureRegistryService", "Create",
+               rpc.MeasureRegistryServiceCreateRequest,
+               rpc.MeasureRegistryServiceCreateResponse)(mreq)
+
+        # the registry watcher pushed both objects to every data node
+        for d in data:
+            assert d.registry.get_measure("sw", "cpm").name == "cpm"
+
+        # routed write + scatter query over the HTTP gateway
+        http = f"http://127.0.0.1:{liaison.http.port}"
+        body = json.dumps({
+            "query": "SELECT count(value) FROM MEASURE cpm IN sw "
+                     f"TIME BETWEEN {T0} AND {T0 + 1000}",
+        }).encode()
+        # write via the bus CLI path first (wire bidi write exercised in
+        # test_wire_api; here the point is the distributed read surface)
+        pts = [
+            {"ts": T0 + i, "tags": {"svc": f"s{i % 3}"},
+             "fields": {"value": float(i)}, "version": 1}
+            for i in range(40)
+        ]
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as fh:
+            json.dump(pts, fh)
+            pf = fh.name
+        _cli(liaison.addr, "write", "sw", "cpm", "--file", pf)
+        r = urllib.request.urlopen(urllib.request.Request(
+            http + "/api/v1/bydbql/query", data=body,
+            headers={"Content-Type": "application/json"},
+        ), timeout=30)
+        out = json.loads(r.read())
+        dps = out["measure_result"]["data_points"]
+        assert dps, out
+        count_field = next(
+            f for f in dps[0]["fields"] if f["name"].startswith("count")
+        )
+        val = count_field["value"]
+        n = val.get("int", val.get("float", {})).get("value", 0)
+        assert int(float(n)) == 40, dps[0]
+        chan.close()
+    finally:
+        liaison.stop()
+        for d in data:
+            d.stop()
+
+
 def test_liaison_stream_write_and_query(topology):
     data, liaison = topology
     addr = liaison.addr
