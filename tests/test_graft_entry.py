@@ -1,0 +1,45 @@
+"""The driver contract: entry() compiles single-chip, dryrun_multichip
+runs the full sharded training-step analog on an n-device mesh.
+
+Three rounds of red MULTICHIP artifacts came from environment probing
+(see __graft_entry__._ambient_provides).  These tests pin the round-4
+contract: with jax already initialised on the conftest's 8-device CPU
+platform, the in-process path engages and passes; with a too-large n,
+the probe answers False instead of dying inside the mesh constructor.
+"""
+
+from __future__ import annotations
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import pytest
+
+import __graft_entry__ as graft
+
+
+def test_ambient_probe_is_runtime_not_env():
+    # jax is imported + initialised by conftest: the probe must say yes
+    # for n <= real device count and no beyond it — regardless of env.
+    n = len(jax.devices())
+    assert graft._ambient_provides(n)
+    assert not graft._ambient_provides(n + 1)
+
+
+def test_dryrun_multichip_in_process():
+    # Full distributed step (mesh collectives + cluster mesh fast path)
+    # on the conftest's 8 virtual CPU devices, in this very process.
+    n = len(jax.devices())
+    if n < 2:
+        pytest.skip("needs a multi-device platform")
+    graft.dryrun_multichip(n)
+
+
+def test_entry_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out["count"].shape == (64,)
