@@ -229,8 +229,9 @@ class MeasureEngine:
             # series registration is PER SEGMENT (each segment owns its own
             # series index, same as the row path): one doc per distinct
             # entity appearing in this segment
-            for i in np.unique(inv[seg_mask], return_index=True)[1].tolist():
-                row = np.nonzero(seg_mask)[0][i]
+            seg_rows = np.nonzero(seg_mask)[0]
+            first = np.unique(inv[seg_mask], return_index=True)[1]
+            for row in seg_rows[first].tolist():
                 doc = {t: tag_bytes[t][row] for t in m.entity.tag_names}
                 doc["@measure"] = name.encode()
                 seg.series_index.insert_series(int(sids[row]), doc)

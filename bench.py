@@ -1,17 +1,25 @@
-"""Round benchmark: fused measure scan+aggregate throughput on one chip.
+"""Round benchmark. Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline", "e2e", "kernel", ...}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Two phases, composed into one line:
 
-Workload (BASELINE.json config #2/#3 analog): filter + group-by(service) +
-{count,sum,min,max,mean} + p50/p99 histogram + top-N over N_ROWS rows of a
-measure with 2 tag columns and 1 float field — the reference's data-node
-scan hot loop (banyand/measure/query.go:594, pkg/query/vectorized).
+1. E2E (the north star, BASELINE.json "measure-query p50/p99 latency"):
+   populate a real on-disk store (10M rows, 100k series, 4 shards,
+   several flushed parts), boot the real standalone server, and measure
+   client-observed TopN + percentile query latency over its gRPC socket
+   — cold (disk part reads) and cache-warm p50/p99.  vs_baseline is the
+   reference's published measure-query p50 (26.7 ms,
+   docs/operation/benchmark/benchmark-single-model.md:105) over ours;
+   hardware differs (their 2CPU/4GB pods vs one TPU host), the workload
+   here is larger (10M rows vs their trailing 15-min window).
 
-vs_baseline: speedup over a single-core NumPy executor running the exact
-same query on the same host arrays. NumPy is a *favorable* stand-in for
-the reference's Go row/vec executor (contiguous SIMD loops, no proto or
-iterator overhead), so this ratio is a conservative proxy for "vs the Go
-executor" (BASELINE.md north star: >=8x on TopN/percentile).
+2. Kernel (scanned-points/sec/chip): filter + group-by(service) +
+   {count,sum,min,max,mean} + p50/p99 histogram + top-N over N_ROWS
+   resident rows — the data-node scan hot loop
+   (banyand/measure/query.go:594, pkg/query/vectorized).  vs_baseline
+   for this sub-record is a fully-vectorized single-core NumPy executor
+   running the same query on the same arrays (no per-group Python
+   loops — an honest stand-in for a competent columnar executor).
 
 Robustness contract (the driver runs this unattended at round end): the
 TPU tunnel on this host is flaky — a claim can fail fast (UNAVAILABLE) or
@@ -47,6 +55,15 @@ PROBE_TIMEOUT_S = int(os.environ.get("BYDB_BENCH_PROBE_TIMEOUT_S", 120))
 TPU_ATTEMPTS = int(os.environ.get("BYDB_BENCH_TPU_ATTEMPTS", 2))
 TPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("BYDB_BENCH_TPU_TIMEOUT_S", 600))
 CPU_FALLBACK_ROWS = int(os.environ.get("BYDB_BENCH_ROWS_CPU", 1 << 20))
+E2E_ROWS_CPU = int(os.environ.get("BYDB_BENCH_E2E_ROWS_CPU", 1_000_000))
+
+_FAILED_REC = {
+    "metric": "measure_query_e2e_p50_ms",
+    "value": 0.0,
+    "unit": "ms",
+    "vs_baseline": 0.0,
+    "error": "all backends failed within budget",
+}
 
 
 def _host_data(n):
@@ -59,28 +76,34 @@ def _host_data(n):
 
 
 def numpy_executor(d, region_ne: int):
-    """Single-core oracle: same query, pure NumPy."""
+    """Single-core oracle: same query, pure NumPy, fully vectorized —
+    no per-group Python loops, so the vs_baseline ratio is a defensible
+    proxy for a competent single-core columnar executor (VERDICT r3:
+    the old per-group bincount loop inflated the ratio)."""
     mask = d["region"] != region_ne
     svc = d["svc"][mask]
     lat = d["latency"][mask]
     count = np.bincount(svc, minlength=N_SVC).astype(np.float64)
     sums = np.bincount(svc, weights=lat, minlength=N_SVC)
-    # min/max per group via sort-split
+    # min/max per group: sort once, reduceat over group boundaries
     order = np.argsort(svc, kind="stable")
     ssvc, slat = svc[order], lat[order]
     bounds = np.searchsorted(ssvc, np.arange(N_SVC + 1))
     mins = np.full(N_SVC, np.inf)
     maxs = np.full(N_SVC, -np.inf)
-    hist = np.zeros((N_SVC, HIST_BUCKETS))
+    nonempty = bounds[1:] > bounds[:-1]
+    starts = bounds[:-1][nonempty]
+    if starts.size:
+        mins[nonempty] = np.minimum.reduceat(slat, starts)
+        maxs[nonempty] = np.maximum.reduceat(slat, starts)
+    # per-group histogram: one flat bincount on (group * B + bucket)
     lo, hi = 0.0, 1000.0
     width = (hi - lo) / HIST_BUCKETS
-    bucket = np.clip(((slat - lo) / width).astype(np.int64), 0, HIST_BUCKETS - 1)
-    for g in range(N_SVC):
-        a, b = bounds[g], bounds[g + 1]
-        if b > a:
-            seg = slat[a:b]
-            mins[g], maxs[g] = seg.min(), seg.max()
-            hist[g] = np.bincount(bucket[a:b], minlength=HIST_BUCKETS)
+    bucket = np.clip(((lat - lo) / width).astype(np.int64), 0, HIST_BUCKETS - 1)
+    hist = np.bincount(
+        svc.astype(np.int64) * HIST_BUCKETS + bucket,
+        minlength=N_SVC * HIST_BUCKETS,
+    ).reshape(N_SVC, HIST_BUCKETS)
     mean = sums / np.maximum(count, 1)
     top = np.argsort(-np.where(count > 0, mean, -np.inf))[:10]
     return count, sums, mins, maxs, hist, top
@@ -182,6 +205,157 @@ def child_main() -> None:
     )
 
 
+def e2e_main() -> None:
+    """End-to-end north-star measurement (BASELINE.json configs #2/#3/#5
+    shapes): populate a REAL on-disk store (multiple flushed parts, 4
+    shards, 24h span), boot the REAL standalone server over its gRPC
+    socket, and measure client-observed query latency through the full
+    path — BydbQL parse -> plan -> part read -> serving cache -> gather/
+    dedup -> device aggregate -> combine -> JSON response.  Reports cold
+    (first query after boot: disk part reads) and cache-warm p50/p99,
+    comparable to the reference's published measure-query table
+    (docs/operation/benchmark/benchmark-single-model.md:105)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import jax
+
+    from banyandb_tpu.api import (
+        Catalog,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        Measure,
+        ResourceOpts,
+        SchemaRegistry,
+        TagSpec,
+        TagType,
+    )
+    from banyandb_tpu.cluster.rpc import GrpcTransport
+    from banyandb_tpu.models.measure import MeasureEngine
+    from banyandb_tpu.server import TOPIC_QL, StandaloneServer
+
+    backend = jax.default_backend()
+    n_rows = int(os.environ.get("BYDB_BENCH_E2E_ROWS", 10_000_000))
+    n_series = int(os.environ.get("BYDB_BENCH_E2E_SERIES", 100_000))
+    iters = int(os.environ.get("BYDB_BENCH_E2E_ITERS", 15))
+    shards = 4
+    T0 = 1_700_000_000_000
+    span_ms = 24 * 3600 * 1000
+    step = max(1, span_ms // n_rows)
+
+    root = Path(tempfile.mkdtemp(prefix="bydb-e2e-"))
+    try:
+        # ---- populate: bulk columnar ingest, periodic flush => several
+        # on-disk parts per shard (the layout a long-running node has) ----
+        reg = SchemaRegistry(root)
+        reg.create_group(
+            Group("g", Catalog.MEASURE, ResourceOpts(shard_num=shards))
+        )
+        reg.create_measure(
+            Measure(
+                group="g",
+                name="m",
+                tags=(
+                    TagSpec("svc", TagType.STRING),
+                    TagSpec("region", TagType.STRING),
+                ),
+                fields=(FieldSpec("value", FieldType.FLOAT),),
+                entity=Entity(("svc",)),
+            )
+        )
+        eng = MeasureEngine(reg, root / "data")
+        rng = np.random.default_rng(11)
+        svc_pool = np.array(
+            [b"svc_%06d" % i for i in range(n_series)], dtype=object
+        )
+        region_pool = np.array([b"r%d" % i for i in range(8)], dtype=object)
+        batch = 1_000_000
+        written = 0
+        t_ing = time.perf_counter()
+        while written < n_rows:
+            b = min(batch, n_rows - written)
+            eng.write_columns(
+                "g",
+                "m",
+                ts_millis=T0 + (written + np.arange(b, dtype=np.int64)) * step,
+                tags={
+                    "svc": svc_pool[rng.integers(0, n_series, b)].tolist(),
+                    "region": region_pool[rng.integers(0, 8, b)].tolist(),
+                },
+                fields={"value": rng.gamma(2.0, 40.0, b).astype(np.float64)},
+                versions=np.ones(b, dtype=np.int64),
+            )
+            written += b
+            if written % (2 * batch) == 0 or written == n_rows:
+                eng.flush()  # several parts per shard, not one mega-part
+            print(f"# e2e ingest {written}/{n_rows}", file=sys.stderr)
+        ingest_s = time.perf_counter() - t_ing
+        del eng, reg  # server below re-opens the same root cold
+
+        # ---- serve + query over the real gRPC socket --------------------
+        srv = StandaloneServer(root, port=0)
+        srv.start()
+        tr = GrpcTransport()
+        end = T0 + n_rows * step + 1
+        queries = {
+            "topn": (
+                f"SELECT mean(value) FROM MEASURE m IN g TIME BETWEEN "
+                f"{T0} AND {end} GROUP BY svc TOP 10 BY value"
+            ),
+            "percentile": (
+                f"SELECT PERCENTILE(value, 0.5, 0.99) FROM MEASURE m IN g "
+                f"TIME BETWEEN {T0} AND {end} GROUP BY region"
+            ),
+        }
+
+        def run(ql: str) -> float:
+            # transport/QL failures raise TransportError — no result
+            # inspection needed, a failed query aborts the bench
+            t0 = time.perf_counter()
+            tr.call(srv.addr, TOPIC_QL, {"ql": ql}, timeout=600.0)
+            return (time.perf_counter() - t0) * 1000
+
+        try:
+            cold = {k: run(q) for k, q in queries.items()}
+            warm: dict[str, list] = {k: [] for k in queries}
+            for _ in range(iters):
+                for k, q in queries.items():
+                    warm[k].append(run(q))
+        finally:
+            tr.close()
+            srv.stop()
+        pooled = sorted(warm["topn"] + warm["percentile"])
+        print(
+            json.dumps(
+                {
+                    "e2e": "ok",
+                    "backend": backend,
+                    "rows": n_rows,
+                    "series": n_series,
+                    "shards": shards,
+                    "span_hours": round(n_rows * step / 3_600_000, 1),
+                    "ingest_points_per_s": round(n_rows / ingest_s),
+                    "cold_ms": {k: round(v, 1) for k, v in cold.items()},
+                    "warm_p50_ms": round(float(np.percentile(pooled, 50)), 1),
+                    "warm_p99_ms": round(float(np.percentile(pooled, 99)), 1),
+                    "warm_by_query_ms": {
+                        k: {
+                            "p50": round(float(np.percentile(v, 50)), 1),
+                            "p99": round(float(np.percentile(v, 99)), 1),
+                        }
+                        for k, v in warm.items()
+                    },
+                    "iters": iters,
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def probe_main() -> None:
     """Cheap claim probe: initialize the ambient backend, run one tiny
     device_put + matmul round-trip, report the backend.  Costs seconds on
@@ -215,9 +389,10 @@ def _cpu_env() -> dict:
 def _run_child(env: dict, timeout_s: float, mode: str = "bench") -> dict | None:
     """Run `bench.py` in child mode; return its parsed JSON line or None.
 
-    mode="probe" runs the cheap claim probe (key "probe"); mode="bench"
-    runs the full benchmark (key "metric")."""
-    key = "probe" if mode == "probe" else "metric"
+    mode="probe" runs the cheap claim probe (key "probe"); mode="e2e"
+    runs the end-to-end server benchmark (key "e2e"); mode="bench"
+    runs the kernel benchmark (key "metric")."""
+    key = {"probe": "probe", "e2e": "e2e"}.get(mode, "metric")
     env = dict(env)
     env["_BYDB_BENCH_CHILD"] = mode
     try:
@@ -258,10 +433,38 @@ def _run_child(env: dict, timeout_s: float, mode: str = "bench") -> dict | None:
     return None
 
 
+REF_P50_MS = 26.7  # reference benchmark-single-model.md:105 measure-query p50
+
+
+def _compose(kernel_rec: dict | None, e2e_rec: dict | None) -> dict | None:
+    """One JSON line: the north star (E2E query p50) headlines when the
+    end-to-end run succeeded; the kernel number always rides along."""
+    if e2e_rec is not None:
+        p50 = float(e2e_rec.get("warm_p50_ms") or 0) or 1e9
+        return {
+            "metric": "measure_query_e2e_p50_ms",
+            "value": e2e_rec.get("warm_p50_ms"),
+            "unit": "ms",
+            "vs_baseline": round(REF_P50_MS / p50, 2),
+            "baseline": (
+                "reference measure-query p50=26.7ms "
+                "(benchmark-single-model.md:105; 2CPU/4GB pods — "
+                "different hardware, larger dataset here)"
+            ),
+            "backend": e2e_rec.get("backend"),
+            "e2e": e2e_rec,
+            "kernel": kernel_rec,
+        }
+    return kernel_rec
+
+
 def main() -> None:
     mode = os.environ.get("_BYDB_BENCH_CHILD")
     if mode == "probe":
         probe_main()
+        return
+    if mode == "e2e":
+        e2e_main()
         return
     if mode:  # "bench" (or legacy "1")
         child_main()
@@ -270,12 +473,23 @@ def main() -> None:
     deadline = time.monotonic() + BUDGET_S
     reserve = 300.0  # always leave room for the CPU fallback
     rec = None
+    e2e_rec = None
 
     ambient_is_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     if ambient_is_cpu:
         # Deliberate CPU run: honor the ambient env (incl. BYDB_BENCH_ROWS)
         # verbatim — no TPU attempt happened, so no fallback labeling.
-        rec = _run_child(dict(os.environ), deadline - time.monotonic())
+        rec = _run_child(
+            dict(os.environ),
+            max(deadline - time.monotonic() - reserve, 120),
+        )
+        env = dict(os.environ)
+        env.setdefault("BYDB_BENCH_E2E_ROWS", str(E2E_ROWS_CPU))
+        e2e_rec = _run_child(
+            env, max(deadline - time.monotonic(), 120), mode="e2e"
+        )
+        print(json.dumps(_compose(rec, e2e_rec) or _FAILED_REC))
+        return
     else:
         # Phase 1: cheap claim probe on the ambient (TPU-tunnel) env.  A
         # stuck claim costs PROBE_TIMEOUT_S, not a full bench budget; many
@@ -302,7 +516,7 @@ def main() -> None:
             if deadline - time.monotonic() > reserve + backoff + 30:
                 time.sleep(backoff)
 
-        # Phase 2: full bench, only on a claimed chip.
+        # Phase 2: kernel bench + E2E server bench, only on a claimed chip.
         if claimed:
             for _ in range(TPU_ATTEMPTS):
                 budget = min(
@@ -313,10 +527,15 @@ def main() -> None:
                 rec = _run_child(dict(os.environ), budget)
                 if rec is not None:
                     break
+            # E2E on the claimed chip — keep the CPU-fallback reserve
+            # intact so a wedged chip can never starve phase 3.
+            budget = deadline - time.monotonic() - reserve
+            if budget > 300:
+                e2e_rec = _run_child(dict(os.environ), budget, mode="e2e")
 
         # Phase 3: CPU fallback — an honest number beats no number.
         if rec is None:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - time.monotonic() - 180.0
             rec = _run_child(_cpu_env(), max(remaining, 120))
             if rec is not None:
                 rec["note"] = (
@@ -324,16 +543,16 @@ def main() -> None:
                     if claimed
                     else "cpu-fallback: TPU claim unavailable"
                 )
+        if e2e_rec is None:
+            remaining = deadline - time.monotonic()
+            if remaining > 120:
+                env = _cpu_env()
+                env.setdefault("BYDB_BENCH_E2E_ROWS", str(E2E_ROWS_CPU))
+                e2e_rec = _run_child(env, remaining, mode="e2e")
+                if e2e_rec is not None:
+                    e2e_rec["note"] = "cpu-fallback"
 
-    if rec is None:
-        rec = {
-            "metric": "measure_scan_groupby_agg_p50p99_topk",
-            "value": 0.0,
-            "unit": "Mpoints/s/chip",
-            "vs_baseline": 0.0,
-            "error": "all backends failed within budget",
-        }
-    print(json.dumps(rec))
+    print(json.dumps(_compose(rec, e2e_rec) or _FAILED_REC))
 
 
 if __name__ == "__main__":
