@@ -224,6 +224,51 @@ def bench_trace_ordered():
     return {"trace_ordered_top100_of_40k": {"s": sec}}
 
 
+def bench_inverted_index():
+    """Segmented inverted index (pkg/index/inverted analog): build,
+    restart (O(segments) manifest+header open), term search over memmap
+    postings, ordered range — at 1M docs / 10k terms / 4 segments."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    from banyandb_tpu.index.inverted import Doc, InvertedIndex, TermQuery
+
+    root = Path(tempfile.mkdtemp(prefix="bydb-idxbench-"))
+    try:
+        n, per = 1_000_000, 250_000
+        idx = InvertedIndex(root / "i.idx")
+        t0 = time.perf_counter()
+        for base in range(0, n, per):
+            idx.insert(
+                Doc(i, {"svc": b"s%05d" % (i % 10_000)}, {"k": i})
+                for i in range(base, base + per)
+            )
+            idx.persist()
+        build_s = time.perf_counter() - t0
+        del idx
+
+        def reopen():
+            InvertedIndex(root / "i.idx")
+
+        restart_s = timeit(reopen, warmup=1, iters=5)
+        idx = InvertedIndex(root / "i.idx")
+        term_s = timeit(
+            lambda: idx.search(TermQuery("svc", b"s00042")), warmup=1, iters=20
+        )
+        range_s = timeit(
+            lambda: idx.range_ordered("k", 500_000, 500_500), warmup=1, iters=20
+        )
+        return {
+            "inverted_build_1M_4segs": {"s": build_s, "docs_per_s": n / build_s},
+            "inverted_restart_1M": {"s": restart_s},
+            "inverted_term_search_1M": {"s": term_s},
+            "inverted_range_ordered_1M": {"s": range_s},
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", action="store_true")
@@ -236,6 +281,7 @@ def main():
         ("merge", bench_merge),
         ("stream_scan", bench_stream_scan),
         ("trace_ordered", bench_trace_ordered),
+        ("inverted_index", bench_inverted_index),
     ):
         results.update(fn())
     if args.json:

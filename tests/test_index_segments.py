@@ -1,0 +1,249 @@
+"""Segmented inverted-index store (index/segment.py + inverted.py):
+immutable posting segments, delete bitmaps, size-tiered merge,
+incremental persist, O(segments) restart, legacy-file migration.
+
+Reference analog: pkg/index/inverted/inverted.go (Bluge ICE segments:
+FST term dictionary + roaring postings, immutable at rest).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.index.inverted import (
+    And,
+    Doc,
+    InvertedIndex,
+    Not,
+    Or,
+    RangeQuery,
+    TermQuery,
+)
+
+
+def _mk(i, svc, lat=None, payload=b""):
+    return Doc(
+        i,
+        {"svc": svc},
+        {"lat": lat} if lat is not None else {},
+        payload,
+    )
+
+
+def test_each_persist_adds_one_segment(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    for batch in range(3):
+        idx.insert([_mk(batch * 10 + j, b"a", lat=batch) for j in range(5)])
+        idx.persist()
+    man = json.loads((tmp_path / "i.idx" / "manifest.json").read_text())
+    assert len(man["segments"]) == 3
+    assert len(idx) == 15
+    np.testing.assert_array_equal(
+        idx.search(RangeQuery("lat", 1, 1)), [10, 11, 12, 13, 14]
+    )
+
+
+def test_restart_reads_headers_not_docs(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(i, b"s%d" % (i % 50), lat=i) for i in range(20_000)])
+    idx.persist()
+    del idx
+
+    idx2 = InvertedIndex(tmp_path / "i.idx")
+    # restart must not materialise docs: the memtable stays empty and the
+    # segment sections are memmaps, untouched until queried
+    assert not idx2._mem
+    assert len(idx2) == 20_000
+    hits = idx2.search(TermQuery("svc", b"s7"))
+    assert hits.size == 400
+    assert (np.asarray([h % 50 for h in hits]) == 7).all()
+    # a term query must not have loaded per-doc columns
+    touched = {
+        name
+        for _, seg in idx2._segs
+        for name in seg._maps
+    }
+    assert not any("docterm" in s or "payload" in s for s in touched)
+
+
+def test_overwrite_across_segments_tombstones_old_copy(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(1, b"old", lat=5), _mk(2, b"keep", lat=6)])
+    idx.persist()
+    idx.insert([_mk(1, b"new", lat=50)])  # overwrite while 1 is on disk
+    idx.persist()
+
+    for reopened in (idx, InvertedIndex(tmp_path / "i.idx")):
+        assert len(reopened) == 2
+        assert reopened.get(1).keywords["svc"] == b"new"
+        assert reopened.search(TermQuery("svc", b"old")).size == 0
+        np.testing.assert_array_equal(reopened.search(TermQuery("svc", b"new")), [1])
+        np.testing.assert_array_equal(
+            reopened.search(RangeQuery("lat", 40, None)), [1]
+        )
+
+
+def test_delete_across_segments_and_restart(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(i, b"x", lat=i) for i in range(10)])
+    idx.persist()
+    idx.delete([3, 7])
+    idx.persist()
+
+    idx2 = InvertedIndex(tmp_path / "i.idx")
+    assert len(idx2) == 8
+    assert idx2.get(3) is None
+    hits = idx2.search(TermQuery("svc", b"x"))
+    assert 3 not in hits and 7 not in hits and hits.size == 8
+
+
+def test_merge_folds_segments_and_drops_tombstones(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    for batch in range(InvertedIndex.MERGE_FANOUT):
+        idx.insert([_mk(batch * 100 + j, b"b%d" % batch) for j in range(4)])
+        if batch == 2:
+            idx.delete([102])  # tombstone into an already-flushed segment
+        idx.persist()
+    # fan-out reached: smallest half folded into one segment
+    man = json.loads((tmp_path / "i.idx" / "manifest.json").read_text())
+    assert len(man["segments"]) < InvertedIndex.MERGE_FANOUT
+    assert len(idx) == InvertedIndex.MERGE_FANOUT * 4 - 1
+    assert idx.get(102) is None
+    np.testing.assert_array_equal(
+        idx.search(TermQuery("svc", b"b1")), [100, 101, 103]
+    )
+    # merged segment physically dropped the tombstoned doc
+    total_slots = sum(seg.n for _, seg in idx._segs)
+    total_alive = sum(seg.alive_count for _, seg in idx._segs)
+    assert total_alive == len(idx)
+    assert total_slots == total_alive  # no dead slots survive a full merge
+    # files on disk match the manifest (GC removed victims)
+    seg_files = {p.name for p in (tmp_path / "i.idx").glob("*.seg")}
+    assert seg_files == {e["name"] + ".seg" for e in man["segments"]}
+
+
+def test_boolean_algebra_spans_segments_and_memtable(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(1, b"a", 1), _mk(2, b"b", 2)])
+    idx.persist()
+    idx.insert([_mk(3, b"a", 3), _mk(4, b"c", 4)])  # memtable only
+    np.testing.assert_array_equal(idx.search(TermQuery("svc", b"a")), [1, 3])
+    np.testing.assert_array_equal(
+        idx.search(Or((TermQuery("svc", b"b"), TermQuery("svc", b"c")))), [2, 4]
+    )
+    np.testing.assert_array_equal(
+        idx.search(And((TermQuery("svc", b"a"), RangeQuery("lat", 2, None)))), [3]
+    )
+    np.testing.assert_array_equal(
+        idx.search(Not(TermQuery("svc", b"a"))), [2, 4]
+    )
+
+
+def test_range_ordered_merges_segments(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(1, b"x", 30), _mk(2, b"x", 10)])
+    idx.persist()
+    idx.insert([_mk(3, b"x", 20)])
+    np.testing.assert_array_equal(idx.range_ordered("lat"), [2, 3, 1])
+    np.testing.assert_array_equal(
+        idx.range_ordered("lat", asc=False), [1, 3, 2]
+    )
+    np.testing.assert_array_equal(
+        idx.range_ordered("lat", 15, None, limit=1), [3]
+    )
+
+
+def test_legacy_single_file_migrates_in_place(tmp_path):
+    # simulate a pre-segment store by writing the v2 single-file format
+    from banyandb_tpu.utils import compress as zst
+    from banyandb_tpu.utils import encoding as enc
+    from banyandb_tpu.utils import fs
+
+    ids = [5, 9]
+    blobs = [
+        enc.encode_int64(np.asarray(ids, dtype=np.int64)),
+        enc.encode_strings([b"svc"]),
+        enc.encode_strings([]),
+        enc.encode_strings([b"a", b"b"]),
+        enc.encode_int64(np.asarray([1, 1], dtype=np.int64)),
+        enc.encode_strings([b"", b"payload"]),
+    ]
+    body = b"".join(len(b).to_bytes(4, "little") + b for b in blobs)
+    path = tmp_path / "legacy.idx"
+    fs.atomic_write(path, b"BTIX2\n" + zst.compress(body))
+
+    idx = InvertedIndex(path)
+    assert len(idx) == 2
+    np.testing.assert_array_equal(idx.search(TermQuery("svc", b"b")), [9])
+    idx.insert([_mk(11, b"c")])
+    idx.persist()  # migrates: file becomes a segmented directory
+    assert path.is_dir()
+
+    idx2 = InvertedIndex(path)
+    assert len(idx2) == 3
+    assert idx2.get(9).payload == b"payload"
+    np.testing.assert_array_equal(idx2.search(TermQuery("svc", b"c")), [11])
+
+
+def test_search_limit_applies_on_every_path(tmp_path):
+    # regression: the single-part early return used to skip the limit
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(i, b"x") for i in range(100)])
+    assert idx.search(TermQuery("svc", b"x"), limit=5).size == 5  # memtable
+    idx.persist()
+    assert idx.search(TermQuery("svc", b"x"), limit=5).size == 5  # 1 segment
+    idx.insert([_mk(i, b"x") for i in range(100, 120)])
+    assert idx.search(TermQuery("svc", b"x"), limit=5).size == 5  # mixed
+
+
+def test_persist_noop_without_changes(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(1, b"a")])
+    idx.persist()
+    man1 = (tmp_path / "i.idx" / "manifest.json").read_bytes()
+    idx.persist()  # nothing pending: no new segment, manifest untouched
+    assert (tmp_path / "i.idx" / "manifest.json").read_bytes() == man1
+
+
+def test_reclaim_releases_and_lazily_reloads(tmp_path):
+    idx = InvertedIndex(tmp_path / "i.idx")
+    idx.insert([_mk(i, b"s", lat=i) for i in range(100)])
+    idx.persist()
+    idx.reclaim()
+    assert idx._released and not idx._segs
+    np.testing.assert_array_equal(idx.search(RangeQuery("lat", 98, None)), [98, 99])
+    assert len(idx) == 100
+
+
+def test_million_doc_scale_restart_and_search(tmp_path):
+    """1M docs: restart cost is manifest+headers; term search untouched
+    columns stay unmapped (VERDICT r3 #3 acceptance shape, scaled to CI)."""
+    import time
+
+    idx = InvertedIndex(tmp_path / "big.idx")
+    n, per = 1_000_000, 250_000
+    for base in range(0, n, per):
+        ids = np.arange(base, base + per, dtype=np.int64)
+        docs = [
+            Doc(int(i), {"svc": b"s%05d" % (i % 10_000)}, {"k": int(i)})
+            for i in ids
+        ]
+        idx.insert(docs)
+        idx.persist()
+    del idx
+
+    t0 = time.perf_counter()
+    idx2 = InvertedIndex(tmp_path / "big.idx")
+    open_s = time.perf_counter() - t0
+    assert open_s < 1.0, f"restart took {open_s:.2f}s — not O(segments)"
+
+    t0 = time.perf_counter()
+    hits = idx2.search(TermQuery("svc", b"s00042"))
+    first_q = time.perf_counter() - t0
+    assert hits.size == 100
+    assert (hits % 10_000 == 42).all()
+    assert first_q < 1.0, f"first term search {first_q:.2f}s"
+    np.testing.assert_array_equal(
+        idx2.range_ordered("k", 999_997, None), [999_997, 999_998, 999_999]
+    )
