@@ -267,6 +267,30 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
     out = pb.measure_query_pb2.QueryResponse()
     if res.groups or res.values:
         group_tags = tuple(req.group_by.tag_names) if req.group_by else ()
+        agg_key = agg_field = None
+        agg_int = False
+        if req.agg is not None:
+            # Reference response shape for grouped aggregation (want/
+            # group_*.yaml in test/cases/measure): exactly ONE field,
+            # named after the aggregated field, typed like it — MEAN
+            # over int fields truncates (Go int64 division,
+            # pkg/query/aggregation meanInt64).
+            fn = req.agg.function
+            agg_field = req.agg.field_name or "value"
+            if fn == "count":
+                agg_key = "count"
+                agg_int = True
+            elif fn == "percentile":
+                agg_key = f"percentile({agg_field})"
+            else:
+                agg_key = f"{fn}({agg_field})"
+            try:
+                agg_int = agg_int or (
+                    fn != "percentile"
+                    and measure.field(agg_field).type.name == "INT"
+                )
+            except (KeyError, AttributeError):
+                pass
         for i, g in enumerate(res.groups):
             dp = out.data_points.add()
             fam = dp.tag_families.add(name="default")
@@ -275,6 +299,20 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
                 tag.value.CopyFrom(
                     py_to_tag_value(v, measure.tag(t).type if _has_tag(measure, t) else None)
                 )
+            if agg_key is not None:
+                vals = res.values.get(agg_key, ())
+                v = vals[i] if i < len(vals) else None
+                if isinstance(v, list):  # percentile -> one field per q
+                    for qi, qv in enumerate(v):
+                        name = agg_field if qi == 0 else f"{agg_field}[{qi}]"
+                        f = dp.fields.add(name=name)
+                        f.value.CopyFrom(py_to_field_value(float(qv)))
+                else:
+                    f = dp.fields.add(name=agg_field)
+                    f.value.CopyFrom(
+                        py_to_field_value(int(v) if agg_int else v)
+                    )
+                continue
             for key, vals in res.values.items():
                 f = dp.fields.add(name=key)
                 v = vals[i] if i < len(vals) else None
@@ -287,16 +325,42 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
                             extra.value.CopyFrom(py_to_field_value(float(qv)))
                 else:
                     f.value.CopyFrom(py_to_field_value(v))
+    int_fields = {
+        f.name for f in measure.fields if getattr(f.type, "name", "") == "INT"
+    }
+    # Strict projection semantics (want/*.yaml): the response carries
+    # ONLY the projected tags/fields, in projection order; an empty
+    # tagProjection yields no tag families at all.
+    tag_proj = tuple(req.tag_projection)
+    field_proj = tuple(req.field_projection)
     for row in res.data_points:
         dp = out.data_points.add()
         dp.timestamp.CopyFrom(millis_to_ts(row["timestamp"]))
-        fam = dp.tag_families.add(name="default")
-        for t, v in row.get("tags", {}).items():
-            tag = fam.tags.add(key=t)
-            tag.value.CopyFrom(py_to_tag_value(v))
-        for fname, fv in row.get("fields", {}).items():
+        tags = row.get("tags", {})
+        if tag_proj:
+            fam = dp.tag_families.add(name="default")
+            for t in tag_proj:
+                if t not in tags:
+                    continue
+                tag = fam.tags.add(key=t)
+                tag.value.CopyFrom(
+                    py_to_tag_value(
+                        tags[t],
+                        measure.tag(t).type if _has_tag(measure, t) else None,
+                    )
+                )
+        fields = row.get("fields", {})
+        for fname in field_proj:
+            if fname not in fields:
+                continue
             f = dp.fields.add(name=fname)
-            f.value.CopyFrom(py_to_field_value(fv))
+            # schema-typed emission: the engine's device column is f64,
+            # but INT fields must return int on the wire (want/*.yaml)
+            f.value.CopyFrom(
+                py_to_field_value(
+                    int(fields[fname]) if fname in int_fields else fields[fname]
+                )
+            )
     fill_trace(out, res)
     return out
 

@@ -28,10 +28,15 @@ from banyandb_tpu.query import measure_exec
 from banyandb_tpu.utils import hashing
 
 
-def _sort_merged_rows(rows: list, req) -> None:
+def _sort_merged_rows(rows: list, req, *, default_desc: bool = True) -> None:
     """Order scattered rows at the liaison merge: by tag value when the
     query orders by an indexed tag (rows missing the tag always sort
-    last, regardless of direction), else by timestamp."""
+    last, regardless of direction), else by timestamp.
+
+    default_desc picks the no-order_by direction per catalog: streams
+    default newest-first, measures oldest-first (the reference's
+    limit/offset golden pins measure ASC — must match the engines so
+    cluster and standalone paginate identically)."""
     if req.order_by_tag:
         tag = req.order_by_tag
 
@@ -46,7 +51,11 @@ def _sort_merged_rows(rows: list, req) -> None:
         # stable second pass: missing-tag rows to the tail either way
         rows.sort(key=lambda d: d.get("tags", {}).get(tag, None) is None)
     else:
-        rows.sort(key=lambda d: d["timestamp"], reverse=(req.order_by_ts != "asc"))
+        if req.order_by_ts:
+            desc = req.order_by_ts == "desc"
+        else:
+            desc = default_desc
+        rows.sort(key=lambda d: d["timestamp"], reverse=desc)
 
 
 class Liaison:
@@ -496,7 +505,7 @@ class Liaison:
                     },
                 )
                 rows.extend(r["data_points"])
-            _sort_merged_rows(rows, req)
+            _sort_merged_rows(rows, req, default_desc=False)  # measure: ASC
             res = QueryResult()
             res.data_points = rows[off : off + limit]
             self._attach_distributed_plan(

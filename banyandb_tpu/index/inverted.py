@@ -189,8 +189,10 @@ class InvertedIndex:
         """Atomic check-and-insert: keep the doc with the higher version."""
         with self._lock:
             self._ensure_loaded()
-            old = self.get(doc.doc_id)
-            if old is not None and old.numerics.get(version_field, 0) >= doc.numerics.get(version_field, 0):
+            old_v = self.get_numeric(doc.doc_id, version_field)
+            if old_v is None and self.contains(doc.doc_id):
+                old_v = 0  # doc exists but carries no version field
+            if old_v is not None and old_v >= doc.numerics.get(version_field, 0):
                 return False
             self.insert([doc])
             return True
@@ -300,6 +302,31 @@ class InvertedIndex:
                 out = out[::-1]
             return out[:limit] if limit is not None else out
 
+    def contains(self, doc_id: int) -> bool:
+        """Existence probe without materialising the doc: memtable dict
+        hit or a per-segment binary search — no column/payload reads.
+        The measure write hot path (SeriesIndex.insert_series idempotency
+        check) rides this on every data point."""
+        with self._lock:
+            self._ensure_loaded()
+            if doc_id in self._mem:
+                return True
+            return any(seg.slot_of(doc_id) >= 0 for _, seg in self._segs)
+
+    def get_numeric(self, doc_id: int, field: str) -> Optional[int]:
+        """Read ONE numeric field of a doc without decoding keywords or
+        payload (insert_if_newer's version probe)."""
+        with self._lock:
+            self._ensure_loaded()
+            d = self._mem.get(doc_id)
+            if d is not None:
+                return d.numerics.get(field)
+            for _, seg in reversed(self._segs):
+                slot = seg.slot_of(doc_id)
+                if slot >= 0:
+                    return seg.numeric_at(slot, field)
+            return None
+
     def get(self, doc_id: int) -> Optional[Doc]:
         with self._lock:
             self._ensure_loaded()
@@ -337,12 +364,15 @@ class InvertedIndex:
             dirty_tombs = [
                 (name, seg) for name, seg in self._segs if seg._tomb_dirty
             ]
-            if not self._mem and not dirty_tombs:
-                return
             # Legacy single-file store: build the segmented dir next to it,
             # then unlink + rename (the whole legacy doc set is already in
-            # the memtable, so nothing else needs carrying over).
+            # the memtable, so nothing else needs carrying over).  Never
+            # short-circuit while migrating — an all-docs-deleted legacy
+            # store has an empty memtable but MUST still replace the file,
+            # or the deleted docs resurrect on reopen.
             migrating = self.path.exists() and self.path.is_file()
+            if not self._mem and not dirty_tombs and not migrating:
+                return
             root = self._tmpdir_path() if migrating else self.path
             root.mkdir(parents=True, exist_ok=True)
 

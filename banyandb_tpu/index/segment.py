@@ -221,7 +221,8 @@ class Segment:
         Returns the number of newly-dead docs."""
         if self.n == 0 or len(ids) == 0:
             return 0
-        ids = np.asarray(ids, dtype=np.int64)
+        # dedupe: a doc_id repeated in one batch must decrement _alive once
+        ids = np.unique(np.asarray(ids, dtype=np.int64))
         docids = self.docids
         slots = np.searchsorted(docids, ids)
         ok = (slots < self.n) & (docids[np.minimum(slots, self.n - 1)] == ids)
@@ -320,6 +321,14 @@ class Segment:
         if self._tomb is not None and self._tomb[s]:
             return -1
         return s
+
+    def numeric_at(self, slot: int, f: str) -> Optional[int]:
+        """One numeric field at one slot — two memmap reads, no decode."""
+        if f not in self.num_fields:
+            return None
+        if not self._sec(f"num:{f}:present")[slot]:
+            return None
+        return int(self._sec(f"num:{f}:docvals")[slot])
 
     def doc_fields(self, slot: int) -> tuple[dict, dict, bytes]:
         """(keywords, numerics, payload) for one slot."""

@@ -33,8 +33,10 @@ class SeriesIndex:
     def insert_series(
         self, series_id: int, tag_values: Mapping[str, bytes]
     ) -> None:
-        """Register (idempotently) a series with its indexed tag values."""
-        if self._idx.get(series_id) is None:
+        """Register (idempotently) a series with its indexed tag values.
+        The existence probe is contains() — no doc materialisation on
+        the per-data-point write hot path."""
+        if not self._idx.contains(series_id):
             self._idx.insert([Doc(doc_id=series_id, keywords=dict(tag_values))])
 
     def update_series(

@@ -532,8 +532,11 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
         )
         ordered = have + miss  # missing-tag rows last under either order
     else:
+        # default (no order_by) is timestamp ASC — pinned by the
+        # reference's limit/offset golden (want/limit.yaml: offset 3
+        # lands on the 4th-written row)
         ordered = sorted(
-            best.values(), key=lambda r: r[0], reverse=(req.order_by_ts != "asc")
+            best.values(), key=lambda r: r[0], reverse=(req.order_by_ts == "desc")
         )
     off = req.offset or 0
     for ts, _ver, tags, fields in ordered[off : off + (req.limit or 100)]:

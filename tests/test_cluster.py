@@ -132,8 +132,10 @@ def test_distributed_raw_query(tmp_path):
     )
     assert 0 < len(res.data_points) <= 25
     assert all(dp["tags"]["region"] == "r1" for dp in res.data_points)
+    # measure default order is ts ASC, matching standalone (pinned by the
+    # reference limit/offset golden)
     ts = [dp["timestamp"] for dp in res.data_points]
-    assert ts == sorted(ts, reverse=True)
+    assert ts == sorted(ts)
 
 
 def test_replica_failover(tmp_path):

@@ -203,8 +203,10 @@ def test_liaison_wire_and_http_surfaces(tmp_path):
         out = json.loads(r.read())
         dps = out["measure_result"]["data_points"]
         assert dps, out
+        # count(value) comes back as one field named "value" (reference
+        # response shape, want/group_count.yaml)
         count_field = next(
-            f for f in dps[0]["fields"] if f["name"].startswith("count")
+            f for f in dps[0]["fields"] if f["name"] == "value"
         )
         val = count_field["value"]
         n = val.get("int", val.get("float", {})).get("value", 0)

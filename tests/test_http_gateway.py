@@ -94,9 +94,11 @@ def test_gateway_schema_and_query(gw):
         "agg": {"function": "AGGREGATION_FUNCTION_COUNT", "field_name": "v"},
     })
     assert st == 200
+    # the aggregate field is named after the aggregated field (reference
+    # response shape, want/group_count.yaml)
     counts = {
         dp["tag_families"][0]["tags"][0]["value"]["str"]["value"]:
-            next(f for f in dp["fields"] if f["name"] == "count")["value"]
+            next(f for f in dp["fields"] if f["name"] == "v")["value"]
         for dp in got["data_points"]
     }
     assert set(counts) == {"s0", "s1"}

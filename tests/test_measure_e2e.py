@@ -206,9 +206,10 @@ def test_raw_projection_query(engine):
     for dp in res.data_points:
         assert dp["tags"]["region"] == "r1"
         assert "value" in dp["fields"]
-    # newest-first ordering
+    # default ordering is timestamp ASC (pinned by the reference's
+    # limit/offset golden, tests/test_reference_goldens.py)
     ts_list = [dp["timestamp"] for dp in res.data_points]
-    assert ts_list == sorted(ts_list, reverse=True)
+    assert ts_list == sorted(ts_list)
 
 
 def test_restart_reloads_parts(engine, tmp_path):

@@ -1,0 +1,215 @@
+"""Replay the reference's OWN golden fixture cases against this
+framework's wire surface (VERDICT r3 #4).
+
+The reference pins query semantics with shared case files: protobuf-
+JSON schemas (/root/reference/pkg/test/measure/testdata), write data
+(test/cases/measure/data/testdata/*.json, timestamped row i of N at
+baseTime-(N-1-i)*interval — data.go loadData), query inputs
+(input/*.yaml, protobuf-YAML QueryRequest with the time range injected
+from Args{Offset,Duration} — helpers.TimeRange) and expected responses
+(want/*.yaml, compared ignoring timestamp/version/sid —
+data.go verifyWithContext protocmp options).
+
+This suite parses those exact files with OUR generated protos (compiled
+from the same proto tree), drives them through the real WireServer gRPC
+socket, and compares field-for-field.  Ordering is asserted only where
+the query pins it (order_by / top) — for unordered raw scans the
+reference's row order is an implementation detail, so those compare as
+multisets (the reference marks several such cases DisOrder itself).
+
+Skipped wholesale when /root/reference is not present.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+yaml = pytest.importorskip("yaml")
+
+from google.protobuf import json_format, timestamp_pb2  # noqa: E402
+
+from banyandb_tpu.api import pb  # noqa: E402
+from banyandb_tpu.api.grpc_server import WireServer, WireServices  # noqa: E402
+from banyandb_tpu.api.schema import SchemaRegistry  # noqa: E402
+from banyandb_tpu.models.measure import MeasureEngine  # noqa: E402
+from banyandb_tpu.models.stream import StreamEngine  # noqa: E402
+
+REF = Path("/root/reference")
+SCHEMA_DIR = REF / "pkg/test/measure/testdata"
+CASE_DIR = REF / "test/cases/measure/data"
+
+pytestmark = pytest.mark.skipif(
+    not CASE_DIR.exists(), reason="reference tree not available"
+)
+
+MIN = 60_000
+
+# The replayed slice: (input, want, kwargs) mirroring measure.go's
+# measureEntries Args.  ordered=True when the query pins row order.
+CASES = [
+    ("all", "all", {}),
+    ("all_only_fields", "all_only_fields", {}),
+    ("all_max_limit", "all", {}),
+    ("tag_filter", "tag_filter", {}),
+    ("tag_filter_unknown", None, {"want_empty": True}),
+    ("group_max", "group_max", {}),
+    ("group_min", "group_min", {}),
+    ("group_sum", "group_sum", {}),
+    ("group_count", "group_count", {}),
+    ("group_mean", "group_mean", {}),
+    ("top", "top", {"ordered": True}),
+    ("bottom", "bottom", {"ordered": True}),
+    ("order_asc", "order_asc", {"ordered": True}),
+    ("order_desc", "order_desc", {"ordered": True}),
+    ("limit", "limit", {}),
+    ("in", "in", {}),
+    ("linked_or", "linked_or", {}),
+    ("complex_and_or", "complex_and_or", {}),
+    ("float", "float", {}),
+    ("entity", "entity", {}),
+    ("entity_in", "entity_in", {}),
+    ("no_field", "no_field", {}),
+]
+
+
+def _yaml_to_pb(path: Path, msg):
+    data = yaml.safe_load(path.read_text())
+    json_format.ParseDict(data, msg, ignore_unknown_fields=False)
+    return msg
+
+
+def _ts(ms: int) -> timestamp_pb2.Timestamp:
+    return timestamp_pb2.Timestamp(
+        seconds=ms // 1000, nanos=(ms % 1000) * 1_000_000
+    )
+
+
+def _method(channel, service, name, req_cls, resp_cls, kind="unary"):
+    path = f"/{service}/{name}"
+    ser = req_cls.SerializeToString
+    de = resp_cls.FromString
+    if kind == "unary":
+        return channel.unary_unary(path, request_serializer=ser, response_deserializer=de)
+    return channel.stream_stream(path, request_serializer=ser, response_deserializer=de)
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    """Boot the wire server, create the reference schemas, seed the
+    reference testdata exactly as test/cases/init.go does."""
+    tmp = tmp_path_factory.mktemp("goldens")
+    registry = SchemaRegistry(tmp)
+    measure = MeasureEngine(registry, tmp / "data")
+    stream = StreamEngine(registry, tmp / "data")
+    srv = WireServer(WireServices(registry, measure, stream), port=0)
+    srv.start()
+    chan = grpc.insecure_channel(f"127.0.0.1:{srv.port}")
+
+    rpc = pb.database_rpc_pb2
+    group_create = _method(
+        chan, "banyandb.database.v1.GroupRegistryService", "Create",
+        rpc.GroupRegistryServiceCreateRequest, rpc.GroupRegistryServiceCreateResponse,
+    )
+    measure_create = _method(
+        chan, "banyandb.database.v1.MeasureRegistryService", "Create",
+        rpc.MeasureRegistryServiceCreateRequest, rpc.MeasureRegistryServiceCreateResponse,
+    )
+    for g in ("sw_metric", "index_mode"):
+        req = rpc.GroupRegistryServiceCreateRequest()
+        _yaml_to_pb(SCHEMA_DIR / "groups" / f"{g}.json", req.group)
+        req.group.resource_opts.replicas = 0  # single node
+        group_create(req)
+    for m in ("service_cpm_minute", "instance_clr_cpu_minute", "service_traffic"):
+        req = rpc.MeasureRegistryServiceCreateRequest()
+        _yaml_to_pb(SCHEMA_DIR / "measures" / f"{m}.json", req.measure)
+        measure_create(req)
+
+    # baseTime: now truncated to the minute (common.go:76-77)
+    now_ms = int(time.time() * 1000)
+    base_ms = now_ms - now_ms % MIN
+
+    write = _method(
+        chan, "banyandb.measure.v1.MeasureService", "Write",
+        pb.measure_write_pb2.WriteRequest, pb.measure_write_pb2.WriteResponse,
+        kind="stream",
+    )
+
+    def seed(name: str, group: str, datafile: str, base: int, interval: int):
+        rows = json.loads((CASE_DIR / "testdata" / datafile).read_text())
+        reqs = []
+        for i, row in enumerate(rows):
+            dp = pb.measure_write_pb2.DataPointValue()
+            json_format.ParseDict(row, dp, ignore_unknown_fields=False)
+            dp.timestamp.CopyFrom(_ts(base - (len(rows) - i - 1) * interval))
+            req = pb.measure_write_pb2.WriteRequest(data_point=dp, message_id=i + 1)
+            req.metadata.name = name
+            req.metadata.group = group
+            reqs.append(req)
+        list(write(iter(reqs)))
+
+    # init.go:47-57 (the slice feeding the replayed cases)
+    seed("service_traffic", "index_mode", "service_traffic_data_old.json",
+         base_ms - 2 * 86_400_000, MIN)
+    seed("service_traffic", "index_mode", "service_traffic_data.json", base_ms, MIN)
+    seed("service_cpm_minute", "sw_metric", "service_cpm_minute_data.json",
+         base_ms, MIN)
+    seed("instance_clr_cpu_minute", "sw_metric",
+         "instance_clr_cpu_minute_data.json", base_ms, MIN)
+
+    query = _method(
+        chan, "banyandb.measure.v1.MeasureService", "Query",
+        pb.measure_query_pb2.QueryRequest, pb.measure_query_pb2.QueryResponse,
+    )
+    yield {"query": query, "base_ms": base_ms}
+    chan.close()
+    srv.stop()
+
+
+def _canon_points(resp) -> list:
+    """DataPoints -> comparable dicts, clearing the fields the reference
+    ignores (timestamp/version/sid — data.go protocmp.IgnoreFields)."""
+    out = []
+    for dp in resp.data_points:
+        dp = type(dp).FromString(dp.SerializeToString())
+        dp.ClearField("timestamp")
+        dp.ClearField("version")
+        dp.ClearField("sid")
+        out.append(json_format.MessageToDict(dp))
+    return out
+
+
+@pytest.mark.parametrize(
+    "inp,want,kw", CASES, ids=[c[0] for c in CASES]
+)
+def test_reference_golden(ctx, inp, want, kw):
+    req = _yaml_to_pb(
+        CASE_DIR / "input" / f"{inp}.yaml", pb.measure_query_pb2.QueryRequest()
+    )
+    # helpers.TimeRange: [base+offset, base+offset+duration]; the measure
+    # entries all use Offset=-20min, Duration=25..30min
+    begin = ctx["base_ms"] - 20 * MIN
+    req.time_range.begin.CopyFrom(_ts(begin))
+    req.time_range.end.CopyFrom(_ts(begin + 30 * MIN))
+    resp = ctx["query"](req)
+
+    if kw.get("want_empty"):
+        assert not resp.data_points
+        return
+    want_pb = _yaml_to_pb(
+        CASE_DIR / "want" / f"{want}.yaml", pb.measure_query_pb2.QueryResponse()
+    )
+    got = _canon_points(resp)
+    exp = _canon_points(want_pb)
+    if not kw.get("ordered"):
+        key = lambda d: json.dumps(d, sort_keys=True)  # noqa: E731
+        got, exp = sorted(got, key=key), sorted(exp, key=key)
+    assert got == exp, (
+        f"{inp}: wire response diverges from reference golden\n"
+        f"got: {json.dumps(got, indent=1)[:2000]}\n"
+        f"want: {json.dumps(exp, indent=1)[:2000]}"
+    )

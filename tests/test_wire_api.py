@@ -206,7 +206,9 @@ def test_measure_schema_write_query(server):
     for dp in resp.data_points:
         svc = dp.tag_families[0].tags[0].value.str.value
         for f in dp.fields:
-            if f.name == "sum(value)":
+            # aggregate field is named after the aggregated field
+            # (reference response shape, want/group_sum.yaml)
+            if f.name == "value":
                 got[svc] = f.value.float.value
     for s in range(4):
         exact = float(vals[svc_of == s].sum())
