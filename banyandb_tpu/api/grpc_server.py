@@ -403,16 +403,10 @@ class WireServices:
             out = pb.property_rpc_pb2.QueryResponse()
             proj = set(req.tag_projection)
             for p in props:
-                m = out.properties.add()
-                m.metadata.group = p.group
-                m.metadata.name = p.name
-                m.metadata.mod_revision = p.mod_revision
-                m.id = p.id
-                for k, v in p.tags.items():
-                    if proj and k not in proj:
-                        continue
-                    t = m.tags.add(key=k)
-                    t.value.CopyFrom(wire.py_to_tag_value(v))
+                wire.fill_property_pb(
+                    out.properties.add(), p.group, p.name, p.id, p.tags,
+                    p.mod_revision, proj,
+                )
             return out
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
@@ -455,17 +449,9 @@ class WireServices:
                 tr.trace_id = str(tid_conds[0].value)
                 proj = set(req.tag_projection)
                 for s in spans[: int(req.limit) or 100]:
-                    sp = tr.spans.add()
-                    sp.span = s.get("span", b"")
-                    for k, v in s.get("tags", {}).items():
-                        if proj and k not in proj:
-                            continue
-                        t = sp.tags.add(key=k)
-                        try:
-                            ttype = t_schema.tag(k).type
-                        except KeyError:
-                            ttype = None
-                        t.value.CopyFrom(wire.py_to_tag_value(v, ttype))
+                    wire.fill_trace_span_pb(
+                        tr.spans.add(), s, t_schema, proj
+                    )
             return out
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
@@ -1182,6 +1168,24 @@ class WireServices:
             elif catalog == "stream":
                 res = self.stream.query(ireq)
                 out.stream_result.CopyFrom(wire.stream_result_to_pb(res))
+            elif catalog == "trace":
+                if self.trace is None:
+                    raise ValueError("trace engine not wired")
+                from banyandb_tpu.query import ql_exec
+
+                res = ql_exec.execute_trace_ql(self.trace, ireq)
+                out.trace_result.CopyFrom(
+                    self._trace_result_to_pb(ireq, res)
+                )
+            elif catalog == "property":
+                if self.property is None:
+                    raise ValueError("property engine not wired")
+                from banyandb_tpu.query import ql_exec
+
+                res = ql_exec.execute_property_ql(self.property, ireq)
+                out.property_result.CopyFrom(
+                    self._property_result_to_pb(ireq, res)
+                )
             else:
                 # NotImplementedError maps to UNIMPLEMENTED in _abort;
                 # aborting inside the try would be re-caught and
@@ -1192,6 +1196,39 @@ class WireServices:
             return out
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
+
+    def _trace_result_to_pb(self, ireq, res):
+        """ql_exec trace QueryResult -> trace/v1 QueryResponse: span dicts
+        (already projection-filtered by the executor) group into one
+        trace per their 'trace_id' key."""
+        out = pb.trace_query_pb2.QueryResponse()
+        try:
+            t_schema = self.registry.get_trace(ireq.groups[0], ireq.name)
+        except KeyError:
+            t_schema = None
+        by_tid: dict[str, list] = {}
+        for dp in res.data_points:
+            by_tid.setdefault(str(dp.get("trace_id")), []).append(dp)
+        for tid, dps in by_tid.items():
+            tr = out.traces.add()
+            tr.trace_id = tid
+            for dp in dps:
+                if "span" not in dp and "tags" not in dp:
+                    continue  # ordered-query id rows carry no span body
+                wire.fill_trace_span_pb(tr.spans.add(), dp, t_schema)
+        return out
+
+    def _property_result_to_pb(self, ireq, res):
+        """ql_exec property QueryResult (already projection-filtered) ->
+        property/v1 QueryResponse."""
+        out = pb.property_rpc_pb2.QueryResponse()
+        for dp in res.data_points:
+            wire.fill_property_pb(
+                out.properties.add(), ireq.groups[0], ireq.name,
+                dp.get("id", ""), dp.get("tags", {}),
+                dp.get("mod_revision", 0),
+            )
+        return out
 
 
 class WireServer:

@@ -515,6 +515,39 @@ def element_value_from_pb(stream: "isch.Stream", wreq):
     )
 
 
+def fill_trace_span_pb(sp, span: dict, t_schema=None, proj=()):
+    """Fill one trace/v1 Span message from an engine span dict; tags
+    outside `proj` (when non-empty) are dropped, tag types resolve from
+    the schema when known.  Shared by TraceService.Query and the BydbQL
+    trace catalog so the two wire surfaces cannot drift."""
+    sp.span = span.get("span", b"")
+    for k, v in span.get("tags", {}).items():
+        if proj and k not in proj:
+            continue
+        ttype = None
+        if t_schema is not None:
+            try:
+                ttype = t_schema.tag(k).type
+            except KeyError:
+                ttype = None
+        t = sp.tags.add(key=k)
+        t.value.CopyFrom(py_to_tag_value(v, ttype))
+
+
+def fill_property_pb(m, group, name, pid, tags: dict, mod_revision=0, proj=()):
+    """Fill one property/v1 Property message; shared by
+    PropertyService.Query and the BydbQL property catalog."""
+    m.metadata.group = group
+    m.metadata.name = name
+    m.metadata.mod_revision = int(mod_revision)
+    m.id = str(pid)
+    for k, v in tags.items():
+        if proj and k not in proj:
+            continue
+        t = m.tags.add(key=k)
+        t.value.CopyFrom(py_to_tag_value(v))
+
+
 # -- schema objects --------------------------------------------------------
 
 

@@ -22,7 +22,7 @@ row count (tests/test_precision.py).
 
 from __future__ import annotations
 
-import dataclasses
+import os
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -40,12 +40,24 @@ from banyandb_tpu.api.model import (
     QueryResult,
 )
 from banyandb_tpu.api.schema import Measure, TagType
-from banyandb_tpu.ops.blocks import pad_rows_bucket
 from banyandb_tpu.storage.part import ColumnData
 from banyandb_tpu.utils import hostops
 
 CHUNK = 8192
+# Scan chunks are much larger than storage blocks (8192 rows,
+# banyand/measure/measure.go:46): the kernel is HBM-bound, so per-chunk
+# dispatch + [G]-sized host accumulation dominate at small chunks (profiled
+# ~330ms of a 372ms warm 100k-group scan at 8192).  Power-of-two buckets up
+# to SCAN_CHUNK keep the compiled-shape set finite.
+SCAN_CHUNK = int(os.environ.get("BYDB_SCAN_CHUNK", 1 << 20))
 _NUM_HIST_BUCKETS = 512
+
+
+def _scan_bucket(n: int) -> int:
+    b = 64
+    while b < n:
+        b <<= 1
+    return min(b, SCAN_CHUNK)
 
 
 @dataclass(frozen=True)
@@ -228,14 +240,60 @@ class DictState:
         self.dicts = GlobalDicts(())
         self.remaps: dict[tuple, np.ndarray] = {}
         self.token = uuid.uuid4().hex
+        # snapshot caches, valid while their length still covers the
+        # (append-only) dict: values = code -> bytes list; ranks = code ->
+        # lexicographic position among dict values (canonical group order
+        # without a per-query Python sort over 100k groups)
+        self.values_cache: dict[str, list] = {}
+        self.rank_cache: dict[str, np.ndarray] = {}
 
     def reset(self):
         with self.lock:
             self._reset_locked()
 
+    def values_snapshot(self, tag: str) -> list:
+        """code -> value list for `tag`; cached, caller holds self.lock.
+        The returned list is immutable by convention (shared across
+        queries): dict growth rebuilds a fresh list."""
+        m = self.dicts.maps.get(tag, {})
+        cached = self.values_cache.get(tag)
+        if cached is None or len(cached) != len(m):
+            cached = self.dicts.values(tag)
+            self.values_cache[tag] = cached
+        return cached
+
+    def rank_lut(self, tag: str, values: list) -> np.ndarray:
+        """code -> bytes-lexicographic rank over at least `values`.
+
+        Ranks from a larger (append-only) snapshot stay order-preserving
+        over any older snapshot's codes, so a cached superset is reusable;
+        callers only need relative order, not density.  The cache is
+        guarded by snapshot identity — `values` must be the object
+        values_snapshot currently hands out — so a query holding a
+        pre-reset snapshot can neither reuse nor poison the post-reset
+        cache (codes from the old dict generation rank differently).
+        Takes self.lock.
+        """
+        with self.lock:
+            current = self.values_cache.get(tag)
+            if values is not current:
+                return _build_rank_lut(values)  # stale/foreign: uncached
+            lut = self.rank_cache.get(tag)
+            if lut is None or len(lut) < len(values):
+                lut = self.rank_cache[tag] = _build_rank_lut(values)
+            return lut
+
+
+def _build_rank_lut(values: list) -> np.ndarray:
+    """code -> bytes-lexicographic rank among `values` (inverse argsort)."""
+    order = sorted(range(len(values)), key=values.__getitem__)
+    lut = np.empty(len(values), dtype=np.int64)
+    lut[np.asarray(order, dtype=np.int64)] = np.arange(len(values))
+    return lut
+
 
 _MAX_PERSISTENT_GROUPS = int(
-    __import__("os").environ.get("BYDB_MAX_PERSISTENT_GROUPS", 1 << 18)
+    os.environ.get("BYDB_MAX_PERSISTENT_GROUPS", 1 << 18)
 )
 
 
@@ -294,7 +352,6 @@ def _lower_criteria(c: Optional[Criteria]) -> tuple[list[Condition], tuple]:
     return conds, (() if pure_and(expr) else expr)
 
 
-@dataclass
 class Partials:
     """Per-node partial aggregates keyed by decoded tag-value tuples.
 
@@ -303,18 +360,80 @@ class Partials:
     docs/concept/distributed-measure-aggregation.md): nodes return these,
     the liaison combines by group tuple and finalizes.  Arrays cover only
     nonempty groups (dense [G] layouts never cross nodes).
+
+    Group identity is dual-representation: either materialized value
+    tuples (`groups`, the wire/combine form) or dense global-code rows
+    (`codes` [K, T] + `group_values` dict snapshots, the standalone hot
+    path).  Tuples materialize lazily on first `.groups` access — a
+    standalone TopN over 100k groups never builds 100k Python tuples
+    (profiled at ~130ms/query before this split).
     """
 
-    group_tags: tuple[str, ...]
-    groups: list[tuple[bytes, ...]]  # tag-value tuple per nonempty group
-    count: np.ndarray  # f64 [K]
-    sums: dict  # field -> f64 [K]
-    mins: dict
-    maxs: dict
-    hist: Optional[np.ndarray] = None  # [K, B]
-    hist_lo: float = 0.0
-    hist_span: float = 1.0
-    field_stats: dict = dataclasses.field(default_factory=dict)  # f -> (min, max)
+    __slots__ = (
+        "group_tags", "count", "sums", "mins", "maxs", "hist", "hist_lo",
+        "hist_span", "field_stats", "_groups", "codes", "group_values",
+    )
+
+    def __init__(
+        self,
+        group_tags: tuple[str, ...],
+        groups: Optional[list] = None,  # tag-value tuple per nonempty group
+        count: np.ndarray = None,  # f64 [K]
+        sums: dict = None,  # field -> f64 [K]
+        mins: dict = None,
+        maxs: dict = None,
+        hist: Optional[np.ndarray] = None,  # [K, B]
+        hist_lo: float = 0.0,
+        hist_span: float = 1.0,
+        field_stats: dict = None,  # f -> (min, max)
+        codes: Optional[np.ndarray] = None,  # int32 [K, T] global codes
+        group_values: Optional[dict] = None,  # tag -> list[bytes] snapshot
+    ):
+        if groups is None and codes is None:
+            raise TypeError("Partials needs groups or codes+group_values")
+        self.group_tags = group_tags
+        self._groups = groups
+        self.codes = codes
+        self.group_values = group_values
+        self.count = count
+        self.sums = sums
+        self.mins = mins
+        self.maxs = maxs
+        self.hist = hist
+        self.hist_lo = hist_lo
+        self.hist_span = hist_span
+        self.field_stats = {} if field_stats is None else field_stats
+
+    @property
+    def groups(self) -> list[tuple[bytes, ...]]:
+        if self._groups is None:
+            k = self.codes.shape[0]
+            if not self.group_tags:
+                self._groups = [()] * k
+            elif k == 0:
+                self._groups = []
+            else:
+                cols = [
+                    np.asarray(self.group_values[t], dtype=object)[
+                        self.codes[:, i]
+                    ]
+                    for i, t in enumerate(self.group_tags)
+                ]
+                self._groups = list(zip(*cols))
+        return self._groups
+
+    @groups.setter
+    def groups(self, v: list) -> None:
+        self._groups = v
+
+    def group_key(self, i: int) -> tuple[bytes, ...]:
+        """Decode ONE group's value tuple without materializing the rest."""
+        if self._groups is not None:
+            return self._groups[i]
+        return tuple(
+            self.group_values[t][int(self.codes[i, j])]
+            for j, t in enumerate(self.group_tags)
+        )
 
 
 def execute_aggregate(
@@ -325,7 +444,7 @@ def execute_aggregate(
 ) -> QueryResult:
     """Run a group-by/aggregate/top-N/percentile query over decoded sources."""
     partial = compute_partials(measure, request, sources, dict_state=dict_state)
-    return finalize_partials(measure, request, [partial])
+    return finalize_partials(measure, request, [partial], dict_state=dict_state)
 
 
 def compute_partials(
@@ -467,7 +586,12 @@ def compute_partials(
                 pred_vals[f"p{i}"] = jnp.int32(code)
 
         radices = tuple(gd.size(t) for t in group_tags)
-        group_values = {t: gd.values(t) for t in group_tags}
+        if dict_state is not None and dict_state.dicts is gd:
+            group_values = {
+                t: dict_state.values_snapshot(t) for t in group_tags
+            }
+        else:
+            group_values = {t: gd.values(t) for t in group_tags}
     num_groups = 1
     for r in radices:
         num_groups *= r
@@ -478,7 +602,7 @@ def compute_partials(
     # distributed two-pass range agreement).
     want_minmax = not agg or agg.function in ("min", "max") or want_percentile
 
-    nrows = CHUNK if n > CHUNK else pad_rows_bucket(max(n, 1))
+    nrows = SCAN_CHUNK if n > SCAN_CHUNK else _scan_bucket(max(n, 1))
     spec = PlanSpec(
         tags_code=tuple(sorted(tags_code)),
         fields=tuple(sorted(fields)),
@@ -556,20 +680,18 @@ def compute_partials(
         if hist is not None:
             hist += np.asarray(out["hist"], dtype=np.float64)
 
-    # --- dense [G] arrays -> nonempty-group records ------------------------
+    # --- dense [G] arrays -> nonempty-group records (codes stay dense
+    # int32 rows; value tuples materialize lazily, Partials.groups) -------
     if group_tags:
         nz = np.nonzero(count > 0)[0]
-        codes = np.unravel_index(nz, radices) if len(nz) else [np.zeros(0, int)] * max(len(radices), 1)
-        groups = [
-            tuple(
-                group_values[t][int(codes[i][row])]
-                for i, t in enumerate(group_tags)
-            )
-            for row in range(len(nz))
-        ]
+        codes = (
+            np.stack(np.unravel_index(nz, radices), axis=1).astype(np.int32)
+            if len(nz)
+            else np.zeros((0, len(group_tags)), np.int32)
+        )
     else:
         nz = np.asarray([0])
-        groups = [()]
+        codes = np.zeros((1, 0), np.int32)
     field_stats = {}
     if want_minmax:
         for f in spec.fields:
@@ -581,7 +703,8 @@ def compute_partials(
                 )
     return Partials(
         group_tags=group_tags,
-        groups=groups,
+        codes=codes,
+        group_values=group_values,
         count=count[nz],
         sums={f: sums[f][nz] for f in spec.fields},
         mins={f: mins[f][nz] for f in spec.fields},
@@ -780,9 +903,15 @@ def combine_partials(partials: list[Partials]) -> Partials:
 
 
 def finalize_partials(
-    measure: Measure, request: QueryRequest, partials: list[Partials]
+    measure: Measure,
+    request: QueryRequest,
+    partials: list[Partials],
+    dict_state: Optional[DictState] = None,
 ) -> QueryResult:
-    """Combine + select + decode: the liaison-side tail of the query."""
+    """Combine + select + decode: the liaison-side tail of the query.
+
+    `dict_state` (standalone fast path only) caches the per-tag rank LUTs
+    that vectorize canonical group ordering."""
     p = combine_partials(partials) if len(partials) != 1 else partials[0]
     agg = request.agg
     group_tags = p.group_tags
@@ -816,13 +945,32 @@ def finalize_partials(
         # standalone vs combine order in the cluster), so positional
         # order would (a) keep different groups per topology once LIMIT
         # truncates and (b) break prefix-stability between pages issued
-        # with different limits.  A total order fixes both; the Python
-        # key sort costs O(G log G) only on the emit path — the combine
-        # plane stays vectorized.
+        # with different limits.  A total order fixes both.  Top-N
+        # queries skip it outright — selection below rebuilds group_ids
+        # from the ranking metric.  The standalone codes path orders via
+        # per-tag rank LUTs + np.lexsort (identical bytes order, no
+        # O(G log G) Python compares); combined tuple partials keep the
+        # Python key sort (the distributed combine plane's group count
+        # crossed the wire already).
         group_ids = np.nonzero(nonempty)[0]
-        group_ids = np.asarray(
-            sorted(group_ids.tolist(), key=lambda i: p.groups[i]), dtype=int
-        )
+        if request.top:
+            pass  # order irrelevant: Top-N selection replaces group_ids
+        elif p.codes is not None and group_ids.size:
+            keys = []
+            for i, t in enumerate(group_tags):
+                vals = p.group_values[t]
+                lut = (
+                    dict_state.rank_lut(t, vals)
+                    if dict_state is not None
+                    else _build_rank_lut(vals)
+                )
+                keys.append(lut[p.codes[group_ids, i]])
+            group_ids = group_ids[np.lexsort(tuple(reversed(keys)))]
+        else:
+            group_ids = np.asarray(
+                sorted(group_ids.tolist(), key=lambda i: p.groups[i]),
+                dtype=int,
+            )
 
     # Top-N selection narrows the group id set.  Ranking field is
     # top.field_name; the ranking function is the request's aggregate when
@@ -853,7 +1001,7 @@ def finalize_partials(
                     int(i)
                     for i in np.nonzero((metric == kth_val) & nonempty)[0]
                 ),
-                key=lambda i: p.groups[i],
+                key=p.group_key,
             )
             group_ids = np.asarray(head + tied[: k - len(head)], dtype=int)
 
@@ -868,7 +1016,7 @@ def finalize_partials(
     from banyandb_tpu.query import filter as qfilter
 
     for g in group_ids:
-        raw = p.groups[int(g)]
+        raw = p.group_key(int(g))
         result.groups.append(
             tuple(
                 qfilter.decode_tag_value(v, measure.tag(t).type)

@@ -1,0 +1,174 @@
+"""Shared BydbQL executors for the trace and property catalogs.
+
+One implementation serves both entry surfaces — the standalone bus server
+(server.py TOPIC_QL) and the gRPC BydbQLService (api/grpc_server.py) —
+the way the reference routes every catalog through one handler
+(banyand/liaison/grpc/bydbql.go:143-173).  Measure and stream QL lower
+onto their engines' query() directly; trace and property need the
+catalog-specific planning below (trace-id lookup vs sidx-ordered scan,
+id/tag filter splitting).
+"""
+
+from __future__ import annotations
+
+from banyandb_tpu.api.model import QueryRequest, QueryResult, TimeRange
+
+
+def and_leaves(req: QueryRequest):
+    """Criteria leaves for catalogs whose executors take flat AND
+    filters — OR trees are rejected rather than silently flattened
+    (flattening an OR into AND returns wrong results)."""
+    from banyandb_tpu.query.measure_exec import _lower_criteria
+
+    leaves, expr = _lower_criteria(req.criteria)
+    if expr:
+        raise ValueError("OR criteria not supported for this catalog")
+    return leaves
+
+
+def _span_matches(span: dict, conds) -> bool:
+    for c in conds:
+        v = span.get("tags", {}).get(c.name)
+        if c.op == "eq":
+            if v != c.value:
+                return False
+        elif c.op == "ne":
+            if v == c.value:
+                return False
+        elif c.op == "in":
+            if v not in c.value:
+                return False
+        elif c.op == "not_in":
+            if v in c.value:
+                return False
+        elif c.op in ("gt", "ge", "lt", "le"):
+            if v is None:
+                return False
+            try:
+                fv, fc = float(v), float(c.value)
+            except (TypeError, ValueError):
+                return False
+            if c.op == "gt" and not fv > fc:
+                return False
+            if c.op == "ge" and not fv >= fc:
+                return False
+            if c.op == "lt" and not fv < fc:
+                return False
+            if c.op == "le" and not fv <= fc:
+                return False
+        else:  # never silently match an op we can't evaluate
+            raise ValueError(f"trace QL op {c.op!r} not supported")
+    return True
+
+
+def execute_trace_ql(trace_engine, req: QueryRequest) -> QueryResult:
+    """Trace QL execution: trace-id equality (the schema's trace_id_tag,
+    not a hardcoded name) fetches spans; otherwise an ORDER BY <numeric
+    tag> query rides the ordered (sidx) index with range bounds from
+    conditions on that tag.  Residual tag conditions post-filter spans
+    (never silently ignored); a SELECT projection narrows span tags."""
+    res = QueryResult()
+    leaves = and_leaves(req)
+    group = req.groups[0]
+    tid_tag = trace_engine.get_trace(group, req.name).trace_id_tag or "trace_id"
+    proj = set(req.tag_projection or ())
+
+    def shape(span: dict, tid: str) -> dict:
+        tags = span.get("tags", {})
+        if proj:
+            tags = {k: v for k, v in tags.items() if k in proj}
+        out = {"trace_id": tid, "tags": tags}
+        if "span" in span:
+            out["span"] = span["span"]
+        return out
+
+    tid_conds = [c for c in leaves if c.name == tid_tag and c.op == "eq"]
+    if tid_conds:
+        tid = str(tid_conds[0].value)
+        residual = [c for c in leaves if c is not tid_conds[0]]
+        spans = trace_engine.query_by_trace_id(group, req.name, tid)
+        res.data_points = [
+            shape(s, tid) for s in spans if _span_matches(s, residual)
+        ][: req.limit or 100]
+        return res
+    if req.order_by_tag:
+        lo = hi = None
+        residual = []
+        for c in leaves:
+            if c.name == req.order_by_tag and c.op in ("gt", "ge", "lt", "le"):
+                # duplicate bounds INTERSECT (AND semantics)
+                if c.op in ("gt", "ge"):
+                    b = int(c.value) + (1 if c.op == "gt" else 0)
+                    lo = b if lo is None else max(lo, b)
+                else:
+                    b = int(c.value) - (1 if c.op == "lt" else 0)
+                    hi = b if hi is None else min(hi, b)
+            else:
+                residual.append(c)
+        tr = TimeRange(req.time_range.begin_millis, req.time_range.end_millis)
+        ids = trace_engine.query_ordered(
+            group,
+            req.name,
+            req.order_by_tag,
+            tr,
+            lo=lo,
+            hi=hi,
+            asc=(req.order_by_dir == "asc"),
+            # over-fetch when residual filters will drop candidates
+            limit=(req.limit or 20) * (4 if residual else 1),
+        )
+        if residual:
+            kept = []
+            for tid in ids:
+                spans = trace_engine.query_by_trace_id(group, req.name, tid)
+                if any(_span_matches(s, residual) for s in spans):
+                    kept.append(tid)
+                if len(kept) >= (req.limit or 20):
+                    break
+            ids = kept
+        res.data_points = [{"trace_id": t} for t in ids[: req.limit or 20]]
+        return res
+    raise ValueError(
+        f"trace QL needs WHERE {tid_tag} = '...' or ORDER BY <numeric tag>"
+    )
+
+
+def execute_property_ql(property_engine, req: QueryRequest) -> QueryResult:
+    """Property QL: id equality / IN and tag-equality filters."""
+    res = QueryResult()
+    leaves = and_leaves(req)
+    ids = None
+    tag_filters = {}
+    for c in leaves:
+        if c.name == "id":
+            if c.op == "eq":
+                ids = [str(c.value)]
+            elif c.op == "in":
+                ids = [str(v) for v in c.value]
+            else:
+                raise ValueError("property id supports = / IN only")
+        elif c.op == "eq":
+            tag_filters[c.name] = c.value
+        else:
+            raise ValueError(f"property QL supports = on tags, got {c.op}")
+    props = property_engine.query(
+        req.groups[0],
+        req.name,
+        tag_filters=tag_filters or None,
+        ids=ids,
+        limit=req.limit or 100,
+    )
+    proj = set(req.tag_projection or ())
+    res.data_points = [
+        {
+            "id": p.id,
+            "tags": (
+                {k: v for k, v in p.tags.items() if k in proj}
+                if proj
+                else p.tags
+            ),
+            "mod_revision": p.mod_revision,
+        }
+        for p in props
+    ]
+    return res

@@ -339,148 +339,15 @@ class StandaloneServer:
         )
         return {"result": result_to_json(res)}
 
-    @staticmethod
-    def _and_leaves(req: QueryRequest):
-        """Criteria leaves for catalogs whose executors take flat AND
-        filters — OR trees are rejected rather than silently flattened
-        (flattening an OR into AND returns wrong results)."""
-        from banyandb_tpu.query.measure_exec import _lower_criteria
-
-        leaves, expr = _lower_criteria(req.criteria)
-        if expr:
-            raise ValueError("OR criteria not supported for this catalog")
-        return leaves
-
     def _ql_trace(self, req: QueryRequest) -> QueryResult:
-        """Trace QL execution: trace_id equality fetches spans; otherwise
-        an ORDER BY <numeric tag> query rides the ordered (sidx) index
-        with range bounds from conditions on that tag.  Residual tag
-        conditions post-filter spans (never silently ignored)."""
-        res = QueryResult()
-        leaves = self._and_leaves(req)
-        group = req.groups[0]
+        from banyandb_tpu.query import ql_exec
 
-        def span_matches(span: dict, conds) -> bool:
-            for c in conds:
-                v = span.get("tags", {}).get(c.name)
-                if c.op == "eq":
-                    if v != c.value:
-                        return False
-                elif c.op == "ne":
-                    if v == c.value:
-                        return False
-                elif c.op == "in":
-                    if v not in c.value:
-                        return False
-                elif c.op == "not_in":
-                    if v in c.value:
-                        return False
-                elif c.op in ("gt", "ge", "lt", "le"):
-                    if v is None:
-                        return False
-                    try:
-                        fv, fc = float(v), float(c.value)
-                    except (TypeError, ValueError):
-                        return False
-                    if c.op == "gt" and not fv > fc:
-                        return False
-                    if c.op == "ge" and not fv >= fc:
-                        return False
-                    if c.op == "lt" and not fv < fc:
-                        return False
-                    if c.op == "le" and not fv <= fc:
-                        return False
-                else:  # never silently match an op we can't evaluate
-                    raise ValueError(f"trace QL op {c.op!r} not supported")
-            return True
-
-        tid_conds = [c for c in leaves if c.name == "trace_id" and c.op == "eq"]
-        if tid_conds:
-            residual = [c for c in leaves if c is not tid_conds[0]]
-            spans = self.trace.query_by_trace_id(
-                group, req.name, str(tid_conds[0].value)
-            )
-            res.data_points = [
-                s for s in spans if span_matches(s, residual)
-            ][: req.limit or 100]
-            return res
-        if req.order_by_tag:
-            from banyandb_tpu.api.model import TimeRange
-
-            lo = hi = None
-            residual = []
-            for c in leaves:
-                if c.name == req.order_by_tag and c.op in ("gt", "ge", "lt", "le"):
-                    # duplicate bounds INTERSECT (AND semantics)
-                    if c.op in ("gt", "ge"):
-                        b = int(c.value) + (1 if c.op == "gt" else 0)
-                        lo = b if lo is None else max(lo, b)
-                    else:
-                        b = int(c.value) - (1 if c.op == "lt" else 0)
-                        hi = b if hi is None else min(hi, b)
-                else:
-                    residual.append(c)
-            tr = TimeRange(req.time_range.begin_millis, req.time_range.end_millis)
-            ids = self.trace.query_ordered(
-                group,
-                req.name,
-                req.order_by_tag,
-                tr,
-                lo=lo,
-                hi=hi,
-                asc=(req.order_by_dir == "asc"),
-                # over-fetch when residual filters will drop candidates
-                limit=(req.limit or 20) * (4 if residual else 1),
-            )
-            if residual:
-                kept = []
-                for tid in ids:
-                    spans = self.trace.query_by_trace_id(group, req.name, tid)
-                    if any(span_matches(s, residual) for s in spans):
-                        kept.append(tid)
-                    if len(kept) >= (req.limit or 20):
-                        break
-                ids = kept
-            res.data_points = [{"trace_id": t} for t in ids[: req.limit or 20]]
-            return res
-        raise ValueError(
-            "trace QL needs WHERE trace_id = '...' or ORDER BY <numeric tag>"
-        )
+        return ql_exec.execute_trace_ql(self.trace, req)
 
     def _ql_property(self, req: QueryRequest) -> QueryResult:
-        """Property QL: id equality / IN and tag-equality filters."""
-        res = QueryResult()
-        leaves = self._and_leaves(req)
-        ids = None
-        tag_filters = {}
-        for c in leaves:
-            if c.name == "id":
-                if c.op == "eq":
-                    ids = [str(c.value)]
-                elif c.op == "in":
-                    ids = [str(v) for v in c.value]
-                else:
-                    raise ValueError("property id supports = / IN only")
-            elif c.op == "eq":
-                tag_filters[c.name] = c.value
-            else:
-                raise ValueError(f"property QL supports = on tags, got {c.op}")
-        props = self.property.query(
-            req.groups[0],
-            req.name,
-            tag_filters=tag_filters or None,
-            ids=ids,
-            limit=req.limit or 100,
-        )
-        res.data_points = [
-            {
-                "id": p.id,
-                "tags": p.tags,
-                "mod_revision": p.mod_revision,
-            }
-            for p in props
-        ]
-        return res
+        from banyandb_tpu.query import ql_exec
+
+        return ql_exec.execute_property_ql(self.property, req)
 
     def _registry_op(self, env):
         op, kind = env["op"], env["kind"]
