@@ -251,31 +251,120 @@ class WireServices:
         except Exception as e:  # noqa: BLE001 - mapped to gRPC status
             _abort(context, e)
 
+    _WRITE_BATCH = 256
+
     def measure_write(self, request_iterator, context):
-        """Bidi stream: one WriteResponse per WriteRequest, matching the
-        reference's flow-control contract (measure/v1 rpc.proto Write)."""
+        """Bidi stream with write batching: consecutive requests for the
+        same measure accumulate into columnar batches committed through
+        the bulk path (write_points_bulk), preserving the reference's
+        one-WriteResponse-per-WriteRequest contract — responses emit
+        after their batch commits.  A 1ms idle flush keeps strict
+        ping-pong clients (that wait for each response) from
+        deadlocking against the batcher; a failed bulk batch replays
+        point-by-point so per-point statuses stay accurate."""
+        import queue as _queue
+        import threading as _threading
+
         from banyandb_tpu.api import model as im
 
-        for wreq in request_iterator:
-            resp = pb.measure_write_pb2.WriteResponse(message_id=wreq.message_id)
+        pending: list = []  # [(wreq, decoded point), ...] one-measure run
+        cur: tuple | None = None  # (group, name) of the pending run
+
+        def _resp(wreq, status):
+            r = pb.measure_write_pb2.WriteResponse(message_id=wreq.message_id)
+            r.status = status
+            r.metadata.CopyFrom(wreq.metadata)
+            return r
+
+        def commit():
+            nonlocal pending, cur
+            if not pending:
+                return []
+            group, name = cur
             try:
-                m = self.registry.get_measure(
-                    wreq.metadata.group, wreq.metadata.name
-                )
-                point = wire.write_request_to_point(m, wreq)
-                self.measure.write(
+                self.measure.write_points_bulk(
                     im.WriteRequest(
-                        wreq.metadata.group, wreq.metadata.name, (point,)
+                        group, name, tuple(p for _, p in pending)
                     )
                 )
-                resp.status = "STATUS_SUCCEED"
-            except KeyError:
-                resp.status = "STATUS_NOT_FOUND"
-            except Exception:  # noqa: BLE001
-                log.exception("measure write failed")
-                resp.status = "STATUS_INTERNAL_ERROR"
-            resp.metadata.CopyFrom(wreq.metadata)
-            yield resp
+                statuses = ["STATUS_SUCCEED"] * len(pending)
+            except Exception:  # noqa: BLE001 — replay for per-point status
+                statuses = []
+                for _, p in pending:
+                    try:
+                        self.measure.write(im.WriteRequest(group, name, (p,)))
+                        statuses.append("STATUS_SUCCEED")
+                    except KeyError:
+                        statuses.append("STATUS_NOT_FOUND")
+                    except Exception:  # noqa: BLE001
+                        log.exception("measure write failed")
+                        statuses.append("STATUS_INTERNAL_ERROR")
+            out = [_resp(w, st) for (w, _), st in zip(pending, statuses)]
+            pending, cur = [], None
+            return out
+
+        # Bounded queue restores HTTP/2 backpressure: the feeder blocks
+        # once the batcher falls behind, so a client that never reads
+        # responses cannot grow server memory with its whole stream.
+        # `dead` unblocks the feeder if the response generator is torn
+        # down early (client cancel) — a plain blocking put would leak
+        # the thread.
+        q: _queue.Queue = _queue.Queue(maxsize=2 * self._WRITE_BATCH)
+        _DONE = object()
+        dead = _threading.Event()
+
+        def _put(item) -> bool:
+            while not dead.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def feeder():
+            try:
+                for r in request_iterator:
+                    if not _put(r):
+                        return
+            except Exception:  # noqa: BLE001 — stream cancel/reset
+                pass
+            finally:
+                _put(_DONE)
+
+        _threading.Thread(target=feeder, daemon=True).start()
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=0.001 if pending else None)
+                except _queue.Empty:
+                    yield from commit()  # idle: client is waiting on us
+                    continue
+                if item is _DONE:
+                    yield from commit()
+                    return
+                wreq = item
+                key = (wreq.metadata.group, wreq.metadata.name)
+                if cur is not None and (
+                    key != cur or len(pending) >= self._WRITE_BATCH
+                ):
+                    yield from commit()
+                try:
+                    m = self.registry.get_measure(*key)
+                    point = wire.write_request_to_point(m, wreq)
+                except KeyError:
+                    yield from commit()  # keep response ordering
+                    yield _resp(wreq, "STATUS_NOT_FOUND")
+                    continue
+                except Exception:  # noqa: BLE001
+                    yield from commit()
+                    log.exception("measure write decode failed")
+                    yield _resp(wreq, "STATUS_INTERNAL_ERROR")
+                    continue
+                cur = key
+                pending.append((wreq, point))
+        finally:
+            dead.set()
 
     def measure_topn(self, req, context):
         try:

@@ -16,6 +16,10 @@ from typing import Callable
 class Topic(str, enum.Enum):
     # write plane (api/data/data.go topic registry analog)
     MEASURE_WRITE = "measure-write"
+    # columnar write envelope: base64-packed ts/field arrays +
+    # optionally dictionary-encoded tag columns — the wire shape of the
+    # vectorized ingest path (10x less per-point JSON than MEASURE_WRITE)
+    MEASURE_WRITE_COLUMNS = "measure-write-cols"
     STREAM_WRITE = "stream-write"
     TRACE_WRITE = "trace-write"
     PROPERTY_APPLY = "property-apply"

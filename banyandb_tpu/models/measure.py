@@ -33,6 +33,34 @@ from banyandb_tpu.storage.tsdb import TSDB
 from banyandb_tpu.utils import hashing
 
 
+class DictColumn:
+    """A dictionary-encoded tag column: `values` (distinct tag values)
+    + int `codes` per row.  The wire's columnar write envelope ships tag
+    columns this way; keeping the encoding end-to-end (client -> bus ->
+    engine -> memtable) means per-row Python work never happens on the
+    ingest hot path — only per-DISTINCT-value work does."""
+
+    __slots__ = ("values", "codes")
+
+    def __init__(self, values: list, codes: np.ndarray):
+        self.values = values
+        self.codes = np.asarray(codes)
+
+    def __len__(self) -> int:
+        return len(self.codes)
+
+    def __getitem__(self, i):
+        # row-shaped access for the slow paths (index-mode, series docs)
+        return self.values[int(self.codes[i])]
+
+    def take(self, idx: np.ndarray) -> "DictColumn":
+        return DictColumn(self.values, self.codes[idx])
+
+    def row_values(self) -> list:
+        """Materialized per-row value list (compat escape hatch)."""
+        return np.asarray(self.values, dtype=object)[self.codes].tolist()
+
+
 class MeasureEngine:
     """All measure resources of all groups, one TSDB per group."""
 
@@ -155,6 +183,43 @@ class MeasureEngine:
                 self.topn.observe(m, p)
         return n
 
+    def write_points_bulk(self, req: WriteRequest) -> int:
+        """Row-shaped request -> columnar ingest: the wire handlers'
+        bridge onto write_columns.  One decode pass over the points
+        builds columns; entity-tag presence is validated with the row
+        path's strictness (missing entity tag raises KeyError rather
+        than silently writing the empty value)."""
+        m = self.registry.get_measure(req.group, req.name)
+        pts = req.points
+        n = len(pts)
+        if n == 0:
+            return 0
+        now_ms = int(time.time() * 1000)
+        ts = np.fromiter((p.ts_millis for p in pts), np.int64, count=n)
+        versions = np.fromiter(
+            ((p.version or now_ms) for p in pts), np.int64, count=n
+        )
+        tags = {t.name: [p.tags.get(t.name) for p in pts] for t in m.tags}
+        for t in m.entity.tag_names:
+            if any(v is None for v in tags[t]):
+                raise KeyError(t)
+        fields = {
+            f.name: np.fromiter(
+                (float(p.fields.get(f.name, 0)) for p in pts),
+                np.float64,
+                count=n,
+            )
+            for f in m.fields
+        }
+        return self.write_columns(
+            req.group,
+            req.name,
+            ts_millis=ts,
+            tags=tags,
+            fields=fields,
+            versions=versions,
+        )
+
     def write_columns(
         self,
         group: str,
@@ -171,12 +236,12 @@ class MeasureEngine:
         reference's gRPC streaming shape); collectors that already hold
         columns use this path: unique entities are hashed once, routing
         and interning are NumPy passes, and memtable appends are bulk
-        extends.  TopN rules do not observe bulk writes (use write() for
-        measures feeding TopN pre-aggregation).
+        extends.  Semantics match write() exactly — TopN rules observe
+        bulk writes (topn.observe_columns) and index-mode measures take
+        the per-doc index path — one write path, two decode shapes
+        (ref single path banyand/measure/write_standalone.go:348).
         """
         m = self.registry.get_measure(group, name)
-        if m.index_mode:
-            raise NotImplementedError("bulk path for index-mode measures")
         db = self._tsdb(group)
         opts = self.registry.get_group(group).resource_opts
         shard_num = opts.shard_num
@@ -189,27 +254,111 @@ class MeasureEngine:
             if versions is not None
             else np.full(n, int(time.time() * 1000), dtype=np.int64)
         )
-        tag_bytes: dict[str, list] = {}
+        tag_bytes: dict[str, object] = {}
         for t in m.tags:
             vals = tags.get(t.name)
-            # None elements map to the empty value, matching the row path
-            tag_bytes[t.name] = (
-                [hashing.entity_bytes(v) if v is not None else b"" for v in vals]
-                if vals is not None
-                else None
-            )
+            # None elements map to the empty value, matching the row path.
+            # DictColumn stays encoded: only its DISTINCT values pay the
+            # bytes conversion.  Columns are validated here (lengths,
+            # code bounds) because a ragged or out-of-range column that
+            # reached the memtable would corrupt it permanently — the
+            # wire envelope hands us client-controlled codes.
+            if vals is None:
+                tag_bytes[t.name] = None
+            elif isinstance(vals, DictColumn):
+                codes = np.asarray(vals.codes)
+                if len(codes) != n:
+                    raise ValueError(
+                        f"tag {t.name}: {len(codes)} codes for {n} rows"
+                    )
+                if codes.size and (
+                    int(codes.min()) < 0
+                    or int(codes.max()) >= len(vals.values)
+                ):
+                    raise ValueError(
+                        f"tag {t.name}: code out of range for dict of "
+                        f"{len(vals.values)}"
+                    )
+                tag_bytes[t.name] = DictColumn(
+                    [
+                        hashing.entity_bytes(v) if v is not None else b""
+                        for v in vals.values
+                    ],
+                    codes,
+                )
+            else:
+                if len(vals) != n:
+                    raise ValueError(
+                        f"tag {t.name}: {len(vals)} values for {n} rows"
+                    )
+                tag_bytes[t.name] = [
+                    hashing.entity_bytes(v) if v is not None else b""
+                    for v in vals
+                ]
+        for f in m.fields:
+            col = fields.get(f.name)
+            if col is not None and len(col) != n:
+                raise ValueError(
+                    f"field {f.name}: {len(col)} values for {n} rows"
+                )
+        if len(versions) != n:
+            raise ValueError(f"{len(versions)} versions for {n} rows")
+        for t in m.entity.tag_names:
+            if tag_bytes.get(t) is None:
+                # row-path strictness: a missing entity tag is a client
+                # error, not an empty value
+                raise KeyError(t)
 
         # --- series ids: hash each DISTINCT entity tuple once -------------
         ent_cols = [tag_bytes[t] for t in m.entity.tag_names]
-        ent_rows = np.empty(n, dtype=object)
-        for i in range(n):
-            ent_rows[i] = tuple(c[i] for c in ent_cols)
-        uniq, inv = np.unique(ent_rows, return_inverse=True)
-        uniq_sids = np.fromiter(
-            (hashing.series_id([name.encode(), *e]) for e in uniq),
-            dtype=np.int64,
-            count=len(uniq),
-        )
+        radix_prod = 1
+        for c in ent_cols:
+            if isinstance(c, DictColumn):
+                radix_prod *= max(len(c.values), 1)
+        if all(isinstance(c, DictColumn) for c in ent_cols) and (
+            radix_prod < 2**62  # int64 mixed-radix key must not wrap
+        ):
+            # all-encoded fast lane: distinct entities are distinct
+            # mixed-radix code keys — int unique, zero per-row Python
+            key = np.zeros(n, dtype=np.int64)
+            for c in ent_cols:
+                key = key * len(c.values) + np.asarray(c.codes, dtype=np.int64)
+            uk, inv = np.unique(key, return_inverse=True)
+            radices = [len(c.values) for c in ent_cols]
+            digits: list[np.ndarray] = []
+            rem = uk
+            for r in reversed(radices):
+                digits.append(rem % r)
+                rem = rem // r
+            digits.reverse()  # per-entity-tag unique codes aligned with uk
+            uniq_sids = np.fromiter(
+                (
+                    hashing.series_id(
+                        [name.encode()]
+                        + [
+                            ent_cols[j].values[int(digits[j][i])]
+                            for j in range(len(ent_cols))
+                        ]
+                    )
+                    for i in range(len(uk))
+                ),
+                dtype=np.int64,
+                count=len(uk),
+            )
+        else:
+            rowed = [
+                c.row_values() if isinstance(c, DictColumn) else c
+                for c in ent_cols
+            ]
+            ent_rows = np.empty(n, dtype=object)
+            for i in range(n):
+                ent_rows[i] = tuple(c[i] for c in rowed)
+            uniq, inv = np.unique(ent_rows, return_inverse=True)
+            uniq_sids = np.fromiter(
+                (hashing.series_id([name.encode(), *e]) for e in uniq),
+                dtype=np.int64,
+                count=len(uniq),
+            )
         sids = uniq_sids[inv]
         shards = sids % shard_num
 
@@ -223,6 +372,38 @@ class MeasureEngine:
 
         # --- route per (segment, shard) with boolean masks ----------------
         seg_starts = ts_millis - (ts_millis % iv_millis)
+        if m.index_mode:
+            # One index doc per point (handleIndexMode analog, same
+            # semantics as the row path): the inverted index takes docs
+            # one at a time, so the win here is upstream decode only.
+            # Index-mode rows never feed TopN (row-path parity).
+            for start in np.unique(seg_starts).tolist():
+                seg = seg_for(int(start))
+                for i in np.nonzero(seg_starts == start)[0].tolist():
+                    _index_mode_write(
+                        seg,
+                        m,
+                        int(sids[i]),
+                        int(ts_millis[i]),
+                        int(versions[i]),
+                        {
+                            t.name: (
+                                tag_bytes[t.name][i]
+                                if tag_bytes[t.name] is not None
+                                else b""
+                            )
+                            for t in m.tags
+                        },
+                        {
+                            f.name: (
+                                float(np.asarray(fields[f.name])[i])
+                                if fields.get(f.name) is not None
+                                else 0.0
+                            )
+                            for f in m.fields
+                        },
+                    )
+            return n
         for start in np.unique(seg_starts).tolist():
             seg = seg_for(int(start))
             seg_mask = seg_starts == start
@@ -238,10 +419,14 @@ class MeasureEngine:
             for shard_idx in np.unique(shards[seg_mask]).tolist():
                 mask = seg_mask & (shards == shard_idx)
                 idx = np.nonzero(mask)[0]
-                sel_tags = {
-                    t: ([tag_bytes[t][i] for i in idx] if tag_bytes[t] is not None else None)
-                    for t in tag_bytes
-                }
+                sel_tags = {}
+                for t, col in tag_bytes.items():
+                    if col is None:
+                        sel_tags[t] = None
+                    elif isinstance(col, DictColumn):
+                        sel_tags[t] = col.take(idx)
+                    else:
+                        sel_tags[t] = [col[i] for i in idx]
                 sel_fields = {}
                 for f in m.fields:
                     v = fields.get(f.name)
@@ -261,6 +446,7 @@ class MeasureEngine:
                         sel_fields,
                     )
                 )
+        self.topn.observe_columns(m, ts_millis, tags, fields)
         return n
 
     def ensure_result_measure(self, group: str) -> None:
@@ -413,12 +599,49 @@ class MeasureEngine:
                 series_ids = np.sort(
                     seg.series_index.search(And(tuple(clauses)))
                 )
+            # Row-level series filter companion to block pruning: blocks
+            # are 8192 rows, so a one-series query over small (young)
+            # parts still decodes ~everything — dropping non-candidate
+            # ROWS here shrinks the whole downstream pipeline (remap,
+            # dedup lexsort, device transfer, kernel) by the selectivity
+            # factor.  Hash digest keys the derived source for the
+            # serving cache (same parts + same series set => same rows).
+            sfilter_key = None
+            if series_ids is not None:
+                sfilter_key = hash(series_ids.tobytes())
+
+            def _series_rows(src: ColumnData, ckey) -> Optional[ColumnData]:
+                if series_ids is None:
+                    return src
+                keep = np.zeros(src.series.shape[0], dtype=bool)
+                if series_ids.size:
+                    pos = np.searchsorted(series_ids, src.series)
+                    pos[pos >= series_ids.size] = 0
+                    keep = series_ids[pos] == src.series
+                if not keep.any():
+                    return None
+                if keep.all():
+                    return src
+                return ColumnData(
+                    ts=src.ts[keep],
+                    series=src.series[keep],
+                    version=src.version[keep],
+                    tags={t: c[keep] for t, c in src.tags.items()},
+                    fields={f: v[keep] for f, v in src.fields.items()},
+                    dicts=src.dicts,
+                    cache_key=(
+                        (*ckey, "sfilter", sfilter_key) if ckey else None
+                    ),
+                )
+
             for shard_idx, shard in enumerate(seg.shards):
                 if shard_ids is not None and shard_idx not in shard_ids:
                     continue
                 mem_cols = shard.mem.columns_for(m.name)
                 if mem_cols is not None and mem_cols.ts.size:
-                    sources.append(mem_cols)
+                    mem_cols = _series_rows(mem_cols, mem_cols.cache_key)
+                    if mem_cols is not None:
+                        sources.append(mem_cols)
                 for part in shard.parts:
                     if part.meta.get("measure") != m.name:
                         continue
@@ -428,13 +651,14 @@ class MeasureEngine:
                         series_ids=series_ids,
                     )
                     if blocks:
-                        sources.append(
-                            part.read(
-                                blocks,
-                                tags=[t for t in tag_names if t in part.meta["tags"]],
-                                fields=[f for f in field_names if f in part.meta["fields"]],
-                            )
+                        src = part.read(
+                            blocks,
+                            tags=[t for t in tag_names if t in part.meta["tags"]],
+                            fields=[f for f in field_names if f in part.meta["fields"]],
                         )
+                        src = _series_rows(src, src.cache_key)
+                        if src is not None:
+                            sources.append(src)
         return sources
 
 

@@ -112,6 +112,111 @@ class TopNProcessorManager:
                 self._watermark[key] = p.ts_millis
             self._flush_closed(key, rule)
 
+    def observe_columns(self, m: Measure, ts_millis, tags, fields) -> None:
+        """Columnar twin of observe(): feed a bulk write's columns through
+        all TopN rules of its measure (closes the row-vs-bulk semantic
+        split, ref one-write-path banyand/measure/write_standalone.go:348).
+
+        Measures with no rules pay one registry scan and return; rule
+        accumulation matches observe() row-for-row (same window routing,
+        late-drop, counters bound, watermark and flush behavior)."""
+        import numpy as np
+
+        rules = [
+            r
+            for r in self.engine.registry.list_topn(m.group)
+            if r.source_measure == m.name
+        ]
+        if not rules:
+            return
+        ts = np.asarray(ts_millis, dtype=np.int64)
+        n = ts.shape[0]
+        if n == 0:
+            return
+
+        def as_str(v) -> str:
+            if v is None:
+                return ""
+            if isinstance(v, bytes):
+                return v.decode(errors="replace")
+            return str(v)
+
+        # batch-level decode, shared across rules (starts/ts once; tag
+        # string columns memoized per tag)
+        starts_all = (ts - (ts % self.window_millis)).tolist()
+        tsl = ts.tolist()
+        str_cols: dict[str, list] = {}
+
+        def col_of(t: str) -> list:
+            col = str_cols.get(t)
+            if col is None:
+                tv = tags.get(t)
+                if tv is None:
+                    col = [""] * n
+                elif hasattr(tv, "codes"):  # dictionary-encoded column
+                    sd = np.asarray(
+                        [as_str(v) for v in tv.values], dtype=object
+                    )
+                    col = sd[np.asarray(tv.codes)].tolist()
+                else:
+                    col = [as_str(v) for v in tv]
+                str_cols[t] = col
+            return col
+
+        for rule in rules:
+            key = (m.group, rule.name)
+            starts = starts_all
+            fvals = fields.get(rule.field_name)
+            fvals = (
+                np.asarray(fvals, dtype=np.float64).tolist()
+                if fvals is not None
+                else [0.0] * n
+            )
+            gtags = tuple(rule.group_by_tag_names) or (m.entity.tag_names[0],)
+            cols = [col_of(t) for t in gtags]
+            wins = self._windows[key]
+            wm = self._watermark.get(key, 0)
+            horizon = self.window_millis + self.lateness_millis
+            # windows close as the watermark advances THROUGH the batch
+            # (row-path parity: a late row after a mid-batch closure is
+            # dropped, not re-accumulated); track the earliest open
+            # window's close time so the flush check is O(1) per row
+            next_close = min((s + horizon for s in wins), default=None)
+            closed = self._closed_until.get(key, 0)
+            for i in range(n):
+                start = starts[i]
+                if start < closed:
+                    continue  # tumbling-window late-drop (see observe())
+                win = wins.get(start)
+                if win is None:
+                    win = wins[start] = _Window(start, {})
+                    close_at = start + horizon
+                    if next_close is None or close_at < next_close:
+                        next_close = close_at
+                ent = tuple(c[i] for c in cols)
+                acc = win.sums.get(ent)
+                if acc is None:
+                    if len(win.sums) >= rule.counters_number:
+                        continue  # bounded counters (heap-capacity analog)
+                    acc = win.sums[ent] = [0.0, 0]
+                acc[0] += fvals[i]
+                acc[1] += 1
+                if tsl[i] > wm:
+                    wm = tsl[i]
+                    self._watermark[key] = wm
+                # row-path parity: observe() runs _flush_closed after
+                # EVERY point, so a window already at-or-past the
+                # watermark's close boundary (late row into a window the
+                # watermark has overtaken) emits immediately and
+                # subsequent late rows drop — not only when wm advances
+                if next_close is not None and wm >= next_close:
+                    self._flush_closed(key, rule)
+                    closed = self._closed_until.get(key, 0)
+                    next_close = min(
+                        (s + horizon for s in wins), default=None
+                    )
+            self._watermark[key] = wm
+
     def _flush_closed(self, key: tuple, rule: TopNAggregation) -> None:
         wm = self._watermark.get(key, 0)
         closed = [

@@ -725,7 +725,11 @@ def _source_lut(
     if src.cache_key is None:
         with dict_state.lock:
             return gd.add_source(tag, list(src.dicts.get(tag, [])))
-    rk = (src.cache_key[1], tag)  # part dir fully identifies the dict
+    # (source identity, tag, dict length): part dicts are immutable, but
+    # memtable snapshots reuse one generation id while their dict grows
+    # append-only — the length pins WHICH prefix this LUT covers, so a
+    # grown dict gets a fresh (longer) LUT instead of a stale short one
+    rk = (src.cache_key[1], tag, len(src.dicts.get(tag, ())))
     with dict_state.lock:
         if dict_state.dicts is not gd:
             # state was reset mid-query: codes from the old gd must not

@@ -15,6 +15,11 @@ import numpy as np
 
 from banyandb_tpu.storage.part import ColumnData
 
+# Monotonic memtable generation counter (itertools.count is GIL-atomic).
+import itertools as _itertools
+
+_MEM_GEN = _itertools.count(1)
+
 
 class PayloadMemtable:
     """Shard memtable keyed by resource name, for payload-bearing engines
@@ -66,6 +71,10 @@ class MemTable:
         self._dicts: dict[str, dict[bytes, int]] = {t: {} for t in tag_names}
         self._fields: dict[str, list[float]] = {f: [] for f in field_names}
         self._payloads: list[bytes] | None = [] if with_payload else None
+        self._snapshot_cache: tuple[int, ColumnData] | None = None
+        # process-unique generation: id() would recycle after GC and
+        # alias a new table's cache_key onto a dead one's cached rows
+        self._gen = next(_MEM_GEN)
 
     def __len__(self) -> int:
         return len(self._ts)
@@ -104,8 +113,11 @@ class MemTable:
     ) -> None:
         """Vectorized append: columns land in one extend per column.
 
-        tag_values: per-tag list[bytes] of row values (interned here via
-        np.unique so each distinct value hits the dict once).
+        tag_values: per-tag row values — either list[bytes] (interned
+        here via np.unique so each distinct value hits the dict once) or
+        an already dictionary-encoded column (duck-typed: has .values +
+        .codes, models.measure.DictColumn) whose dict remaps straight
+        into this table's dict — zero per-row Python.
         """
         n = len(ts_millis)
         with self._lock:
@@ -118,6 +130,16 @@ class MemTable:
                 if vals is None:
                     code = d.setdefault(b"", len(d))
                     self._tag_codes[t].extend([code] * n)
+                    continue
+                if hasattr(vals, "codes"):  # dictionary-encoded column
+                    lut = np.fromiter(
+                        (d.setdefault(v, len(d)) for v in vals.values),
+                        dtype=np.int64,
+                        count=len(vals.values),
+                    )
+                    self._tag_codes[t].extend(
+                        lut[np.asarray(vals.codes, dtype=np.int64)].tolist()
+                    )
                     continue
                 arr = np.asarray(vals, dtype=object)
                 uniq, inv = np.unique(arr, return_inverse=True)
@@ -143,9 +165,21 @@ class MemTable:
         return [("", self.snapshot_columns(), {})]
 
     def snapshot_columns(self) -> ColumnData:
-        """Columnar view of the buffered rows (for hot-data queries/flush)."""
+        """Columnar view of the buffered rows (for hot-data queries/flush).
+
+        Cached per row count: the table is append-only between drains, so
+        a snapshot stays valid until the next append — under sustained
+        mixed load queries outnumber batches and reuse one materialized
+        copy instead of converting every list per query.  The cache_key
+        ("mem", id, count) is an honest immutable identity for the same
+        reason, letting the serving-cache layers treat a quiet memtable
+        like a part."""
         with self._lock:
-            return ColumnData(
+            n = len(self._ts)
+            cached = self._snapshot_cache
+            if cached is not None and cached[0] == n:
+                return cached[1]
+            snap = ColumnData(
                 ts=np.asarray(self._ts, dtype=np.int64),
                 series=np.asarray(self._series, dtype=np.int64),
                 version=np.asarray(self._version, dtype=np.int64),
@@ -162,4 +196,7 @@ class MemTable:
                     for t in self.tag_names
                 },
                 payloads=list(self._payloads) if self._payloads is not None else None,
+                cache_key=("mem", self._gen, n),
             )
+            self._snapshot_cache = (n, snap)
+            return snap

@@ -46,8 +46,14 @@ def run_load(
     queriers: int = 4,
     batch: int = 500,
     seed: int = 0,
+    write_rate: int = 0,
     tmp_root: str | None = None,
 ) -> dict:
+    """write_rate: total sustained ingest points/s across all writers
+    (0 = closed loop, writers go as fast as the core allows).  The
+    reference's published query latencies are measured at a FIXED ingest
+    rate (~9.5k points/s, benchmark-single-model.md:96) — a closed loop
+    on a shared core measures writer throughput, not query SLO."""
     import tempfile
 
     from banyandb_tpu.cluster.rpc import GrpcTransport
@@ -86,6 +92,7 @@ def run_load(
         return _drive_load(
             call, seconds=seconds, writers=writers,
             queriers=queriers, batch=batch, seed=seed,
+            write_rate=write_rate,
         )
     finally:
         srv.stop()
@@ -95,7 +102,9 @@ def run_load(
             shutil.rmtree(root, ignore_errors=True)
 
 
-def _drive_load(call, *, seconds, writers, queriers, batch, seed) -> dict:
+def _drive_load(
+    call, *, seconds, writers, queriers, batch, seed, write_rate=0
+) -> dict:
     from banyandb_tpu.cluster.bus import Topic
     from banyandb_tpu.cluster.rpc import GrpcTransport
     from banyandb_tpu.server import TOPIC_QL
@@ -107,30 +116,76 @@ def _drive_load(call, *, seconds, writers, queriers, batch, seed) -> dict:
     q_errors = [0] * queriers
     clock0 = time.time()
 
+    import base64
+
+    svc_dict = [f"s{i}" for i in range(50)]
+    region_dict = [f"r{i}" for i in range(3)]
+    status_dict = [200, 404, 500]
+
     def writer(wid: int):
         rng = np.random.default_rng(seed + wid)
         t = GrpcTransport()
+        lane_rate = write_rate / writers if write_rate else 0
+        t_start = time.monotonic()
         try:
             while not stop.is_set():
-                pts = [
-                    {
-                        # disjoint per-writer timestamp lanes: stride by
-                        # writer count so no two writers ever collide on
-                        # (series, ts) and silently overwrite each other
-                        "ts": T0 + ((written[wid] + j) * writers + wid) * 10,
-                        "tags": {
-                            "svc": f"s{rng.integers(0, 50)}",
-                            "region": f"r{rng.integers(0, 3)}",
-                            "status": int((200, 404, 500)[rng.integers(0, 3)]),
+                if lane_rate:
+                    # token-bucket pacing: sleep until this lane's next
+                    # batch is due at the configured points/s
+                    due = t_start + written[wid] / lane_rate
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        if stop.wait(min(delay, 0.5)):
+                            break
+                        continue
+                # disjoint per-writer timestamp lanes: stride by writer
+                # count so no two writers ever collide on (series, ts)
+                # and silently overwrite each other
+                ts = (
+                    T0
+                    + ((written[wid] + np.arange(batch, dtype=np.int64))
+                       * writers + wid) * 10
+                )
+                env = {
+                    "group": GROUP, "name": MEASURE,
+                    "ts": base64.b64encode(
+                        ts.astype("<i8").tobytes()
+                    ).decode(),
+                    "versions": base64.b64encode(
+                        np.ones(batch, dtype="<i8").tobytes()
+                    ).decode(),
+                    "tags": {
+                        "svc": {
+                            "dict": svc_dict,
+                            "codes": base64.b64encode(
+                                rng.integers(0, 50, batch, dtype=np.int32)
+                                .astype("<i4").tobytes()
+                            ).decode(),
                         },
-                        "fields": {"value": float(rng.integers(0, 1000))},
-                        "version": 1,
-                    }
-                    for j in range(batch)
-                ]
+                        "region": {
+                            "dict": region_dict,
+                            "codes": base64.b64encode(
+                                rng.integers(0, 3, batch, dtype=np.int32)
+                                .astype("<i4").tobytes()
+                            ).decode(),
+                        },
+                        "status": {
+                            "dict": status_dict,
+                            "codes": base64.b64encode(
+                                rng.integers(0, 3, batch, dtype=np.int32)
+                                .astype("<i4").tobytes()
+                            ).decode(),
+                        },
+                    },
+                    "fields": {
+                        "value": base64.b64encode(
+                            rng.integers(0, 1000, batch)
+                            .astype("<f8").tobytes()
+                        ).decode(),
+                    },
+                }
                 try:
-                    call(t, Topic.MEASURE_WRITE.value,
-                         {"request": {"group": GROUP, "name": MEASURE, "points": pts}})
+                    call(t, Topic.MEASURE_WRITE_COLUMNS.value, env)
                     written[wid] += batch
                 except Exception:  # noqa: BLE001 - keep load flowing
                     write_errors[wid] += 1
@@ -145,14 +200,29 @@ def _drive_load(call, *, seconds, writers, queriers, batch, seed) -> dict:
         try:
             while not stop.is_set():
                 agg = AGGS[rng.integers(0, len(AGGS))]
-                where = (
-                    f"WHERE region = 'r{rng.integers(0, 3)}' "
-                    if rng.integers(0, 2) else ""
-                )
-                group_by = "GROUP BY svc " if rng.integers(0, 2) else ""
+                # Trailing event-time window (the reference benchmark's
+                # query shape: trailing 15 minutes during sustained
+                # ingest, benchmark-single-model.md:104): high-water
+                # mark from the writers' lane clocks, quantized to 1s
+                # ticks the way dashboard refresh cycles are.
+                hw = T0 + (max(written) * writers * 10) // 1000 * 1000
+                lo = max(T0, hw - 900_000)
+                if rng.integers(0, 4) < 3:
+                    # per-entity metric read (the OAP access pattern the
+                    # reference benchmark measures: one service's metric
+                    # over the window, series-index pruned)
+                    where = f"WHERE svc = 's{rng.integers(0, 50)}' "
+                    group_by = ""
+                else:
+                    # dashboard aggregation across all services
+                    where = (
+                        f"WHERE region = 'r{rng.integers(0, 3)}' "
+                        if rng.integers(0, 2) else ""
+                    )
+                    group_by = "GROUP BY svc "
                 ql = (
                     f"SELECT {agg}(value) FROM MEASURE {MEASURE} IN {GROUP} "
-                    f"TIME BETWEEN {T0} AND {T0 + 10_000_000_000} "
+                    f"TIME BETWEEN {lo} AND {hw} "
                     f"{where}{group_by}LIMIT 100"
                 )
                 t0 = time.perf_counter()
@@ -206,12 +276,17 @@ def main(argv=None) -> int:
     ap.add_argument("--queriers", type=int, default=4)
     ap.add_argument("--batch", type=int, default=500)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--write-rate", type=int, default=0,
+        help="total ingest points/s across writers (0 = closed loop)",
+    )
     ap.add_argument("--min-writes-per-min", type=int, default=0)
     ap.add_argument("--max-p99-ms", type=float, default=0.0)
     args = ap.parse_args(argv)
     stats = run_load(
         seconds=args.seconds, writers=args.writers,
         queriers=args.queriers, batch=args.batch, seed=args.seed,
+        write_rate=args.write_rate,
     )
     slo_fail = []
     if args.min_writes_per_min and stats["write_points_per_min"] < args.min_writes_per_min:

@@ -152,3 +152,286 @@ def test_bulk_throughput_sanity(tmp_path):
     r = eng.query(QueryRequest(("g",), "m", TimeRange(T0, T0 + n),
                                agg=Aggregation("count", "v")))
     assert r.values["count"][0] == n
+
+
+def _topn_engine(tmp_path, sub):
+    from banyandb_tpu.api.schema import TopNAggregation
+
+    reg = SchemaRegistry(tmp_path / sub)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+    reg.create_measure(
+        Measure("g", "m",
+                (TagSpec("svc", TagType.STRING), TagSpec("region", TagType.STRING)),
+                (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)))
+    )
+    reg.create_topn(TopNAggregation(
+        group="g", name="top_svc", source_measure="m",
+        field_name="v", group_by_tag_names=("svc",),
+        counters_number=100, field_value_sort="desc",
+    ))
+    return MeasureEngine(reg, tmp_path / sub / "data")
+
+
+def test_bulk_topn_parity_with_row_path(tmp_path):
+    """VERDICT r4 missing #3: bulk writes feed TopN pre-aggregation with
+    the same window/watermark semantics as per-point writes."""
+    from banyandb_tpu.models import topn as topn_mod
+
+    n = 5000
+    rng = np.random.default_rng(9)
+    svc = [f"s{i}" for i in rng.integers(0, 12, n)]
+    region = [f"r{i}" for i in rng.integers(0, 3, n)]
+    vals = rng.gamma(2.0, 30.0, n)
+    ts = T0 + np.arange(n) * 50  # spans several 60s windows
+
+    row_eng = _topn_engine(tmp_path, "row")
+    row_eng.write(WriteRequest("g", "m", tuple(
+        DataPointValue(int(ts[i]), {"svc": svc[i], "region": region[i]},
+                       {"v": float(vals[i])}, version=1)
+        for i in range(n)
+    )))
+    bulk_eng = _topn_engine(tmp_path, "bulk")
+    # split into several batches like a wire stream would
+    for lo in range(0, n, 1300):
+        hi = min(lo + 1300, n)
+        bulk_eng.write_columns(
+            "g", "m",
+            ts_millis=ts[lo:hi],
+            tags={"svc": svc[lo:hi], "region": region[lo:hi]},
+            fields={"v": vals[lo:hi]},
+            versions=np.ones(hi - lo, dtype=np.int64),
+        )
+    for eng in (row_eng, bulk_eng):
+        eng.topn.flush_all_windows()
+        eng.flush()
+    tr = TimeRange(T0, T0 + n * 50 + 1)
+    got_row = topn_mod.query_topn(row_eng, "g", "top_svc", tr, n=5)
+    got_bulk = topn_mod.query_topn(bulk_eng, "g", "top_svc", tr, n=5)
+    assert got_row == got_bulk
+    assert len(got_row) == 5
+
+
+def test_bulk_index_mode_parity(tmp_path):
+    """Bulk path handles index-mode measures (was NotImplementedError)."""
+    def mk(sub):
+        reg = SchemaRegistry(tmp_path / sub)
+        reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=2)))
+        reg.create_measure(
+            Measure("g", "im",
+                    (TagSpec("svc", TagType.STRING), TagSpec("region", TagType.STRING)),
+                    (FieldSpec("v", FieldType.FLOAT),), Entity(("svc",)),
+                    index_mode=True)
+        )
+        return MeasureEngine(reg, tmp_path / sub / "data")
+
+    n = 800
+    rng = np.random.default_rng(4)
+    svc = [f"s{i}" for i in rng.integers(0, 10, n)]
+    region = [f"r{i}" for i in rng.integers(0, 3, n)]
+    vals = rng.gamma(2.0, 30.0, n)
+    ts = T0 + np.arange(n)
+
+    row_eng = mk("rowim")
+    row_eng.write(WriteRequest("g", "im", tuple(
+        DataPointValue(int(ts[i]), {"svc": svc[i], "region": region[i]},
+                       {"v": float(vals[i])}, version=1)
+        for i in range(n)
+    )))
+    bulk_eng = mk("bulkim")
+    bulk_eng.write_columns(
+        "g", "im",
+        ts_millis=ts,
+        tags={"svc": svc, "region": region},
+        fields={"v": vals},
+        versions=np.ones(n, dtype=np.int64),
+    )
+    req = QueryRequest(
+        groups=("g",), name="im", time_range=TimeRange(T0, T0 + n + 1),
+        group_by=GroupBy(("svc",)), agg=Aggregation("sum", "v"), limit=0,
+    )
+    r1, r2 = row_eng.query(req), bulk_eng.query(req)
+    assert r1.groups == r2.groups
+    assert np.allclose(r1.values["sum(v)"], r2.values["sum(v)"])
+
+
+def test_write_points_bulk_matches_write(tmp_path):
+    """The wire bridge (row-shaped request -> columns) is write()-equal."""
+    n = 1500
+    rng = np.random.default_rng(5)
+    pts = tuple(
+        DataPointValue(
+            int(T0 + i),
+            {"svc": f"s{rng.integers(0, 15)}", "region": f"r{rng.integers(0, 3)}"},
+            {"v": float(rng.gamma(2.0, 30.0))},
+            version=1,
+        )
+        for i in range(n)
+    )
+    a = _engine(tmp_path, "wr_row")
+    a.write(WriteRequest("g", "m", pts))
+    b = _engine(tmp_path, "wr_bulk")
+    b.write_points_bulk(WriteRequest("g", "m", pts))
+    req = QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + n + 1),
+        group_by=GroupBy(("svc", "region")), agg=Aggregation("sum", "v"),
+        limit=0,
+    )
+    r1, r2 = a.query(req), b.query(req)
+    assert r1.groups == r2.groups
+    assert np.allclose(r1.values["sum(v)"], r2.values["sum(v)"])
+    assert np.allclose(r1.values["count"], r2.values["count"])
+
+    # missing entity tag raises like the row path
+    import pytest as _pytest
+    bad = (DataPointValue(T0, {"region": "r0"}, {"v": 1.0}, version=1),)
+    with _pytest.raises(KeyError):
+        b.write_points_bulk(WriteRequest("g", "m", bad))
+
+
+def test_dict_column_ingest_parity(tmp_path):
+    """Dictionary-encoded tag columns (the wire's columnar envelope form)
+    land identically to plain value lists."""
+    from banyandb_tpu.models.measure import DictColumn
+
+    n = 3000
+    rng = np.random.default_rng(12)
+    svc_codes = rng.integers(0, 20, n).astype(np.int32)
+    region_codes = rng.integers(0, 3, n).astype(np.int32)
+    svc_dict = [f"s{i}" for i in range(20)]
+    region_dict = [f"r{i}" for i in range(3)]
+    vals = rng.gamma(2.0, 30.0, n)
+    ts = T0 + np.arange(n)
+
+    plain = _engine(tmp_path, "plain")
+    plain.write_columns(
+        "g", "m",
+        ts_millis=ts,
+        tags={"svc": [svc_dict[c] for c in svc_codes],
+              "region": [region_dict[c] for c in region_codes]},
+        fields={"v": vals},
+        versions=np.ones(n, dtype=np.int64),
+    )
+    enc = _engine(tmp_path, "enc")
+    enc.write_columns(
+        "g", "m",
+        ts_millis=ts,
+        tags={"svc": DictColumn(svc_dict, svc_codes),
+              "region": DictColumn(region_dict, region_codes)},
+        fields={"v": vals},
+        versions=np.ones(n, dtype=np.int64),
+    )
+    for eng in (plain, enc):
+        eng.flush()
+    req = QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + n + 1),
+        group_by=GroupBy(("svc", "region")), agg=Aggregation("sum", "v"),
+        limit=0,
+    )
+    r1, r2 = plain.query(req), enc.query(req)
+    assert r1.groups == r2.groups
+    assert np.allclose(r1.values["sum(v)"], r2.values["sum(v)"])
+    assert np.allclose(r1.values["count"], r2.values["count"])
+
+
+def test_memtable_new_tag_value_between_queries(tmp_path):
+    """Regression: the memtable snapshot carries a cache_key whose
+    generation persists while its tag dict grows — the remap LUT must
+    re-key on dict length or the second query IndexErrors."""
+    eng = _engine(tmp_path, "grow")
+    ts = T0 + np.arange(100)
+
+    def batch(svc_vals):
+        eng.write_columns(
+            "g", "m",
+            ts_millis=ts + batch.n * 1000,
+            tags={"svc": svc_vals, "region": ["r0"] * 100},
+            fields={"v": np.ones(100)},
+            versions=np.ones(100, dtype=np.int64),
+        )
+        batch.n += 1
+    batch.n = 0
+
+    req = QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10_000_000),
+        group_by=GroupBy(("svc",)), agg=Aggregation("sum", "v"), limit=0,
+    )
+    batch(["a"] * 100)
+    r1 = eng.query(req)
+    assert [g[0] for g in r1.groups] == ["a"]
+    batch(["b"] * 100)  # NEW distinct value lands in the same memtable
+    r2 = eng.query(req)
+    assert [g[0] for g in r2.groups] == ["a", "b"]
+    assert r2.values["sum(v)"] == [100.0, 100.0]
+
+
+def test_observe_columns_late_window_flush_parity(tmp_path):
+    """Regression: a late row into a window the watermark already
+    overtook must emit immediately then drop followers (row-path
+    parity), not keep accumulating."""
+    from banyandb_tpu.api.model import DataPointValue
+    from banyandb_tpu.models import topn as topn_mod
+
+    row_eng = _topn_engine(tmp_path, "lrow")
+    bulk_eng = _topn_engine(tmp_path, "lbulk")
+    W = 60_000
+    # advance watermark far past window 0, then send two late rows at
+    # ts inside window 0
+    seq = [(2 * W + 5, "s1", 1.0), (10_000, "s2", 5.0), (11_000, "s2", 7.0)]
+    row_eng.write(WriteRequest("g", "m", tuple(
+        DataPointValue(T0 // W * W + t, {"svc": s, "region": "r0"},
+                       {"v": v}, version=1)
+        for t, s, v in seq
+    )))
+    base = T0 // W * W
+    bulk_eng.write_columns(
+        "g", "m",
+        ts_millis=np.asarray([base + t for t, _, _ in seq], dtype=np.int64),
+        tags={"svc": [s for _, s, _ in seq], "region": ["r0"] * 3},
+        fields={"v": np.asarray([v for _, _, v in seq])},
+        versions=np.ones(3, dtype=np.int64),
+    )
+    for eng in (row_eng, bulk_eng):
+        eng.topn.flush_all_windows()
+        eng.flush()
+    tr = TimeRange(base - W, base + 4 * W)
+    got_row = topn_mod.query_topn(row_eng, "g", "top_svc", tr, n=5)
+    got_bulk = topn_mod.query_topn(bulk_eng, "g", "top_svc", tr, n=5)
+    assert got_row == got_bulk
+
+
+def test_write_columns_validates_wire_columns(tmp_path):
+    """Ragged or out-of-range columnar envelopes are rejected before any
+    row lands (a half-applied batch would corrupt the memtable)."""
+    from banyandb_tpu.models.measure import DictColumn
+
+    eng = _engine(tmp_path, "val")
+    ts = T0 + np.arange(10)
+    ones = np.ones(10, dtype=np.int64)
+    with pytest.raises(ValueError):  # ragged tag column
+        eng.write_columns("g", "m", ts_millis=ts,
+                          tags={"svc": ["a"] * 9, "region": ["r"] * 10},
+                          fields={"v": np.ones(10)}, versions=ones)
+    with pytest.raises(ValueError):  # code out of dict range
+        eng.write_columns("g", "m", ts_millis=ts,
+                          tags={"svc": DictColumn(["a"], np.full(10, 5, np.int32)),
+                                "region": ["r"] * 10},
+                          fields={"v": np.ones(10)}, versions=ones)
+    with pytest.raises(ValueError):  # negative code
+        eng.write_columns("g", "m", ts_millis=ts,
+                          tags={"svc": DictColumn(["a"], np.full(10, -1, np.int32)),
+                                "region": ["r"] * 10},
+                          fields={"v": np.ones(10)}, versions=ones)
+    with pytest.raises(ValueError):  # ragged field
+        eng.write_columns("g", "m", ts_millis=ts,
+                          tags={"svc": ["a"] * 10, "region": ["r"] * 10},
+                          fields={"v": np.ones(9)}, versions=ones)
+    with pytest.raises(KeyError):  # missing entity tag column
+        eng.write_columns("g", "m", ts_millis=ts,
+                          tags={"region": ["r"] * 10},
+                          fields={"v": np.ones(10)}, versions=ones)
+    # a valid write still lands
+    assert eng.write_columns(
+        "g", "m", ts_millis=ts,
+        tags={"svc": ["a"] * 10, "region": ["r"] * 10},
+        fields={"v": np.ones(10)}, versions=ones,
+    ) == 10
