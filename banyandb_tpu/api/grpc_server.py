@@ -243,13 +243,91 @@ class WireServices:
     def measure_query(self, req, context):
         try:
             ireq = wire.measure_query_to_internal(req)
+            if len(ireq.groups) > 1:
+                return self._measure_query_multi_group(ireq)
             group = self._one_group(ireq)
             m = self.registry.get_measure(group, ireq.name)
+            # projection names are schema errors, not silent drops
+            # (ref WantErr project_non_existent_{tag,field} cases)
+            for t in ireq.tag_projection:
+                m.tag(t)
+            for f in ireq.field_projection:
+                m.field(f)
             ireq = self._resolve_order(group, ireq)
             res = self.measure.query(ireq)
             return wire.measure_result_to_pb(m, ireq, res)
         except Exception as e:  # noqa: BLE001 - mapped to gRPC status
             _abort(context, e)
+
+    def _measure_query_multi_group(self, ireq):
+        """Cross-group union (ref pkg/query/logical/measure/
+        cross_group_merge.go): run the query per group against that
+        group's OWN schema revision (tag/field sets may differ across
+        groups — that is the feature's point), merge data points by
+        timestamp in the requested time order."""
+        import dataclasses as _dc
+
+        merged = None
+        for group in ireq.groups:
+            m = self.registry.get_measure(group, ireq.name)
+            known_tags = {t.name for t in m.tags}
+            known_fields = {f.name for f in m.fields}
+            sub = _dc.replace(
+                ireq,
+                groups=(group,),
+                offset=0,  # offset applies ONCE, on the merged stream
+                tag_projection=tuple(
+                    t for t in ireq.tag_projection if t in known_tags
+                ),
+                tag_families_projection=tuple(
+                    (fam, tuple(t for t in tags if t in known_tags))
+                    for fam, tags in ireq.tag_families_projection
+                ),
+                field_projection=tuple(
+                    f for f in ireq.field_projection if f in known_fields
+                ),
+            )
+            sub = self._resolve_order(group, sub)
+            out = wire.measure_result_to_pb(
+                m, sub, self.measure.query(sub)
+            )
+            # union projection: rows from a group lacking a projected
+            # tag/field carry an explicit null in projection position
+            # (ref cross-group merge emits the merged schema)
+            for dp in out.data_points:
+                for (fam_name, fam_tags), fam in zip(
+                    ireq.tag_families_projection
+                    or (("default", ireq.tag_projection),),
+                    dp.tag_families,
+                ):
+                    have = [t.key for t in fam.tags]
+                    for pos, tname in enumerate(fam_tags):
+                        if tname not in have:
+                            tag = pb.model_query_pb2.Tag(key=tname)
+                            tag.value.null = 0
+                            fam.tags.insert(pos, tag)
+                            have.insert(pos, tname)
+                have_f = [f.name for f in dp.fields]
+                for pos, fname in enumerate(ireq.field_projection):
+                    if fname not in have_f:
+                        fv = pb.measure_query_pb2.DataPoint.Field(name=fname)
+                        fv.value.null = 0
+                        dp.fields.insert(pos, fv)
+                        have_f.insert(pos, fname)
+            if merged is None:
+                merged = out
+            else:
+                merged.data_points.extend(out.data_points)
+        desc = ireq.order_by_ts == "desc"
+        pts = sorted(
+            merged.data_points,
+            key=lambda dp: (dp.timestamp.seconds, dp.timestamp.nanos),
+            reverse=desc,
+        )
+        off = ireq.offset or 0
+        del merged.data_points[:]
+        merged.data_points.extend(pts[off : off + (ireq.limit or 100)])
+        return merged
 
     _WRITE_BATCH = 256
 

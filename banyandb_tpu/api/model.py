@@ -30,6 +30,11 @@ class Condition:
     name: str
     op: str  # eq | ne | lt | le | gt | ge | in | not_in | having | match
     value: object
+    # MATCH options (model/v1 Condition.MatchOption): "and" requires
+    # every analyzed query token to hit; analyzer overrides the index
+    # rule's analyzer
+    match_op: str = "or"
+    match_analyzer: str = ""
 
 
 @dataclass(frozen=True)
@@ -86,6 +91,11 @@ class QueryRequest:
     order_by_dir: str = "asc"  # asc | desc (applies to order_by_tag)
     trace: bool = False  # in-band query tracing
     stages: tuple[str, ...] = ()
+    # family-structured tag projection from the wire ((family, (tags..)),
+    # ...): responses group projected tags under the REQUESTED family
+    # names (a measure can declare non-"default" families, e.g.
+    # storage_only in service_latency_minute)
+    tag_families_projection: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -113,4 +123,9 @@ class QueryResult:
     groups: list[tuple] = field(default_factory=list)
     values: dict[str, list] = field(default_factory=dict)
     data_points: list[dict] = field(default_factory=list)
+    # representative values for projected-but-not-grouped tags: each
+    # grouped output row carries the tag values of the group's FIRST row
+    # in scan order (the reference's aggGroupIterator copies the first
+    # fed data point's TagFamilies, measure_plan_aggregation.go:286)
+    rep_tags: dict[str, list] = field(default_factory=dict)
     trace: Optional[dict] = None

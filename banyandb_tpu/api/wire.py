@@ -168,8 +168,19 @@ def criteria_to_internal(c) -> Optional[im.Criteria]:
         op = _COND_OP.get(cond.op, "eq")
         val = tag_value_to_py(cond.value)
         if op in ("in", "not_in") and not isinstance(val, (list, tuple)):
-            val = [val]
-        return im.Condition(cond.name, op, val)
+            # ref rejects IN/NOT_IN with a scalar literal (the array
+            # oneof is mandatory; WantErr gen_err_in_scalar)
+            raise ValueError(f"{op.upper()} requires an array value")
+        match_op = "or"
+        match_analyzer = ""
+        if cond.HasField("match_option"):
+            if cond.match_option.operator == 1:  # OPERATOR_AND
+                match_op = "and"
+            match_analyzer = cond.match_option.analyzer
+        return im.Condition(
+            cond.name, op, val,
+            match_op=match_op, match_analyzer=match_analyzer,
+        )
     le = c.le
     op = "and" if le.op == 1 else "or"
     return im.LogicalExpression(
@@ -227,6 +238,10 @@ def measure_query_to_internal(req) -> im.QueryRequest:
         else im.TimeRange(0, 1 << 62),
         criteria=criteria_to_internal(req.criteria) if req.HasField("criteria") else None,
         tag_projection=_flatten_projection(req.tag_projection),
+        tag_families_projection=tuple(
+            (fam.name, tuple(fam.tags))
+            for fam in req.tag_projection.tag_families
+        ),
         field_projection=tuple(req.field_projection.names),
         group_by=group_by,
         agg=agg,
@@ -279,26 +294,51 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
             agg_field = req.agg.field_name or "value"
             if fn == "count":
                 agg_key = "count"
-                agg_int = True
             elif fn == "percentile":
                 agg_key = f"percentile({agg_field})"
             else:
                 agg_key = f"{fn}({agg_field})"
             try:
-                agg_int = agg_int or (
+                # the output field is typed like the AGGREGATED FIELD —
+                # including count (count over a float field emits float,
+                # want/float_top_count.yaml)
+                agg_int = (
                     fn != "percentile"
                     and measure.field(agg_field).type.name == "INT"
                 )
             except (KeyError, AttributeError):
-                pass
+                agg_int = fn == "count"
+        # Tags emit in PROJECTION order under the REQUESTED family names:
+        # group-key values from the group tuple, other projected tags
+        # from the representative (first scanned) row (reference
+        # aggregation keeps the first fed row's TagFamilies).  Without an
+        # explicit projection, group tags under "default".
+        fam_specs = req.tag_families_projection or (
+            ("default", tuple(req.tag_projection or group_tags)),
+        )
         for i, g in enumerate(res.groups):
+            by_name = dict(zip(group_tags, g))
             dp = out.data_points.add()
-            fam = dp.tag_families.add(name="default")
-            for t, v in zip(group_tags, g):
-                tag = fam.tags.add(key=t)
-                tag.value.CopyFrom(
-                    py_to_tag_value(v, measure.tag(t).type if _has_tag(measure, t) else None)
-                )
+            for fam_name, fam_tags in fam_specs:
+                fam = dp.tag_families.add(name=fam_name)
+                for t in fam_tags:
+                    if t not in by_name and t not in res.rep_tags:
+                        continue
+                    v = (
+                        by_name[t]
+                        if t in by_name
+                        else res.rep_tags[t][i]
+                        if i < len(res.rep_tags.get(t, ()))
+                        else None
+                    )
+                    tag = fam.tags.add(key=t)
+                    tag.value.CopyFrom(
+                        py_to_tag_value(v, measure.tag(t).type if _has_tag(measure, t) else None)
+                    )
+            if req.agg is None:
+                # groupBy without aggregation: distinct groups, no
+                # fields (want/group_no_field.yaml)
+                continue
             if agg_key is not None:
                 vals = res.values.get(agg_key, ())
                 v = vals[i] if i < len(vals) else None
@@ -337,9 +377,12 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
         dp = out.data_points.add()
         dp.timestamp.CopyFrom(millis_to_ts(row["timestamp"]))
         tags = row.get("tags", {})
-        if tag_proj:
-            fam = dp.tag_families.add(name="default")
-            for t in tag_proj:
+        fam_specs = req.tag_families_projection or (
+            (("default", tag_proj),) if tag_proj else ()
+        )
+        for fam_name, fam_tags in fam_specs:
+            fam = dp.tag_families.add(name=fam_name)
+            for t in fam_tags:
                 if t not in tags:
                     continue
                 tag = fam.tags.add(key=t)

@@ -106,6 +106,13 @@ class DataNode:
             ),
         )
         self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
+        # operator flush surface (data-node SnapshotService analog):
+        # persists memtables to parts on demand — ops tooling and tests
+        # use it to bound the direct-write plane's crash-loss window
+        self.bus.subscribe(
+            "flush",
+            lambda env: {"parts": self.measure.flush(env.get("group"))},
+        )
         # per-node FODC agent surface polled by the proxy (admin/fodc.py)
         from banyandb_tpu.admin.diagnostics import DIAG_TOPIC
 

@@ -277,7 +277,7 @@ def partials_to_json(p: Partials) -> dict:
         arrays.append(np.ascontiguousarray(p.hist, dtype="<f8").ravel())
     frame = b"".join(a.tobytes() for a in arrays)
     flat_groups = [v for g in p.groups for v in g]
-    return {
+    out = {
         "v": 2,
         "group_tags": list(p.group_tags),
         "k": len(p.groups),
@@ -290,6 +290,20 @@ def partials_to_json(p: Partials) -> dict:
         "hist_span": p.hist_span,
         "field_stats": {f: list(v) for f, v in p.field_stats.items()},
     }
+    if p.rep_key is not None:
+        # [K,2] (ts,row) scan-order keys + representative tag values
+        # (optional section; pre-rep peers ignore it and lose only
+        # ordering/rep)
+        out["rep_key"] = _b64(
+            np.ascontiguousarray(p.rep_key, dtype="<i8").tobytes()
+        )
+        out["rep_desc"] = bool(p.rep_desc)
+        if p.rep_vals is not None:
+            out["rep_vals"] = {
+                t: _b64(enc.encode_strings([v or b"" for v in vals]))
+                for t, vals in p.rep_vals.items()
+            }
+    return out
 
 
 def partials_from_json(d: dict) -> Partials:
@@ -329,6 +343,19 @@ def partials_from_json(d: dict) -> Partials:
             f"partials frame length mismatch: expected {off} f64s "
             f"(k={k}, fields={nf}), got {buf.size}"
         )
+    rep_key = None
+    rep_vals = None
+    if d.get("rep_key") is not None:
+        rep_key = (
+            np.frombuffer(_unb64(d["rep_key"]), dtype="<i8")
+            .reshape(-1, 2)
+            .copy()
+        )
+        if d.get("rep_vals"):
+            rep_vals = {
+                t: enc.decode_strings(_unb64(b))
+                for t, b in d["rep_vals"].items()
+            }
     return Partials(
         group_tags=tuple(d["group_tags"]),
         groups=groups,
@@ -340,6 +367,9 @@ def partials_from_json(d: dict) -> Partials:
         hist_lo=d["hist_lo"],
         hist_span=d["hist_span"],
         field_stats={f: tuple(v) for f, v in d.get("field_stats", {}).items()},
+        rep_key=rep_key,
+        rep_desc=bool(d.get("rep_desc")),
+        rep_vals=rep_vals,
     )
 
 

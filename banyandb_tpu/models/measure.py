@@ -33,6 +33,60 @@ from banyandb_tpu.storage.tsdb import TSDB
 from banyandb_tpu.utils import hashing
 
 
+_RAW_FIELD_TYPES = (FieldType.STRING, FieldType.DATA_BINARY)
+_RAW_FIELD_PREFIX = "@f:"
+
+# Server-assigned write versions are MONOTONIC per process (the
+# reference assigns nanosecond timestamps per point): two writes of the
+# same (series, ts) must resolve to the later one, even within one
+# batch/millisecond.  A plain now()-per-batch ties and dedup picks
+# arbitrarily.
+import threading as _threading
+
+_version_lock = _threading.Lock()
+_version_base = time.time_ns()
+
+
+def _next_versions(n: int) -> int:
+    """Reserve n consecutive monotonic versions; returns the first."""
+    global _version_base
+    with _version_lock:
+        start = _version_base
+        _version_base += n
+        return start
+
+
+def _numeric_fields(m: Measure):
+    return [f for f in m.fields if f.type not in _RAW_FIELD_TYPES]
+
+
+def _tag_col_names(m: Measure) -> list[str]:
+    """Schema tags + reserved raw-field columns, the storage tag layout."""
+    return [t.name for t in m.tags] + [
+        _RAW_FIELD_PREFIX + f.name for f in _raw_fields(m)
+    ]
+
+
+def _raw_fields(m: Measure):
+    """STRING / DATA_BINARY fields: stored, projected, never aggregated.
+
+    They ride the dictionary-encoded tag machinery under reserved
+    '@f:<name>' column names (the part/memtable formats already handle
+    arbitrary byte columns there), mirroring the reference's non-numeric
+    field columns (FIELD_TYPE_STRING in pkg/test/measure/testdata)."""
+    return [f for f in m.fields if f.type in _RAW_FIELD_TYPES]
+
+
+def _raw_field_bytes(v) -> bytes:
+    if v is None:
+        return b""
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode()
+    return str(v).encode()
+
+
 class DictColumn:
     """A dictionary-encoded tag column: `values` (distinct tag values)
     + int `codes` per row.  The wire's columnar write envelope ships tag
@@ -145,13 +199,18 @@ class MeasureEngine:
             ]
             sid = hashing.series_id(entity)
             seg = db.segment_for(p.ts_millis)
-            version = p.version or int(time.time() * 1000)
+            version = p.version or _next_versions(1)
             tag_bytes = {
                 t.name: _tag_to_bytes(p.tags.get(t.name), t.type)
                 for t in m.tags
             }
+            for f in _raw_fields(m):
+                tag_bytes[_RAW_FIELD_PREFIX + f.name] = _raw_field_bytes(
+                    p.fields.get(f.name)
+                )
             field_vals = {
-                f.name: float(p.fields.get(f.name, 0)) for f in m.fields
+                f.name: float(p.fields.get(f.name, 0))
+                for f in _numeric_fields(m)
             }
             if m.index_mode:
                 # Index-mode measures live entirely in the series index —
@@ -169,8 +228,8 @@ class MeasureEngine:
             seg.shards[shard].ingest(
                 lambda mem: mem.append_measure(
                     m.name,
-                    [t.name for t in m.tags],
-                    [f.name for f in m.fields],
+                    _tag_col_names(m),
+                    [f.name for f in _numeric_fields(m)],
                     p.ts_millis,
                     sid,
                     version,
@@ -194,23 +253,27 @@ class MeasureEngine:
         n = len(pts)
         if n == 0:
             return 0
-        now_ms = int(time.time() * 1000)
         ts = np.fromiter((p.ts_millis for p in pts), np.int64, count=n)
+        v0 = _next_versions(n)
         versions = np.fromiter(
-            ((p.version or now_ms) for p in pts), np.int64, count=n
+            ((p.version or (v0 + i)) for i, p in enumerate(pts)),
+            np.int64,
+            count=n,
         )
         tags = {t.name: [p.tags.get(t.name) for p in pts] for t in m.tags}
         for t in m.entity.tag_names:
             if any(v is None for v in tags[t]):
                 raise KeyError(t)
-        fields = {
+        fields: dict[str, object] = {
             f.name: np.fromiter(
                 (float(p.fields.get(f.name, 0)) for p in pts),
                 np.float64,
                 count=n,
             )
-            for f in m.fields
+            for f in _numeric_fields(m)
         }
+        for f in _raw_fields(m):
+            fields[f.name] = [p.fields.get(f.name) for p in pts]
         return self.write_columns(
             req.group,
             req.name,
@@ -252,7 +315,7 @@ class MeasureEngine:
         versions = (
             versions
             if versions is not None
-            else np.full(n, int(time.time() * 1000), dtype=np.int64)
+            else _next_versions(n) + np.arange(n, dtype=np.int64)
         )
         tag_bytes: dict[str, object] = {}
         for t in m.tags:
@@ -303,6 +366,21 @@ class MeasureEngine:
                 )
         if len(versions) != n:
             raise ValueError(f"{len(versions)} versions for {n} rows")
+        # raw (string/binary) fields ride the tag machinery ('@f:' cols)
+        for f in _raw_fields(m):
+            vals = fields.get(f.name)
+            key = _RAW_FIELD_PREFIX + f.name
+            if vals is None:
+                tag_bytes[key] = None
+            elif isinstance(vals, DictColumn):
+                tag_bytes[key] = DictColumn(
+                    [_raw_field_bytes(v) for v in vals.values], vals.codes
+                )
+            else:
+                tag_bytes[key] = [_raw_field_bytes(v) for v in vals]
+        num_fields = {
+            f.name: fields.get(f.name) for f in _numeric_fields(m)
+        }
         for t in m.entity.tag_names:
             if tag_bytes.get(t) is None:
                 # row-path strictness: a missing entity tag is a client
@@ -387,20 +465,20 @@ class MeasureEngine:
                         int(ts_millis[i]),
                         int(versions[i]),
                         {
-                            t.name: (
-                                tag_bytes[t.name][i]
-                                if tag_bytes[t.name] is not None
+                            t: (
+                                tag_bytes[t][i]
+                                if tag_bytes[t] is not None
                                 else b""
                             )
-                            for t in m.tags
+                            for t in tag_bytes
                         },
                         {
                             f.name: (
-                                float(np.asarray(fields[f.name])[i])
-                                if fields.get(f.name) is not None
+                                float(np.asarray(num_fields[f.name])[i])
+                                if num_fields.get(f.name) is not None
                                 else 0.0
                             )
-                            for f in m.fields
+                            for f in _numeric_fields(m)
                         },
                     )
             return n
@@ -428,8 +506,8 @@ class MeasureEngine:
                     else:
                         sel_tags[t] = [col[i] for i in idx]
                 sel_fields = {}
-                for f in m.fields:
-                    v = fields.get(f.name)
+                for f in _numeric_fields(m):
+                    v = num_fields.get(f.name)
                     sel_fields[f.name] = (
                         np.asarray(v)[idx] if v is not None else None
                     )
@@ -437,8 +515,8 @@ class MeasureEngine:
                 shard_obj.ingest(
                     lambda mem: mem.append_measure_bulk(
                         name,
-                        [t.name for t in m.tags],
-                        [f.name for f in m.fields],
+                        _tag_col_names(m),
+                        [f.name for f in _numeric_fields(m)],
                         ts_millis[idx],
                         sids[idx],
                         versions[idx],
@@ -446,7 +524,7 @@ class MeasureEngine:
                         sel_fields,
                     )
                 )
-        self.topn.observe_columns(m, ts_millis, tags, fields)
+        self.topn.observe_columns(m, ts_millis, tags, num_fields)
         return n
 
     def ensure_result_measure(self, group: str) -> None:
@@ -498,12 +576,15 @@ class MeasureEngine:
                     if attempt == 2:
                         raise
         t_gather = time.perf_counter()
+        analyzers = self._tag_analyzers(group, req.name)
         if plan.find("GroupByAggregate") is not None:
             res = measure_exec.execute_aggregate(
-                m, req, sources, dict_state=self._dict_state(group, req.name)
+                m, req, sources,
+                dict_state=self._dict_state(group, req.name),
+                analyzers=analyzers,
             )
         else:
-            res = _raw_rows(m, req, sources)
+            res = _raw_rows(m, req, sources, analyzers=analyzers)
         if req.trace:
             res.trace = _trace_spans(t_start, t_gather, sources, m.index_mode)
             res.trace["plan"] = plan.explain()
@@ -520,9 +601,10 @@ class MeasureEngine:
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         sources = self.gather_query_sources(req, shard_ids=shard_ids)
+        analyzers = self._tag_analyzers(group, req.name)
         if m.index_mode:
             return measure_exec.compute_partials(
-                m, req, sources, hist_range=hist_range
+                m, req, sources, hist_range=hist_range, analyzers=analyzers
             )
         return measure_exec.compute_partials(
             m,
@@ -530,7 +612,28 @@ class MeasureEngine:
             sources,
             hist_range=hist_range,
             dict_state=self._dict_state(group, req.name),
+            analyzers=analyzers,
         )
+
+    def _tag_analyzers(self, group: str, name: str) -> dict[str, str]:
+        """tag -> analyzer from index rules BOUND to this measure (the
+        MATCH op's mandatory context, ref inverted/query.go:371).  Rules
+        without an analyzer map to 'keyword' (exact-term match)."""
+        out: dict[str, str] = {}
+        try:
+            rules = {r.name: r for r in self.registry.list_index_rules(group)}
+            for b in self.registry.list_index_rule_bindings(group):
+                if b.subject_name != name:
+                    continue
+                for rn in b.rules:
+                    r = rules.get(rn)
+                    if r is None:
+                        continue
+                    for t in r.tags:
+                        out[t] = r.analyzer or "keyword"
+        except Exception:  # noqa: BLE001 — registries without bindings
+            pass
+        return out
 
     def gather_query_sources(self, req, shard_ids=None):
         """Source selection for the map phase, shared by the host partial
@@ -576,8 +679,8 @@ class MeasureEngine:
         self, db: TSDB, m: Measure, req: QueryRequest, shard_ids=None
     ) -> list[ColumnData]:
         sources: list[ColumnData] = []
-        tag_names = [t.name for t in m.tags]
-        field_names = [f.name for f in m.fields]
+        tag_names = _tag_col_names(m)  # incl. '@f:' raw-field columns
+        field_names = [f.name for f in _numeric_fields(m)]
         entity_conds = _entity_eq_conditions(m, req)
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
@@ -712,7 +815,12 @@ class _MultiMeasureMemtable:
         return dict(self._tables)
 
 
-def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> QueryResult:
+def _raw_rows(
+    m: Measure,
+    req: QueryRequest,
+    sources: list[ColumnData],
+    analyzers: Optional[dict] = None,
+) -> QueryResult:
     """Projection/limit query without aggregation: host-side assembly.
 
     The aggregate path is the TPU hot loop; raw row retrieval is IO-bound
@@ -728,26 +836,64 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
         if src.ts.size == 0:
             continue
         mask = qfilter.criteria_mask(
-            src, req.criteria, req.time_range.begin_millis, req.time_range.end_millis
+            src, req.criteria, req.time_range.begin_millis,
+            req.time_range.end_millis, analyzers=analyzers,
+            tag_types={t.name: t.type for t in m.tags},
         )
+        raw_types = {
+            _RAW_FIELD_PREFIX + f.name: f.type for f in _raw_fields(m)
+        }
         for i in np.nonzero(mask)[0]:
-            tags = {
-                t: qfilter.decode_tag_value(
-                    src.dicts[t][src.tags[t][i]], m.tag(t).type
+            tags = {}
+            fields = {}
+            for t in src.tags:
+                raw = src.dicts[t][src.tags[t][i]]
+                ftype = raw_types.get(t)
+                if ftype is not None:
+                    # reserved '@f:' column: a stored raw field value
+                    fields[t[len(_RAW_FIELD_PREFIX):]] = (
+                        raw
+                        if ftype == FieldType.DATA_BINARY
+                        else raw.decode(errors="replace")
+                    )
+                else:
+                    tags[t] = qfilter.decode_tag_value(raw, m.tag(t).type)
+            for f in src.fields:
+                fields[f] = float(src.fields[f][i])
+            rows.append(
+                (
+                    int(src.ts[i]),
+                    int(src.version[i]),
+                    tags,
+                    fields,
+                    int(src.series[i]),
                 )
-                for t in src.tags
-            }
-            fields = {f: float(src.fields[f][i]) for f in src.fields}
-            rows.append((int(src.ts[i]), int(src.version[i]), tags, fields))
+            )
 
     # Version dedup then ordering: by an indexed tag's value when
     # order_by_tag is set (order-by-index analog), else by ts.
+    # Index-mode measures dedup PER SERIES across segments (docs are
+    # series-keyed upserts; an older segment may still hold a replaced
+    # doc) — row measures dedup per (series, ts): a rewrite of the same
+    # series at the same timestamp REPLACES the row even when non-entity
+    # tags changed (want/duplicated_part.yaml keeps only the last write)
     best: dict[tuple, tuple] = {}
     for row in rows:
-        key = (row[0], tuple(sorted(row[2].items())))
+        key = (row[4],) if m.index_mode else (row[4], row[0])
         if key not in best or best[key][1] < row[1]:
             best[key] = row
-    if req.order_by_tag:
+    if req.top:
+        # row-level top-N (measure_top.go): rank raw points by the
+        # field's value, emit in ranking order
+        fname = req.top.field_name
+        desc = req.top.field_value_sort != "asc"
+        ranked = sorted(
+            (r for r in best.values() if fname in r[3]),
+            key=lambda r: r[3][fname],
+            reverse=desc,
+        )
+        ordered = ranked[: req.top.number]
+    elif req.order_by_tag:
         have = [r for r in best.values() if r[2].get(req.order_by_tag) is not None]
         miss = [r for r in best.values() if r[2].get(req.order_by_tag) is None]
         have.sort(
@@ -763,7 +909,7 @@ def _raw_rows(m: Measure, req: QueryRequest, sources: list[ColumnData]) -> Query
             best.values(), key=lambda r: r[0], reverse=(req.order_by_ts == "desc")
         )
     off = req.offset or 0
-    for ts, _ver, tags, fields in ordered[off : off + (req.limit or 100)]:
+    for ts, _ver, tags, fields, _sid in ordered[off : off + (req.limit or 100)]:
         res.data_points.append({"timestamp": ts, "tags": tags, "fields": fields})
     return res
 
@@ -820,17 +966,18 @@ def _entity_eq_conditions(m: Measure, req: QueryRequest):
 # -- index-mode measures (doc-per-point in the series index) ---------------
 
 
-def _point_doc_id(measure: str, sid: int, ts_millis: int) -> int:
+def _series_doc_id(measure: str, sid: int) -> int:
+    """Index-mode doc identity = the SERIES (ref DocID: uint64(series.ID),
+    write_standalone.go:89): a new point for the same series REPLACES the
+    doc — index-mode measures hold each series' latest state, not a
+    point history."""
     import hashlib
 
     h = hashlib.blake2b(
-        measure.encode()
-        + b"\x00"
-        + sid.to_bytes(8, "little")
-        + ts_millis.to_bytes(8, "little", signed=True),
+        measure.encode() + b"\x00" + sid.to_bytes(8, "little", signed=True),
         digest_size=8,
-    ).digest()
-    return int.from_bytes(h, "little") & 0x7FFF_FFFF_FFFF_FFFF
+    )
+    return int.from_bytes(h.digest(), "little", signed=True)
 
 
 def _index_mode_write(seg, m: Measure, sid, ts_millis, version, tag_bytes, field_vals):
@@ -842,10 +989,11 @@ def _index_mode_write(seg, m: Measure, sid, ts_millis, version, tag_bytes, field
     ).tobytes()
     keywords = dict(tag_bytes)
     keywords["@measure"] = m.name.encode()
-    # check-and-insert under the index lock (dedup-by-version contract)
+    # check-and-insert under the index lock (dedup-by-version contract);
+    # series-keyed doc id => a newer point REPLACES the series' doc
     idx.insert_if_newer(
         Doc(
-            doc_id=_point_doc_id(m.name, sid, ts_millis),
+            doc_id=_series_doc_id(m.name, sid),
             keywords=keywords,
             numerics={"@ts": ts_millis, "@version": version, "@series": sid},
             payload=payload,
@@ -855,13 +1003,23 @@ def _index_mode_write(seg, m: Measure, sid, ts_millis, version, tag_bytes, field
 
 def _index_mode_sources(db: TSDB, m: Measure, req: QueryRequest) -> list[ColumnData]:
     """Build scan sources straight from index docs (SearchWithoutSeries) —
-    the same device executor then runs over them unchanged."""
+    the same device executor then runs over them unchanged.
+
+    Segments wholly past the group's TTL are excluded at QUERY time (the
+    retention sweep may not have run yet; ref 'excludes data expired
+    beyond TTL' golden): data past retention must never surface."""
     from banyandb_tpu.index.inverted import And, RangeQuery, TermQuery
 
+    ttl_floor = None
+    ttl = getattr(db.opts, "ttl", None)
+    if ttl is not None and ttl.millis:
+        ttl_floor = int(time.time() * 1000) - ttl.millis
     sources = []
     for seg in db.select_segments(
         req.time_range.begin_millis, req.time_range.end_millis
     ):
+        if ttl_floor is not None and seg.end <= ttl_floor:
+            continue  # fully expired segment
         idx = seg.series_index._idx
         ids = idx.search(
             And(
@@ -886,20 +1044,25 @@ def _index_mode_sources(db: TSDB, m: Measure, req: QueryRequest) -> list[ColumnD
         )
         tags: dict[str, np.ndarray] = {}
         dicts: dict[str, list[bytes]] = {}
-        for t in m.tags:
+        for tname in _tag_col_names(m):
             vocab: dict[bytes, int] = {}
             codes = np.empty(n, dtype=np.int32)
             for i, d in enumerate(docs):
-                v = d.keywords.get(t.name, b"")
+                v = d.keywords.get(tname, b"")
                 codes[i] = vocab.setdefault(v, len(vocab))
-            tags[t.name] = codes
-            dicts[t.name] = [
+            tags[tname] = codes
+            dicts[tname] = [
                 v for v, _ in sorted(vocab.items(), key=lambda kv: kv[1])
             ]
         fields: dict[str, np.ndarray] = {}
+        num_fields = _numeric_fields(m)
         raw = np.frombuffer(b"".join(d.payload for d in docs), dtype=np.float64)
-        raw = raw.reshape(n, len(m.fields)) if len(m.fields) else raw.reshape(n, 0)
-        for j, f in enumerate(m.fields):
+        raw = (
+            raw.reshape(n, len(num_fields))
+            if num_fields
+            else raw.reshape(n, 0)
+        )
+        for j, f in enumerate(num_fields):
             fields[f.name] = raw[:, j].copy()
         sources.append(
             ColumnData(

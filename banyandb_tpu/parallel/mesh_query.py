@@ -64,6 +64,14 @@ class MeshExecutor:
     def execute(self, m, req, assignment):
         from banyandb_tpu.parallel import dist_exec
 
+        group_tags = set(req.group_by.tag_names) if req.group_by else set()
+        if (req.group_by or req.agg) and (
+            set(req.tag_projection) - group_tags
+        ):
+            # representative-tag projection needs the host partial path's
+            # scan-order tracking; the collective plane carries dense
+            # sums only (applies to grouped AND global aggregates)
+            raise MeshUnsupported("projection beyond group tags")
         if not (req.agg or req.group_by):
             raise MeshUnsupported("raw row queries ride scatter-gather")
         conds = _supported_conds(req)

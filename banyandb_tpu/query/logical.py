@@ -132,7 +132,10 @@ def analyze_measure(measure, req: QueryRequest, *, execute=None) -> PlanNode:
             _execute=execute,
         )
     root = scan
-    if req.agg or req.group_by or req.top:
+    # top WITHOUT group-by/agg ranks raw data points by field value
+    # (measure_top.go row-level top) — a raw scan concern, not the
+    # grouped kernel's
+    if req.agg or req.group_by:
         root = PlanNode(
             "GroupByAggregate",
             {

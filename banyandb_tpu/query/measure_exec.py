@@ -92,6 +92,11 @@ class PlanSpec:
     hist_field: str = ""  # non-empty -> also emit histogram partials
     nrows: int = CHUNK
     group_method: str = "auto"  # ops.group_reduce method override
+    # scan-order tracking: emit per-group min (scan asc) or max (desc)
+    # of (ts<<32 | row) — drives first-appearance group ordering AND the
+    # representative row for projected-but-not-grouped tags
+    want_rep: bool = False
+    rep_desc: bool = False
     # predicate expression tree over `preds`: ("p", i) leaves combined by
     # ("and", l, r) / ("or", l, r) nodes — the device lowering of a full
     # model/v1 Criteria tree (pkg/query/logical analog). () = AND of all
@@ -163,6 +168,40 @@ def _build_kernel(spec: PlanSpec):
                 hist_span,
                 _NUM_HIST_BUCKETS,
             )
+        if spec.want_rep:
+            # scan-order tracking, 32-bit friendly (device x64 stays
+            # off): per-group min/max ts, then min/max row among rows AT
+            # that ts — the first row of each group under a ts ASC scan
+            # (DESC under ORDER BY time DESC), which drives both group
+            # emission order and the representative row (reference
+            # measure_plan_groupby.go first-appearance + aggregation
+            # first-fed row semantics)
+            ts32 = chunk["ts"]
+            row32 = chunk["row"]
+            G1 = spec.num_groups + 1
+            skey = jnp.where(mask, key, jnp.int32(spec.num_groups))
+            if spec.rep_desc:
+                gts = jax.ops.segment_max(
+                    jnp.where(mask, ts32, jnp.int32(-(2**31) + 1)),
+                    skey, num_segments=G1,
+                )
+                at = mask & (ts32 == jnp.take(gts, skey, mode="clip"))
+                grow = jax.ops.segment_max(
+                    jnp.where(at, row32, jnp.int32(-1)),
+                    skey, num_segments=G1,
+                )
+            else:
+                gts = jax.ops.segment_min(
+                    jnp.where(mask, ts32, jnp.int32(2**31 - 1)),
+                    skey, num_segments=G1,
+                )
+                at = mask & (ts32 == jnp.take(gts, skey, mode="clip"))
+                grow = jax.ops.segment_min(
+                    jnp.where(at, row32, jnp.int32(2**31 - 1)),
+                    skey, num_segments=G1,
+                )
+            out["rep_ts"] = gts[: spec.num_groups]
+            out["rep_row"] = grow[: spec.num_groups]
         return out
 
     return jax.jit(kernel)
@@ -372,6 +411,7 @@ class Partials:
     __slots__ = (
         "group_tags", "count", "sums", "mins", "maxs", "hist", "hist_lo",
         "hist_span", "field_stats", "_groups", "codes", "group_values",
+        "rep_key", "rep_desc", "rep_vals",
     )
 
     def __init__(
@@ -388,6 +428,9 @@ class Partials:
         field_stats: dict = None,  # f -> (min, max)
         codes: Optional[np.ndarray] = None,  # int32 [K, T] global codes
         group_values: Optional[dict] = None,  # tag -> list[bytes] snapshot
+        rep_key: Optional[np.ndarray] = None,  # int64 [K] scan-order key
+        rep_desc: bool = False,
+        rep_vals: Optional[dict] = None,  # tag -> list[bytes] [K] rep row
     ):
         if groups is None and codes is None:
             raise TypeError("Partials needs groups or codes+group_values")
@@ -403,6 +446,9 @@ class Partials:
         self.hist_lo = hist_lo
         self.hist_span = hist_span
         self.field_stats = {} if field_stats is None else field_stats
+        self.rep_key = rep_key
+        self.rep_desc = rep_desc
+        self.rep_vals = rep_vals
 
     @property
     def groups(self) -> list[tuple[bytes, ...]]:
@@ -441,9 +487,12 @@ def execute_aggregate(
     request: QueryRequest,
     sources: list[ColumnData],
     dict_state: Optional[DictState] = None,
+    analyzers: Optional[dict] = None,
 ) -> QueryResult:
     """Run a group-by/aggregate/top-N/percentile query over decoded sources."""
-    partial = compute_partials(measure, request, sources, dict_state=dict_state)
+    partial = compute_partials(
+        measure, request, sources, dict_state=dict_state, analyzers=analyzers
+    )
     return finalize_partials(measure, request, [partial], dict_state=dict_state)
 
 
@@ -453,6 +502,7 @@ def compute_partials(
     sources: list[ColumnData],
     hist_range: Optional[tuple[float, float]] = None,
     dict_state: Optional[DictState] = None,
+    analyzers: Optional[dict] = None,
 ) -> Partials:
     """The 'map' phase: device scan+reduce over local sources.
 
@@ -475,9 +525,37 @@ def compute_partials(
     for c in conds:
         measure.tag(c.name)  # validate against schema (KeyError on typo)
         tags_code.add(c.name)
+    # Representative tags: projected but not grouped — each output group
+    # carries the first-scanned row's values for these (reference
+    # aggregation copies the first fed row's TagFamilies).  Unknown
+    # projected tags are schema errors (ref WantErr cases).
+    rep_tags: tuple[str, ...] = ()
+    if group_tags or agg is not None:
+        rep_list = []
+        for t in request.tag_projection:
+            if t in group_tags:
+                continue
+            measure.tag(t)  # KeyError -> INVALID_ARGUMENT on the wire
+            rep_list.append(t)
+            tags_code.add(t)
+        rep_tags = tuple(dict.fromkeys(rep_list))
+    rep_desc = request.order_by_ts == "desc"
+    # scan-order tracking serves grouped ordering AND the global-agg
+    # representative row (a no-group aggregate's output row carries the
+    # first scanned row's projected tags)
+    want_rep = bool(group_tags) or bool(rep_tags)
     # Projection names that aren't schema fields (e.g. tags from a QL
     # SELECT list) are dropped — they'd only materialize zero columns.
-    known = {f.name for f in measure.fields}
+    # Raw (string/binary) fields never ride the device path either: they
+    # are stored as '@f:' tag columns and only the raw-row path serves
+    # them (models/measure._raw_fields).
+    from banyandb_tpu.api.schema import FieldType as _FT
+
+    known = {
+        f.name
+        for f in measure.fields
+        if f.type not in (_FT.STRING, _FT.DATA_BINARY)
+    }
     fields = {f for f in request.field_projection if f in known}
     if agg:
         fields.add(agg.field_name)
@@ -538,6 +616,14 @@ def compute_partials(
     else:
         chunks_np = _do_gather()
     n = chunks_np["ts"].shape[0]
+    # epoch = global min ts keeps chunk-relative int32 offsets
+    # nonnegative for the scan-order key; spans >= 2^31 ms (~24.8 days)
+    # would wrap the int32 cast, so rep tracking degrades to canonical
+    # ordering there instead of silently corrupting
+    epoch = int(chunks_np["ts"].min()) if n else 0
+    if n and int(chunks_np["ts"].max()) - epoch >= 2**31:
+        want_rep = False
+        rep_tags = ()
 
     # --- plan signature ---------------------------------------------------
     # All gd reads happen under the DictState lock (concurrent queries
@@ -549,30 +635,24 @@ def compute_partials(
     pred_vals: dict[str, jax.Array] = {}
     with dict_state.lock if dict_state is not None else contextlib.nullcontext():
         for i, c in enumerate(conds):
-            if c.op in range_ops:
-                # Numeric range on an INT tag: evaluate op(dict_value,
-                # literal) host-side per global code -> bool LUT gathered on
-                # device.  64-bit tag values never leave the host (int32-safe
-                # kernel).
-                if measure.tag(c.name).type != TagType.INT:
-                    raise TypeError(f"range op {c.op} on non-INT tag {c.name}")
-                dvals = np.asarray(
-                    [
-                        int.from_bytes(v, "little", signed=True) if v else 0
-                        for v in gd.values(c.name)
-                    ],
-                    dtype=np.int64,
-                )
-                if dvals.size == 0:
-                    dvals = np.zeros(1, dtype=np.int64)
-                    lut = np.zeros(1, dtype=bool)
+            if c.op in range_ops or c.op == "match":
+                # LUT predicates (range / MATCH): op(dict_value, literal)
+                # evaluated host-side per global code -> bool LUT gathered
+                # on device (64-bit tag values and analyzer tokenization
+                # never reach the int32 kernel).  Shared with the raw row
+                # path (query/filter.py) so host and device semantics
+                # cannot drift.
+                from banyandb_tpu.query.filter import match_lut, range_lut
+
+                vals = gd.values(c.name)
+                if c.op == "match":
+                    lut = match_lut(c, analyzers, vals)
                 else:
-                    lut = {
-                        "lt": dvals < int(c.value),
-                        "le": dvals <= int(c.value),
-                        "gt": dvals > int(c.value),
-                        "ge": dvals >= int(c.value),
-                    }[c.op]
+                    lut = range_lut(
+                        c.op, c.value, vals, measure.tag(c.name).type
+                    )
+                if not len(lut):
+                    lut = np.zeros(1, dtype=bool)
                 pred_specs.append(_PredSpec("lut", c.name, c.op, nvals=len(lut)))
                 pred_vals[f"p{i}"] = jnp.asarray(lut)
             elif c.op in ("in", "not_in"):
@@ -614,6 +694,8 @@ def compute_partials(
         hist_field=hist_field,
         nrows=nrows,
         expr=expr,
+        want_rep=want_rep,
+        rep_desc=rep_desc,
     )
     kernel = _KERNEL_CACHE.get(spec)
     if kernel is None:
@@ -629,6 +711,89 @@ def compute_partials(
     else:
         hist_lo, hist_span = 0.0, 1.0
 
+    # --- partials-level serving cache -------------------------------------
+    # Repeat queries over unchanged sources (the dashboard pattern) skip
+    # the whole reduction: the cache key pins the gathered snapshot
+    # (gather_key covers source identities + time range + dict token),
+    # the compiled plan signature, and every predicate VALUE.
+    partials_key = None
+    if gather_key is not None:
+        import hashlib as _hl
+
+        h = _hl.blake2b(digest_size=16)
+        for pk in sorted(pred_vals):
+            h.update(pk.encode())
+            h.update(np.asarray(pred_vals[pk]).tobytes())
+        partials_key = (
+            "partials",
+            gather_key,
+            spec,
+            round(hist_lo, 9),
+            round(hist_span, 9),
+            h.hexdigest(),
+        )
+
+    def _reduce() -> Partials:
+        return _reduce_partials(
+            measure, chunks_np, conds, expr, pred_vals, spec, kernel,
+            group_values, rep_tags, rep_desc, want_rep, gd, dict_state,
+            hist_lo, hist_span, want_percentile, epoch, gather_key, agg,
+        )
+
+    if partials_key is not None:
+        from banyandb_tpu.storage.cache import global_cache
+
+        return global_cache().get_or_load(partials_key, _reduce)
+    return _reduce()
+
+
+def _reduce_partials(
+    measure,
+    chunks_np,
+    conds,
+    expr,
+    pred_vals,
+    spec,
+    kernel,
+    group_values,
+    rep_tags,
+    rep_desc,
+    want_rep,
+    gd,
+    dict_state,
+    hist_lo,
+    hist_span,
+    want_percentile,
+    epoch,
+    gather_key,
+    agg,
+):
+    """The reduction tail of compute_partials (cacheable unit)."""
+    import contextlib
+
+    n = chunks_np["ts"].shape[0]
+    group_tags = spec.group_tags
+    radices = spec.radices
+    want_minmax = spec.want_minmax
+    # --- exact-f64 host path for FLOAT-field aggregation ------------------
+    # The reference aggregates float64 fields in full f64 and its goldens
+    # compare exactly (852.0409999999999 etc.); the device kernel's f32
+    # partials cannot reproduce that.  Float aggregates therefore reduce
+    # on host in f64 (vectorized bincount — still columnar, just not on
+    # the accelerator); INT fields keep the device path (f32 partials
+    # are exact to 2^24 per chunk and merge in f64).
+    agg_is_float = False
+    if agg and agg.function != "percentile":
+        try:
+            agg_is_float = measure.field(agg.field_name).type.name == "FLOAT"
+        except KeyError:
+            agg_is_float = False
+    if agg_is_float and n:
+        return _host_float_partials(
+            measure, None, chunks_np, conds, expr, pred_vals, spec,
+            group_values, rep_tags, rep_desc, want_rep, gd, dict_state,
+        )
+
     # --- run chunks, combine partials ------------------------------------
     G = spec.num_groups
     count = np.zeros(G, dtype=np.float64)
@@ -636,8 +801,12 @@ def compute_partials(
     mins = {f: np.full(G, np.inf) for f in spec.fields}
     maxs = {f: np.full(G, -np.inf) for f in spec.fields}
     hist = np.zeros((G, _NUM_HIST_BUCKETS), dtype=np.float64) if want_percentile else None
+    rep_ts_acc = rep_row_acc = None
+    if want_rep:
+        sentinel = -(2**62) if rep_desc else 2**62
+        rep_ts_acc = np.full(G, sentinel, dtype=np.int64)
+        rep_row_acc = np.full(G, sentinel, dtype=np.int64)
 
-    epoch = int(chunks_np["ts"][0]) if n else 0
     # device scalars hoisted out of the chunk loop: rebuilding them per
     # chunk costs two convert_element_type dispatches each iteration
     # (~profiled third of warm query latency on many-chunk scans)
@@ -679,6 +848,19 @@ def compute_partials(
                 maxs[f] = np.maximum(maxs[f], np.asarray(out["maxs"][f]))
         if hist is not None:
             hist += np.asarray(out["hist"], dtype=np.float64)
+        if rep_ts_acc is not None:
+            rts = np.asarray(out["rep_ts"], dtype=np.int64) + epoch
+            rrow = np.asarray(out["rep_row"], dtype=np.int64)
+            if rep_desc:
+                better = (rts > rep_ts_acc) | (
+                    (rts == rep_ts_acc) & (rrow > rep_row_acc)
+                )
+            else:
+                better = (rts < rep_ts_acc) | (
+                    (rts == rep_ts_acc) & (rrow < rep_row_acc)
+                )
+            rep_ts_acc = np.where(better, rts, rep_ts_acc)
+            rep_row_acc = np.where(better, rrow, rep_row_acc)
 
     # --- dense [G] arrays -> nonempty-group records (codes stay dense
     # int32 rows; value tuples materialize lazily, Partials.groups) -------
@@ -692,6 +874,26 @@ def compute_partials(
     else:
         nz = np.asarray([0])
         codes = np.zeros((1, 0), np.int32)
+    rep_key = None
+    if rep_ts_acc is not None:
+        # [K, 2] (absolute ts, row) scan-order key, compared
+        # lexicographically; row is only a local tie-break (cross-node
+        # combines compare ts first, which is what first-appearance
+        # ordering needs)
+        rep_key = np.stack([rep_ts_acc[nz], rep_row_acc[nz]], axis=1)
+    rep_vals = None
+    if rep_tags and rep_key is not None and len(nz):
+        # decode each group's representative row into the gathered cols
+        rows = np.clip(rep_key[:, 1], 0, max(n - 1, 0))
+        with dict_state.lock if dict_state is not None else contextlib.nullcontext():
+            rep_vals = {}
+            for t in rep_tags:
+                vals_list = gd.values(t)
+                varr = np.asarray(vals_list, dtype=object)
+                rep_codes_t = chunks_np["tags_code"][t][rows]
+                rep_vals[t] = varr[rep_codes_t].tolist()
+    elif rep_tags:
+        rep_vals = {t: [] for t in rep_tags}
     field_stats = {}
     if want_minmax:
         for f in spec.fields:
@@ -713,6 +915,150 @@ def compute_partials(
         hist_lo=hist_lo,
         hist_span=hist_span,
         field_stats=field_stats,
+        rep_key=rep_key,
+        rep_desc=rep_desc,
+        rep_vals=rep_vals,
+    )
+
+
+def _host_float_partials(
+    measure,
+    request,
+    chunks: dict,
+    conds,
+    expr,
+    pred_vals: dict,
+    spec: PlanSpec,
+    group_values: dict,
+    rep_tags: tuple,
+    rep_desc: bool,
+    want_rep: bool,
+    gd: GlobalDicts,
+    dict_state,
+) -> Partials:
+    """Exact-f64 reduction over the gathered columns (float agg fields).
+
+    Mirrors the device kernel's semantics — same predicate LUT/code
+    masks, same mixed-radix group keys, same scan-order representative —
+    with numpy f64 arithmetic so float goldens compare exactly."""
+    n = chunks["ts"].shape[0]
+    G = spec.num_groups
+
+    def pred_mask(i: int) -> np.ndarray:
+        p = spec.preds[i]
+        col = chunks["tags_code"][p.name]
+        v = np.asarray(pred_vals[f"p{i}"])
+        if p.kind == "lut":
+            m = len(v)
+            ok = (col >= 0) & (col < m)
+            return np.where(ok, v[np.clip(col, 0, m - 1)], False)
+        if p.op in ("in", "not_in"):
+            m = np.isin(col, v)
+            return ~m if p.op == "not_in" else m
+        return (col == v) if p.op == "eq" else (col != v)
+
+    def eval_expr(node) -> np.ndarray:
+        if node[0] == "p":
+            return pred_mask(node[1])
+        left, right = eval_expr(node[1]), eval_expr(node[2])
+        return (left & right) if node[0] == "and" else (left | right)
+
+    if spec.expr:
+        mask = eval_expr(spec.expr)
+    else:
+        mask = np.ones(n, dtype=bool)
+        for i in range(len(spec.preds)):
+            mask &= pred_mask(i)
+
+    if spec.group_tags:
+        key = np.zeros(n, dtype=np.int64)
+        for t, r in zip(spec.group_tags, spec.radices):
+            key = key * r + chunks["tags_code"][t].astype(np.int64)
+    else:
+        key = np.zeros(n, dtype=np.int64)
+
+    sel = np.nonzero(mask)[0]
+    k = key[sel]
+    count = np.bincount(k, minlength=G).astype(np.float64)
+    sums = {}
+    mins = {}
+    maxs = {}
+    for f in spec.fields:
+        vals = chunks["fields"][f][sel].astype(np.float64)
+        sums[f] = np.bincount(k, weights=vals, minlength=G)
+        mn = np.full(G, np.inf)
+        mx = np.full(G, -np.inf)
+        np.minimum.at(mn, k, vals)
+        np.maximum.at(mx, k, vals)
+        mins[f] = mn
+        maxs[f] = mx
+
+    rep_ts_acc = rep_row_acc = None
+    if want_rep:
+        # sentinels ALWAYS initialized when rep is on — a zero-match
+        # node must still ship rep arrays or combine_partials would
+        # drop rep for the whole cluster result
+        sentinel = -(2**62) if rep_desc else 2**62
+        rep_ts_acc = np.full(G, sentinel, dtype=np.int64)
+        rep_row_acc = np.full(G, sentinel, dtype=np.int64)
+        if sel.size:
+            ts_sel = chunks["ts"][sel]
+            order = (
+                np.lexsort((-sel, -ts_sel))
+                if rep_desc
+                else np.lexsort((sel, ts_sel))
+            )
+            uk, first = np.unique(k[order], return_index=True)
+            rep_ts_acc[uk] = ts_sel[order][first]
+            rep_row_acc[uk] = sel[order][first]
+
+    if spec.group_tags:
+        nz = np.nonzero(count > 0)[0]
+        codes = (
+            np.stack(np.unravel_index(nz, spec.radices), axis=1).astype(np.int32)
+            if len(nz)
+            else np.zeros((0, len(spec.group_tags)), np.int32)
+        )
+    else:
+        nz = np.asarray([0])
+        codes = np.zeros((1, 0), np.int32)
+    rep_key = None
+    if rep_ts_acc is not None:
+        rep_key = np.stack([rep_ts_acc[nz], rep_row_acc[nz]], axis=1)
+    rep_vals = None
+    if rep_tags and rep_key is not None and len(nz):
+        rows = np.clip(rep_key[:, 1], 0, max(n - 1, 0))
+        import contextlib as _cl
+
+        with dict_state.lock if dict_state is not None else _cl.nullcontext():
+            rep_vals = {}
+            for t in rep_tags:
+                varr = np.asarray(gd.values(t), dtype=object)
+                rep_vals[t] = varr[chunks["tags_code"][t][rows]].tolist()
+    elif rep_tags:
+        rep_vals = {t: [] for t in rep_tags}
+
+    field_stats = {}
+    nonempty = count > 0
+    if nonempty.any():
+        for f in spec.fields:
+            field_stats[f] = (
+                float(mins[f][nonempty].min()),
+                float(maxs[f][nonempty].max()),
+            )
+    return Partials(
+        group_tags=spec.group_tags,
+        codes=codes,
+        group_values=group_values,
+        count=count[nz],
+        sums={f: sums[f][nz] for f in spec.fields},
+        mins={f: mins[f][nz] for f in spec.fields},
+        maxs={f: maxs[f][nz] for f in spec.fields},
+        hist=None,
+        field_stats=field_stats,
+        rep_key=rep_key,
+        rep_desc=rep_desc,
+        rep_vals=rep_vals,
     )
 
 
@@ -825,19 +1171,26 @@ def _device_chunk(cols: dict, start: int, end: int, spec: PlanSpec, epoch: int) 
 
     valid = np.zeros((nb,), dtype=bool)
     valid[:n] = True
-    # ts offsets relative to the first row's epoch keep int32 exact; range
+    # ts offsets relative to the global-min epoch keep int32 exact; range
     # masks are applied on absolute millis host-side during block pruning,
     # so the residual in-chunk mask only needs relative comparisons.
     ts_off = cols["ts"][start:end] - epoch
     ts = np.zeros((nb,), dtype=np.int64)
     ts[:n] = ts_off
-    return {
+    chunk = {
         "ts": jnp.asarray(ts.astype(np.int32)),
         "series": pad(cols["series"] % (2**31), np.int32),
         "valid": jnp.asarray(valid),
         "tags_code": {t: pad(cols["tags_code"][t], np.int32) for t in spec.tags_code},
         "fields": {f: pad(cols["fields"][f], np.float32) for f in spec.fields},
     }
+    # always present: the device-chunk cache is keyed by (gather, shape,
+    # columns) and shared across plan variants — a chunk built for a
+    # rep-less plan must still serve a rep-tracking one
+    row = np.zeros((nb,), dtype=np.int32)
+    row[:n] = np.arange(start, end, dtype=np.int32)
+    chunk["row"] = jnp.asarray(row)
+    return chunk
 
 
 def combine_partials(partials: list[Partials]) -> Partials:
@@ -854,6 +1207,13 @@ def combine_partials(partials: list[Partials]) -> Partials:
     """
     base = partials[0]
     want_hist = base.hist is not None
+    want_rep = all(p.rep_key is not None for p in partials)
+    rep_desc = base.rep_desc
+    rep_tags = (
+        sorted(base.rep_vals.keys())
+        if all(p.rep_vals is not None for p in partials)
+        else None
+    )
     fields = sorted(base.sums.keys())
 
     index: dict[tuple, int] = {}
@@ -876,6 +1236,14 @@ def combine_partials(partials: list[Partials]) -> Partials:
     maxs = {f: np.full(K, -np.inf) for f in fields}
     hist = np.zeros((K, _NUM_HIST_BUCKETS)) if want_hist else None
     field_stats: dict[str, tuple[float, float]] = {}
+    rep_key = (
+        np.full((K, 2), -(2**62) if rep_desc else 2**62, dtype=np.int64)
+        if want_rep
+        else None
+    )
+    rep_vals = (
+        {t: [None] * K for t in rep_tags} if rep_tags is not None else None
+    )
 
     for p, idx in zip(partials, maps):
         np.add.at(count, idx, p.count)
@@ -885,6 +1253,17 @@ def combine_partials(partials: list[Partials]) -> Partials:
             np.maximum.at(maxs[f], idx, p.maxs[f])
         if want_hist and p.hist is not None:
             np.add.at(hist, idx, p.hist)
+        if rep_key is not None and p.rep_key is not None:
+            # the scan-order winner's representative values follow its key
+            for k, i in enumerate(idx.tolist()):
+                pk = (int(p.rep_key[k, 0]), int(p.rep_key[k, 1]))
+                cur = (int(rep_key[i, 0]), int(rep_key[i, 1]))
+                better = pk > cur if rep_desc else pk < cur
+                if better:
+                    rep_key[i] = pk
+                    if rep_vals is not None:
+                        for t in rep_tags:
+                            rep_vals[t][i] = p.rep_vals[t][k]
         for f, (lo, hi) in p.field_stats.items():
             old = field_stats.get(f)
             field_stats[f] = (
@@ -903,6 +1282,9 @@ def combine_partials(partials: list[Partials]) -> Partials:
         hist_lo=base.hist_lo,
         hist_span=base.hist_span,
         field_stats=field_stats,
+        rep_key=rep_key,
+        rep_desc=rep_desc,
+        rep_vals=rep_vals,
     )
 
 
@@ -959,6 +1341,17 @@ def finalize_partials(
         group_ids = np.nonzero(nonempty)[0]
         if request.top:
             pass  # order irrelevant: Top-N selection replaces group_ids
+        elif p.rep_key is not None and group_ids.size:
+            # First-appearance scan order (the reference's groupLst:
+            # groups emit in the order their first row appears in the
+            # ts-asc — or ts-desc under ORDER BY time DESC — scan, i.e.
+            # by per-group min/max (ts, row) key).
+            k = p.rep_key[group_ids]
+            if p.rep_desc:
+                order = np.lexsort((-k[:, 1], -k[:, 0]))
+            else:
+                order = np.lexsort((k[:, 1], k[:, 0]))
+            group_ids = group_ids[order]
         elif p.codes is not None and group_ids.size:
             keys = []
             for i, t in enumerate(group_tags):
@@ -1027,6 +1420,18 @@ def finalize_partials(
                 for t, v in zip(group_tags, raw)
             )
         )
+    if p.rep_vals:
+        # representative (first-scanned row) values for projected-but-
+        # not-grouped tags, aligned with result.groups
+        for t, vals in p.rep_vals.items():
+            result.rep_tags[t] = [
+                (
+                    qfilter.decode_tag_value(vals[int(g)], measure.tag(t).type)
+                    if vals[int(g)] is not None
+                    else None
+                )
+                for g in group_ids
+            ]
 
     if agg:
         if agg.function == "percentile":

@@ -67,6 +67,10 @@ def result_to_json(res: QueryResult) -> dict:
         "values": {k: _jsonable(list(vs)) for k, vs in res.values.items()},
         "data_points": [_jsonable(dp) for dp in res.data_points],
     }
+    if res.rep_tags:
+        out["rep_tags"] = {
+            t: _jsonable(list(vs)) for t, vs in res.rep_tags.items()
+        }
     if res.trace is not None:
         out["trace"] = res.trace
     return out

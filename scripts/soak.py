@@ -98,13 +98,19 @@ def _random_ql(rng, epoch) -> str:
 
 
 def _norm(res) -> list:
-    """Order-independent comparable form with float rounding."""
+    """Order-independent comparable form.
+
+    Floats round to 5 SIGNIFICANT digits — the engine's device kernels
+    carry an f32 accumulation contract (~1e-5 relative,
+    query/measure_exec.py docstring) and different topologies partition
+    chunks differently, so float aggregates may differ by accumulation
+    order within that bound.  Counts/ints compare exactly."""
 
     def r(v):
         if isinstance(v, (list, tuple)):
             return tuple(r(x) for x in v)
         if isinstance(v, float):
-            return round(v, 6)
+            return float(f"{v:.5g}") if v == v else v
         return v
 
     if res.data_points:
@@ -145,14 +151,39 @@ def run_soak(
 
     transport = LocalTransport()
     nodes = []
+    datanodes = []
     for i in range(2):
         reg = SchemaRegistry(f"{root}/n{i}")
         _schema(reg, shard_num=4)
         dn = DataNode(f"d{i}", reg, f"{root}/n{i}/data")
+        datanodes.append(dn)
         nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
     lreg = SchemaRegistry(f"{root}/l")
     _schema(lreg, shard_num=4)
     liaison = Liaison(lreg, transport, nodes)
+
+    # Third topology: a mesh-fastpath liaison over the SAME data-node
+    # engines (psum/pmin/pmax collectives, parallel/mesh_query.py) —
+    # engaged when JAX exposes >=2 devices (force 8 CPU devices via
+    # XLA_FLAGS=--xla_force_host_platform_device_count=8; a
+    # single-device run soaks two topologies only).
+    mesh_liaison = None
+    try:
+        import jax
+
+        ndev = len(jax.devices())
+        if ndev >= 2:
+            from banyandb_tpu.parallel import make_mesh
+
+            mreg = SchemaRegistry(f"{root}/lm")
+            _schema(mreg, shard_num=4)
+            mesh_liaison = Liaison(mreg, transport, nodes)
+            mesh_liaison.enable_mesh_fastpath(
+                make_mesh(ndev // 2, 2),
+                {dn.name: dn.measure for dn in datanodes},
+            )
+    except Exception:  # noqa: BLE001 — mesh topology is best-effort extra
+        mesh_liaison = None
 
     stats = {"queries": 0, "writes": 0, "divergences": 0, "errors": 0}
     report = open(report_path, "a") if report_path else None
@@ -181,8 +212,12 @@ def run_soak(
             ql = _random_ql(rng, epoch)
             try:
                 req = bydbql.parse(ql)
-                a = _norm(standalone.query(req))
-                b = _norm(liaison.query_measure(req))
+                results = {
+                    "standalone": _norm(standalone.query(req)),
+                    "cluster": _norm(liaison.query_measure(req)),
+                }
+                if mesh_liaison is not None:
+                    results["mesh"] = _norm(mesh_liaison.query_measure(req))
             except Exception as e:  # noqa: BLE001 - soak must survive
                 stats["errors"] += 1
                 if report:
@@ -190,13 +225,14 @@ def run_soak(
                     report.flush()
                 stats["queries"] += 1
                 continue
-            if a != b:
+            base_topo = results["standalone"]
+            if any(v != base_topo for v in results.values()):
                 stats["divergences"] += 1
                 if report:
                     report.write(
                         json.dumps(
                             {"ql": ql, "epoch": epoch,
-                             "standalone": a[:50], "cluster": b[:50]},
+                             **{k: v[:50] for k, v in results.items()}},
                             default=str,
                         )
                         + "\n"

@@ -15,6 +15,7 @@ from banyandb_tpu.index import (
     TermQuery,
 )
 from banyandb_tpu.api import (
+    IntervalRule,
     Aggregation,
     Catalog,
     Condition,
@@ -158,7 +159,8 @@ def test_series_pruning_correctness(engine):
 
 def test_two_index_mode_measures_do_not_mix(tmp_path):
     reg = SchemaRegistry(tmp_path)
-    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_group(Group("g", Catalog.MEASURE,
+                       ResourceOpts(shard_num=1, ttl=IntervalRule(20000, "day"))))
     for name, nfields in (("a", 2), ("b", 1)):
         reg.create_measure(
             Measure(
@@ -185,7 +187,8 @@ def test_index_mode_survives_lifecycle_restart(tmp_path):
     import time as _time
 
     reg = SchemaRegistry(tmp_path)
-    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_group(Group("g", Catalog.MEASURE,
+                       ResourceOpts(shard_num=1, ttl=IntervalRule(20000, "day"))))
     reg.create_measure(
         Measure(
             group="g", name="attrs",
@@ -210,7 +213,8 @@ def test_index_mode_survives_lifecycle_restart(tmp_path):
 
 def test_index_mode_measure(tmp_path):
     reg = SchemaRegistry(tmp_path)
-    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_group(Group("g", Catalog.MEASURE,
+                       ResourceOpts(shard_num=1, ttl=IntervalRule(20000, "day"))))
     reg.create_measure(
         Measure(
             group="g", name="attrs",
@@ -221,31 +225,37 @@ def test_index_mode_measure(tmp_path):
         )
     )
     eng = MeasureEngine(reg, tmp_path / "data")
+    # Index-mode docs are SERIES-KEYED UPSERTS (ref DocID =
+    # uint64(series.ID), write_standalone.go:89): each series holds its
+    # LATEST point only, so 100 writes over 4 entities leave 4 docs.
     pts = tuple(
-        DataPointValue(T0 + i, {"svc": f"s{i % 4}", "ver": f"v{i % 2}"}, {"cnt": i}, version=1)
+        DataPointValue(T0 + i, {"svc": f"s{i % 4}", "ver": f"v{i % 2}"},
+                       {"cnt": i}, version=i + 1)
         for i in range(100)
     )
     eng.write(WriteRequest("g", "attrs", pts))
 
-    # raw retrieval
+    # raw retrieval: the latest point of the s1 series (i=97)
     r = eng.query(
         QueryRequest(("g",), "attrs", TimeRange(T0, T0 + 1000),
                      criteria=Condition("svc", "eq", "s1"), limit=100)
     )
-    assert len(r.data_points) == 25
-    assert all(dp["tags"]["svc"] == "s1" for dp in r.data_points)
+    assert len(r.data_points) == 1
+    assert r.data_points[0]["tags"]["svc"] == "s1"
+    assert r.data_points[0]["fields"]["cnt"] == 97.0
 
-    # aggregate over index docs through the same device executor
+    # aggregate over the 4 series docs: latest ver per series is
+    # s0->v0(i96), s1->v1(i97), s2->v0(i98), s3->v1(i99)
     r = eng.query(
         QueryRequest(("g",), "attrs", TimeRange(T0, T0 + 1000),
                      group_by=GroupBy(("ver",)), agg=Aggregation("count", "cnt"))
     )
     got = dict(zip([g[0] for g in r.groups], r.values["count"]))
-    assert got == {"v0": 50.0, "v1": 50.0}
+    assert got == {"v0": 2.0, "v1": 2.0}
 
-    # dedup: overwrite (series, ts) with higher version
+    # upsert: a higher-version write replaces the series' doc
     eng.write(WriteRequest("g", "attrs", (
-        DataPointValue(T0, {"svc": "s0", "ver": "v9"}, {"cnt": 123}, version=9),)))
+        DataPointValue(T0, {"svc": "s0", "ver": "v9"}, {"cnt": 123}, version=1000),)))
     r = eng.query(
         QueryRequest(("g",), "attrs", TimeRange(T0, T0 + 1),
                      field_projection=("cnt",), limit=10)
