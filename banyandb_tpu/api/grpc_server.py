@@ -456,6 +456,20 @@ class WireServices:
             )
             if rule is None:
                 raise KeyError(f"topn rule {req.name} not found")
+            # ranked entities display the SOURCE measure's entity tuple
+            # (reference TopNList item shape); conditions filter over
+            # entity + rule group-by dims inside query_topn
+            src_m = self.registry.get_measure(
+                rule.source_group or group, rule.source_measure
+            )
+            group_tags = tuple(src_m.entity.tag_names)
+            conds = []
+            for c in req.conditions:
+                op = wire._COND_OP.get(c.op, "eq")
+                if op not in ("eq", "ne", "in", "not_in"):
+                    raise ValueError(f"TopN condition op {op} not supported")
+                conds.append((c.name, op, wire.tag_value_to_py(c.value)))
+
             ranked = topn_mod.query_topn(
                 self.measure,
                 group,
@@ -467,16 +481,29 @@ class WireServices:
                 n=req.top_n or 10,
                 direction="asc" if req.field_value_sort == 2 else "desc",
                 agg=wire._AGG_FN.get(req.agg, "sum"),
+                conditions=tuple(conds),
             )
+            # the output value is typed like the SOURCE measure's field
+            # (int64 aggregation stays integral, mean truncates)
+            as_int = False
+            try:
+                as_int = src_m.field(rule.field_name).type.name == "INT"
+            except KeyError:
+                pass
             out = pb.measure_topn_pb2.TopNResponse()
             lst = out.lists.add()
-            group_tags = tuple(rule.group_by_tag_names)
             for entity, value in ranked:
                 item = lst.items.add()
                 for name, v in zip(group_tags, entity):
                     t = item.entity.add(key=name)
-                    t.value.CopyFrom(wire.py_to_tag_value(v))
-                item.value.CopyFrom(wire.py_to_field_value(float(value)))
+                    # the empty value renders as null (a row written
+                    # without the tag)
+                    t.value.CopyFrom(wire.py_to_tag_value(v or None))
+                item.value.CopyFrom(
+                    wire.py_to_field_value(
+                        int(value) if as_int else float(value)
+                    )
+                )
             return out
         except Exception as e:  # noqa: BLE001
             _abort(context, e)

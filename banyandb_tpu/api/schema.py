@@ -211,6 +211,11 @@ class TopNAggregation:
     # group of the source measure when it differs from the rule's group
     # ("" = same group); wire Get/List must round-trip this faithfully
     source_group: str = ""
+    # optional ingest-time filter (database/v1 TopNAggregation.criteria):
+    # only source rows matching it feed the windows.  Stored as the
+    # protobuf-JSON dict of the model/v1 Criteria (registry persistence
+    # stays plain JSON); None = no filter.
+    criteria: Optional[dict] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from google.protobuf import json_format
+
 from banyandb_tpu.api import model as im
 from banyandb_tpu.api import pb
 from banyandb_tpu.api import schema as isch
@@ -806,6 +808,9 @@ def index_rule_binding_to_pb(b: isch.IndexRuleBinding):
     return out
 
 
+_SORT_TOPN_RULE = {0: "all", 1: "desc", 2: "asc"}
+
+
 def topn_to_internal(t) -> isch.TopNAggregation:
     src_group = t.source_measure.group
     return isch.TopNAggregation(
@@ -813,11 +818,18 @@ def topn_to_internal(t) -> isch.TopNAggregation:
         name=t.metadata.name,
         source_measure=t.source_measure.name,
         field_name=t.field_name,
-        field_value_sort=_SORT_TOPN.get(t.field_value_sort, "desc"),
+        # SORT_UNSPECIFIED on a RULE keeps BOTH directions (the rule can
+        # then serve top AND bottom queries; ref topn.go sort handling)
+        field_value_sort=_SORT_TOPN_RULE.get(t.field_value_sort, "desc"),
         group_by_tag_names=tuple(t.group_by_tag_names),
         counters_number=t.counters_number or 1000,
         lru_size=t.lru_size or 10,
         source_group="" if src_group in ("", t.metadata.group) else src_group,
+        criteria=(
+            json_format.MessageToDict(t.criteria)
+            if t.HasField("criteria")
+            else None
+        ),
     )
 
 
@@ -828,8 +840,10 @@ def topn_to_pb(t: isch.TopNAggregation):
     out.source_measure.group = t.source_group or t.group
     out.source_measure.name = t.source_measure
     out.field_name = t.field_name
-    out.field_value_sort = 2 if t.field_value_sort == "asc" else 1
+    out.field_value_sort = {"asc": 2, "desc": 1}.get(t.field_value_sort, 0)
     out.group_by_tag_names.extend(t.group_by_tag_names)
     out.counters_number = t.counters_number
     out.lru_size = t.lru_size
+    if t.criteria:
+        json_format.ParseDict(t.criteria, out.criteria)
     return out

@@ -41,6 +41,105 @@ RESULT_MEASURE = "_top_n_result"
 _SEP = "\x01"
 
 
+def rule_key_tags(rule: TopNAggregation, m: Measure) -> tuple[str, ...]:
+    """Counter-key dimensions: the source measure's entity tags plus any
+    rule group-by tags beyond them.  Results DISPLAY the entity prefix;
+    the extra dims exist so query conditions (e.g. http.uri = null) can
+    filter counters (ref null_group/eq goldens)."""
+    ent = tuple(m.entity.tag_names)
+    extras = tuple(
+        t for t in rule.group_by_tag_names if t not in ent
+    )
+    return ent + extras
+
+
+def _key_str(v) -> str:
+    """Canonical STRING domain for counter keys, criteria literals and
+    query conditions alike: None (absent/null) -> "", bytes decode,
+    everything else str() — one domain so the row path, the columnar
+    path and query-time filters can never disagree on a value."""
+    if v is None:
+        return ""
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    return str(v)
+
+
+def _canon_cond_value(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_key_str(x) for x in v)
+    return _key_str(v)
+
+
+def _rule_criteria(rule: TopNAggregation):
+    """Parsed ingest-time Criteria for the rule (None = no filter), with
+    condition literals canonicalized into the string key domain."""
+    if not rule.criteria:
+        return None
+    from google.protobuf import json_format
+
+    from banyandb_tpu.api import pb, wire
+    from banyandb_tpu.api.model import Condition as _C
+    from banyandb_tpu.api.model import LogicalExpression as _LE
+
+    crit = pb.model_query_pb2.Criteria()
+    json_format.ParseDict(rule.criteria, crit)
+
+    def canon(node):
+        if node is None:
+            return None
+        if isinstance(node, _C):
+            return _C(node.name, node.op, _canon_cond_value(node.value))
+        assert isinstance(node, _LE)
+        return _LE(node.op, canon(node.left), canon(node.right))
+
+    return canon(wire.criteria_to_internal(crit))
+
+
+def _crit_tag_names(crit) -> set:
+    """Tag names referenced by a (canonicalized) criteria tree."""
+    from banyandb_tpu.api.model import Condition as _C
+
+    out: set = set()
+
+    def walk(node):
+        if node is None:
+            return
+        if isinstance(node, _C):
+            out.add(node.name)
+        else:
+            walk(node.left)
+            walk(node.right)
+
+    walk(crit)
+    return out
+
+
+def _row_matches(tags: dict, crit) -> bool:
+    """Evaluate a canonicalized Criteria tree over string-domain tag
+    values (tags values already through _key_str)."""
+    from banyandb_tpu.api.model import Condition as _C
+    from banyandb_tpu.api.model import LogicalExpression as _LE
+
+    if crit is None:
+        return True
+    if isinstance(crit, _C):
+        v = tags.get(crit.name, "")
+        if crit.op == "eq":
+            return v == crit.value
+        if crit.op == "ne":
+            return v != crit.value
+        if crit.op == "in":
+            return v in crit.value
+        if crit.op == "not_in":
+            return v not in crit.value
+        raise ValueError(f"topn rule criteria op {crit.op!r} not supported")
+    assert isinstance(crit, _LE)
+    left = _row_matches(tags, crit.left)
+    right = _row_matches(tags, crit.right)
+    return (left and right) if crit.op == "and" else (left or right)
+
+
 def result_measure_schema(group: str) -> Measure:
     """The shared result measure (storage-and-format.md §3.5 analog)."""
     return Measure(
@@ -60,10 +159,20 @@ def result_measure_schema(group: str) -> Measure:
 class _Window:
     start: int
     sums: dict  # entity tuple -> [sum, count]
+    dirty: bool = True  # has un-emitted accumulation
 
 
 class TopNProcessorManager:
-    """Per-engine manager: routes measure writes into rule windows."""
+    """Per-engine manager: routes measure writes into rule windows.
+
+    Window lifecycle follows the reference's streaming processor: a
+    window whose close time the watermark passed EMITS its ranked
+    counters, but its state is KEPT so late rows keep accumulating and
+    re-emit the window with a higher version — the result measure's
+    (series, window-start) dedup replaces the earlier emission.  Memory
+    is bounded per rule by lru_size windows (TopNAggregation.lru_size):
+    the oldest window is finally emitted and evicted when the bound is
+    exceeded — only data older than the eviction horizon is dropped."""
 
     def __init__(
         self,
@@ -78,8 +187,17 @@ class TopNProcessorManager:
         # (group, rule name) -> {window_start -> _Window}
         self._windows: dict[tuple, dict[int, _Window]] = defaultdict(dict)
         self._watermark: dict[tuple, int] = {}
-        self._closed_until: dict[tuple, int] = {}  # drop-late boundary
         self._emit_seq = 0
+        # parsed rule-criteria cache: (group, rule) -> (criteria_dict, tree)
+        self._crit_cache: dict[tuple, tuple] = {}
+
+    def _cached_criteria(self, key: tuple, rule: TopNAggregation):
+        hit = self._crit_cache.get(key)
+        if hit is not None and hit[0] == rule.criteria:
+            return hit[1]
+        parsed = _rule_criteria(rule)
+        self._crit_cache[key] = (rule.criteria, parsed)
+        return parsed
 
     def observe(self, m: Measure, p: DataPointValue) -> None:
         """Feed one written point through all TopN rules of its measure."""
@@ -87,19 +205,29 @@ class TopNProcessorManager:
             if rule.source_measure != m.name:
                 continue
             key = (m.group, rule.name)
-            start = p.ts_millis - (p.ts_millis % self.window_millis)
-            if start < self._closed_until.get(key, 0):
-                # Tumbling-window contract: data later than the watermark's
-                # closed boundary is dropped (re-opening a closed window
-                # would emit a duplicate (series, ts) result row that
-                # dedup resolves arbitrarily).
+            # criteria filter runs BEFORE any window allocation: rejected
+            # rows must not create empty windows (they would prematurely
+            # LRU-evict real ones)
+            crit = self._cached_criteria(key, rule)
+            if crit is not None and not _row_matches(
+                {
+                    t: _key_str(p.tags.get(t))
+                    for t in _crit_tag_names(crit)
+                },
+                crit,
+            ):
                 continue
-            win = self._windows[key].get(start)
+            start = p.ts_millis - (p.ts_millis % self.window_millis)
+            wins = self._windows[key]
+            win = wins.get(start)
             if win is None:
-                win = self._windows[key][start] = _Window(start, {})
+                win = wins[start] = _Window(start, {})
+                self._evict_over_lru(key, rule)
+            # counters key = entity tags + extra group-by dims (results
+            # display the entity prefix; extras serve conditions)
             ent = tuple(
-                str(p.tags.get(t, "")) for t in rule.group_by_tag_names
-            ) or (str(p.tags.get(m.entity.tag_names[0], "")),)
+                _key_str(p.tags.get(t)) for t in rule_key_tags(rule, m)
+            )
             acc = win.sums.get(ent)
             if acc is None:
                 if len(win.sums) >= rule.counters_number:
@@ -107,10 +235,20 @@ class TopNProcessorManager:
                 acc = win.sums[ent] = [0.0, 0]
             acc[0] += float(p.fields.get(rule.field_name, 0))
             acc[1] += 1
+            win.dirty = True
             wm = self._watermark.get(key, 0)
             if p.ts_millis > wm:
                 self._watermark[key] = p.ts_millis
             self._flush_closed(key, rule)
+
+    def _evict_over_lru(self, key: tuple, rule: TopNAggregation) -> None:
+        wins = self._windows[key]
+        bound = max(int(rule.lru_size or 10), 2)
+        while len(wins) > bound:
+            oldest = min(wins)
+            win = wins.pop(oldest)
+            if win.dirty:
+                self._emit(key[0], rule, win)
 
     def observe_columns(self, m: Measure, ts_millis, tags, fields) -> None:
         """Columnar twin of observe(): feed a bulk write's columns through
@@ -134,12 +272,7 @@ class TopNProcessorManager:
         if n == 0:
             return
 
-        def as_str(v) -> str:
-            if v is None:
-                return ""
-            if isinstance(v, bytes):
-                return v.decode(errors="replace")
-            return str(v)
+        as_str = _key_str  # one canonical string domain (module helper)
 
         # batch-level decode, shared across rules (starts/ts once; tag
         # string columns memoized per tag)
@@ -172,27 +305,27 @@ class TopNProcessorManager:
                 if fvals is not None
                 else [0.0] * n
             )
-            gtags = tuple(rule.group_by_tag_names) or (m.entity.tag_names[0],)
+            # per-source-series counters + extra group-by dims
+            gtags = rule_key_tags(rule, m)
             cols = [col_of(t) for t in gtags]
+            crit = self._cached_criteria(key, rule)
+            crit_tags = None
+            if crit is not None:
+                # string-domain columns for every referenced tag (the
+                # same _key_str domain the canonicalized tree carries)
+                crit_tags = {t: col_of(t) for t in _crit_tag_names(crit)}
             wins = self._windows[key]
             wm = self._watermark.get(key, 0)
-            horizon = self.window_millis + self.lateness_millis
-            # windows close as the watermark advances THROUGH the batch
-            # (row-path parity: a late row after a mid-batch closure is
-            # dropped, not re-accumulated); track the earliest open
-            # window's close time so the flush check is O(1) per row
-            next_close = min((s + horizon for s in wins), default=None)
-            closed = self._closed_until.get(key, 0)
             for i in range(n):
+                if crit_tags is not None and not _row_matches(
+                    {t: col[i] for t, col in crit_tags.items()}, crit
+                ):
+                    continue
                 start = starts[i]
-                if start < closed:
-                    continue  # tumbling-window late-drop (see observe())
                 win = wins.get(start)
                 if win is None:
                     win = wins[start] = _Window(start, {})
-                    close_at = start + horizon
-                    if next_close is None or close_at < next_close:
-                        next_close = close_at
+                    self._evict_over_lru(key, rule)
                 ent = tuple(c[i] for c in cols)
                 acc = win.sums.get(ent)
                 if acc is None:
@@ -201,37 +334,28 @@ class TopNProcessorManager:
                     acc = win.sums[ent] = [0.0, 0]
                 acc[0] += fvals[i]
                 acc[1] += 1
+                win.dirty = True
                 if tsl[i] > wm:
                     wm = tsl[i]
-                    self._watermark[key] = wm
-                # row-path parity: observe() runs _flush_closed after
-                # EVERY point, so a window already at-or-past the
-                # watermark's close boundary (late row into a window the
-                # watermark has overtaken) emits immediately and
-                # subsequent late rows drop — not only when wm advances
-                if next_close is not None and wm >= next_close:
-                    self._flush_closed(key, rule)
-                    closed = self._closed_until.get(key, 0)
-                    next_close = min(
-                        (s + horizon for s in wins), default=None
-                    )
             self._watermark[key] = wm
+            self._flush_closed(key, rule)
 
     def _flush_closed(self, key: tuple, rule: TopNAggregation) -> None:
+        """Emit every DIRTY window the watermark has passed, KEEPING its
+        state: a late row re-dirties the window and the next flush
+        re-emits it with a higher version (the result measure's
+        (series, window) dedup replaces the earlier rows)."""
         wm = self._watermark.get(key, 0)
-        closed = [
-            s
-            for s in self._windows[key]
-            if s + self.window_millis + self.lateness_millis <= wm
-        ]
-        for start in closed:
-            self._closed_until[key] = max(
-                self._closed_until.get(key, 0), start + self.window_millis
-            )
-            self._emit(key[0], rule, self._windows[key].pop(start))
+        for start, win in self._windows[key].items():
+            if (
+                win.dirty
+                and start + self.window_millis + self.lateness_millis <= wm
+            ):
+                win.dirty = False
+                self._emit(key[0], rule, win)
 
     def flush_all_windows(self) -> None:
-        """Close every open window (shutdown / test hook)."""
+        """Emit every dirty window (shutdown / test hook); state kept."""
         for (group, rname), wins in list(self._windows.items()):
             rule = next(
                 (r for r in self.engine.registry.list_topn(group) if r.name == rname),
@@ -239,8 +363,10 @@ class TopNProcessorManager:
             )
             if rule is None:
                 continue
-            for start in list(wins):
-                self._emit(group, rule, wins.pop(start))
+            for win in wins.values():
+                if win.dirty:
+                    win.dirty = False
+                    self._emit(group, rule, win)
 
     def _emit(self, group: str, rule: TopNAggregation, win: _Window) -> None:
         if not win.sums:
@@ -289,10 +415,35 @@ def query_topn(
     n: int = 10,
     direction: str = "desc",
     agg: str = "sum",
+    conditions: tuple = (),
 ) -> list[tuple[tuple, float]]:
-    """Re-rank across windows (topn_post_processor.go analog)."""
+    """Re-rank across windows (topn_post_processor.go analog).
+
+    conditions: (tag, op, value) filters over the counter key dims
+    (entity tags + rule group-by extras); "" counters compare as None.
+    Distinct-best step (topn_plan_distinct.go): each DISPLAYED entity
+    (the source measure's entity prefix) keeps its extreme surviving
+    window value in the query direction; the aggregation then applies
+    over that single distinct item — sum/max/min/mean all equal it,
+    count is 1."""
     from banyandb_tpu.api.model import Aggregation, Condition, GroupBy, LogicalExpression
 
+    rule = next(
+        (r for r in engine.registry.list_topn(group) if r.name == rule_name),
+        None,
+    )
+    if rule is None:
+        raise KeyError(f"topn rule {rule_name} not found")
+    src = engine.registry.get_measure(
+        rule.source_group or group, rule.source_measure
+    )
+    key_tags = rule_key_tags(rule, src)
+    ent_n = len(src.entity.tag_names)
+    for name, _op, _v in conditions:
+        if name not in key_tags:
+            raise ValueError(f"TopN condition on unknown tag {name!r}")
+
+    extreme = "max" if direction == "desc" else "min"
     req = QueryRequest(
         groups=(group,),
         name=RESULT_MEASURE,
@@ -303,14 +454,34 @@ def query_topn(
             Condition("sort", "eq", direction),
         ),
         group_by=GroupBy(("entity",)),
-        agg=Aggregation(agg, "value"),
+        agg=Aggregation(extreme, "value"),
         limit=0,
     )
     res = engine.query(req)
-    key = f"{agg}(value)"
-    pairs = [
-        (tuple(g[0].split(_SEP)), v)
-        for g, v in zip(res.groups, res.values[key])
-    ]
-    pairs.sort(key=lambda kv: kv[1], reverse=(direction == "desc"))
+    key = f"{extreme}(value)"
+
+    # conditions evaluate through the SAME canonical string domain and
+    # evaluator as ingest-time rule criteria (no second implementation)
+    conds_canon = tuple(
+        Condition(nm, op, _canon_cond_value(v)) for nm, op, v in conditions
+    )
+
+    def cond_ok(full: tuple) -> bool:
+        by = dict(zip(key_tags, full))
+        return all(_row_matches(by, c) for c in conds_canon)
+
+    best: dict[tuple, float] = {}
+    for g, v in zip(res.groups, res.values[key]):
+        full = tuple(g[0].split(_SEP))
+        if not cond_ok(full):
+            continue
+        disp = full[:ent_n]
+        cur = best.get(disp)
+        if cur is None or (v > cur if direction == "desc" else v < cur):
+            best[disp] = v
+    pairs = sorted(
+        best.items(), key=lambda kv: kv[1], reverse=(direction == "desc")
+    )
+    if agg == "count":  # one distinct item per entity reaches the agg
+        return [(ent, 1.0) for ent, _ in pairs[:n]]
     return pairs[:n]
