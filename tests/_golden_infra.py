@@ -240,11 +240,6 @@ def load_stream_schemas(chan):
         req = rpc.StreamRegistryServiceCreateRequest()
         yaml_to_pb(f, req.stream)
         _create(s_create, req)
-    dedup = base / "deduplication_test.json"
-    if dedup.exists():
-        req = rpc.StreamRegistryServiceCreateRequest()
-        yaml_to_pb(dedup, req.stream)
-        _create(s_create, req)
     _load_rules_bindings(chan, base)
 
 
