@@ -27,6 +27,8 @@ class AccessLog:
         self.slow_query_ms = slow_query_ms
         self.max_bytes = max_bytes
         self._lock = threading.Lock()
+        # bdlint: disable=resource-hygiene -- log handle lives as long as
+        # the AccessLog; closed by close() and across rotation in _emit
         self._f = open(self.path, "a", buffering=1)
 
     def _emit(self, record: dict) -> None:
@@ -37,6 +39,8 @@ class AccessLog:
                 self._f.close()
                 rotated = self.path.with_name(self.path.name + ".1")
                 self.path.replace(rotated)
+                # bdlint: disable=resource-hygiene -- rotation replaces
+                # the owned handle just closed above
                 self._f = open(self.path, "a", buffering=1)
             self._f.write(json.dumps(record) + "\n")
 

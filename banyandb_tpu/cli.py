@@ -162,7 +162,8 @@ def main(argv=None) -> int:
     elif args.cmd == "write":
         points = [json.loads(p) for p in args.point]
         if args.file:
-            points += json.loads(open(args.file).read())
+            with open(args.file) as fh:
+                points += json.loads(fh.read())
         env = {
             "request": {
                 "group": args.group,

@@ -27,6 +27,17 @@ from banyandb_tpu.cluster.rpc import TransportError
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.utils import hashing
 
+# RPC deadline tiers (the rpc-timeout contract, docs/linting.md): every
+# fabric call states the stall it tolerates.  Probes stay snappy so the
+# alive set converges; control-plane pushes are bounded so a dead peer
+# can't wedge schema rollout; data-plane queries get room for real
+# scans; bulk part sync moves whole files.
+_RPC_PROBE_S = 5.0
+_RPC_CONTROL_S = 10.0
+_RPC_WRITE_S = 15.0
+_RPC_QUERY_S = 30.0
+_RPC_SYNC_S = 120.0
+
 
 def _sort_merged_rows(rows: list, req, *, default_desc: bool = True) -> None:
     """Order scattered rows at the liaison merge: by tag value when the
@@ -101,7 +112,9 @@ class Liaison:
         alive = set()
         for n in self.selector.nodes:
             try:
-                r = self.transport.call(n.addr, Topic.HEALTH.value, {}, timeout=5)
+                r = self.transport.call(
+                    n.addr, Topic.HEALTH.value, {}, timeout=_RPC_PROBE_S
+                )
                 if r.get("status") == "ok":
                     alive.add(n.name)
             except TransportError:
@@ -116,8 +129,13 @@ class Liaison:
                 if node.name in alive and self.handoff.pending(node.name):
                     self.handoff.replay(
                         node.name,
+                        # spooled envelopes include write fan-out from
+                        # the _replicate failure path: give replay the
+                        # write budget, or a heavy spooled write that
+                        # would succeed live strands the whole spool
+                        # (replay stops at the first failure)
                         lambda topic, env, addr=node.addr: self.transport.call(
-                            addr, topic, env
+                            addr, topic, env, timeout=_RPC_WRITE_S
                         ),
                     )
         return alive
@@ -149,7 +167,10 @@ class Liaison:
                     self.handoff.spool(n.name, Topic.SCHEMA_SYNC.value, env)
                 continue
             try:
-                r = self.transport.call(n.addr, Topic.SCHEMA_SYNC.value, env)
+                r = self.transport.call(
+                    n.addr, Topic.SCHEMA_SYNC.value, env,
+                    timeout=_RPC_CONTROL_S,
+                )
                 acks[n.name] = {
                     "revision": r.get("revision", 0),
                     "obj_rev": r.get("obj_rev", 0),
@@ -338,7 +359,9 @@ class Liaison:
         first_shed: Optional[TransportError] = None
         for name, env in by_node_env.items():
             try:
-                self.transport.call(addr_of[name], topic, env)
+                self.transport.call(
+                    addr_of[name], topic, env, timeout=_RPC_WRITE_S
+                )
                 delivered_to.add(name)
             except TransportError as e:
                 failed[name] = env  # spooled below (shed AND dead alike)
@@ -453,7 +476,8 @@ class Liaison:
         for node, shards in assignment.items():
             env = dict(env_base, shards=shards)
             r = self.transport.call(
-                node.addr, Topic.MEASURE_QUERY_PARTIAL.value, env
+                node.addr, Topic.MEASURE_QUERY_PARTIAL.value, env,
+                timeout=_RPC_QUERY_S,
             )
             out.append(serde.partials_from_json(r["partials"]))
         return out
@@ -503,6 +527,7 @@ class Liaison:
                         "request": serde.query_request_to_json(node_req),
                         "shards": shards,
                     },
+                    timeout=_RPC_QUERY_S,
                 )
                 rows.extend(r["data_points"])
             _sort_merged_rows(rows, req, default_desc=False)  # measure: ASC
@@ -617,6 +642,7 @@ class Liaison:
                 node.addr,
                 Topic.STREAM_QUERY.value,
                 {"request": serde.query_request_to_json(node_req), "shards": shards},
+                timeout=_RPC_QUERY_S,
             )
             rows.extend(r["data_points"])
         _sort_merged_rows(rows, req)
@@ -671,6 +697,7 @@ class Liaison:
             node.addr,
             Topic.TRACE_QUERY_BY_ID.value,
             {"group": group, "name": name, "trace_id": trace_id},
+            timeout=_RPC_QUERY_S,
         )
         import base64
 
@@ -713,6 +740,7 @@ class Liaison:
                     "end": time_range.end_millis,
                     "lo": lo, "hi": hi, "asc": asc, "limit": limit,
                 },
+                timeout=_RPC_QUERY_S,
             )
             streams.append([(int(k), tid) for k, tid in r["results"]])
         merged = heapq.merge(*streams, key=lambda kt: kt[0] if asc else -kt[0])
@@ -765,7 +793,8 @@ class ChunkedSyncClient:
             "shard": shard,
         }
         self.transport.call(
-            self.addr, Topic.SYNC_PART.value, dict(base, phase="begin")
+            self.addr, Topic.SYNC_PART.value, dict(base, phase="begin"),
+            timeout=_RPC_SYNC_S,
         )
         for f in sorted(part_dir.iterdir()):
             data = f.read_bytes()
@@ -786,8 +815,10 @@ class ChunkedSyncClient:
                         data=base64.b64encode(blob).decode(),
                         crc32=zlib.crc32(blob),
                     ),
+                    timeout=_RPC_SYNC_S,
                 )
         r = self.transport.call(
-            self.addr, Topic.SYNC_PART.value, dict(base, phase="finish")
+            self.addr, Topic.SYNC_PART.value, dict(base, phase="finish"),
+            timeout=_RPC_SYNC_S,
         )
         return r["introduced"]

@@ -1,0 +1,33 @@
+"""bdlint — project-native static analysis for banyandb-tpu.
+
+Machine-checks the invariants that keep the TPU hot path hot and the
+cluster fabric live — the failure classes code review keeps missing
+(docs/linting.md has the full rule catalog):
+
+- ``host-sync``        accidental device->host round-trips in hot modules
+- ``recompile-hazard`` per-call jit wrapper churn / trace-time formatting
+- ``rpc-timeout``      fabric calls that can block forever
+- ``lock-across-rpc``  locks held across blocking network calls
+- ``retry-backoff``    retry loops that hammer without sleeping
+- ``resource-hygiene`` files/sockets opened outside context managers
+- ``precision-drift``  implicit float64 promotion in kernel paths
+
+Usage::
+
+    python -m banyandb_tpu.lint --check banyandb_tpu
+    python -m banyandb_tpu.lint --format json path/to/file.py
+
+Per-line suppression (same line or the comment line directly above)::
+
+    x = np.asarray(out)  # bdlint: disable=host-sync -- boundary transfer
+"""
+
+from banyandb_tpu.lint.core import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+    lint_file,
+    lint_paths,
+    lint_source,
+    render_json,
+    render_text,
+)

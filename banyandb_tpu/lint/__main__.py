@@ -1,0 +1,76 @@
+"""CLI: ``python -m banyandb_tpu.lint [--check] [--format json] PATH...``
+
+Exit codes: without ``--check`` the run is report-only (exit 0 even
+with findings — the editor/exploration mode); ``--check`` is the CI
+gate (exit 1 on any finding); 2 on usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from banyandb_tpu.lint.core import (
+    all_rules,
+    lint_paths,
+    render_json,
+    render_text,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bdlint",
+        description="banyandb-tpu project-native static analysis",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["banyandb_tpu"],
+        help="files or directories (default: banyandb_tpu)",
+    )
+    ap.add_argument(
+        "--check",
+        action="store_true",
+        help="CI mode: exit 1 on any finding (default: report-only)",
+    )
+    ap.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (json is SARIF-lite, stable ordering)",
+    )
+    ap.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated rule names to run (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for r in rules:
+            scope = ",".join(r.scope) or "(package)"
+            print(f"{r.name:18s} [{scope}] {r.summary}")
+        return 0
+    if args.rules:
+        wanted = {n.strip() for n in args.rules.split(",") if n.strip()}
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            print(f"bdlint: unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.name in wanted]
+
+    findings, summary = lint_paths(args.paths, rules=rules)
+    if args.format == "json":
+        print(render_json(findings, summary))
+    else:
+        print(render_text(findings, summary))
+    return 1 if (findings and args.check) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

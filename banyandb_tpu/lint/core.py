@@ -1,0 +1,271 @@
+"""bdlint engine: file discovery, suppressions, rule running, rendering.
+
+Rules live in rules_jax.py (hot-path invariants) and rules_fabric.py
+(cluster-fabric + resource invariants).  Each rule is an object with
+
+- ``name``     the greppable id used in ``# bdlint: disable=<name>``
+- ``summary``  one line for ``--list-rules``
+- ``scope``    tuple of package-relative path prefixes it applies to
+               (``""`` = the whole package)
+- ``check(ctx) -> Iterable[Finding]``
+
+Scopes are matched against the file's path relative to the
+``banyandb_tpu`` package root, so the hot-path rules fire only in the
+modules where a stray host sync actually costs money (query/, ops/,
+parallel/, index/) while fabric rules cover the whole tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Optional
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*bdlint:\s*(disable|disable-file)=([A-Za-z0-9_,\- ]+)"
+)
+_GENERATED_DIRS = {"pb", "__pycache__"}
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    path: str  # as given to the linter (display path)
+    line: int  # 1-based
+    col: int  # 0-based
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+class FileContext:
+    """Parsed source + shared per-file analyses handed to every rule."""
+
+    def __init__(self, source: str, path: str, rel: str):
+        self.source = source
+        self.path = path
+        self.rel = rel  # package-relative, "/"-separated (scope matching)
+        self.tree = ast.parse(source)
+        self.lines = source.splitlines()
+        self._parents: Optional[dict] = None
+        self._facts = None
+
+    @property
+    def parents(self) -> dict:
+        """child ast node -> parent node map (built on first use)."""
+        if self._parents is None:
+            p: dict = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    p[child] = node
+            self._parents = p
+        return self._parents
+
+    @property
+    def jax_facts(self):
+        """Module-level jit analysis shared by the hot-path rules."""
+        if self._facts is None:
+            from banyandb_tpu.lint.rules_jax import ModuleJaxFacts
+
+            self._facts = ModuleJaxFacts(self.tree)
+        return self._facts
+
+    def finding(self, node: ast.AST, rule: str, message: str) -> Finding:
+        return Finding(
+            path=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            rule=rule,
+            message=message,
+        )
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.lax.psum`` for nested Attribute/Name chains, else ""."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def parse_suppressions(lines: list[str]) -> tuple[dict[int, frozenset], frozenset]:
+    """-> ({1-based line: suppressed rule names}, file-wide suppressions).
+
+    A suppression on a comment-only line applies to the next code line, so
+    long reasons don't have to fight the line-length limit.
+    """
+    per_line: dict[int, set] = {}
+    file_wide: set = set()
+    pending: set = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        stripped = text.strip()
+        names: set = set()
+        if m:
+            # the "-- reason" text must go before splitting on commas,
+            # or a reason like "-- not a leak, host-sync" would widen
+            # the suppression to the named rule
+            spec = m.group(2).split("--", 1)[0]
+            names = {n.strip() for n in spec.split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                file_wide |= names
+                names = set()
+        if stripped.startswith("#") or not stripped:
+            # comment-only or blank line: keep deferring to the next
+            # code line (a reflow that inserts a blank line must not
+            # silently detach an audited suppression)
+            pending |= names
+            continue
+        here = names | pending
+        pending = set()
+        if here:
+            per_line[i] = per_line.get(i, set()) | here
+    return (
+        {k: frozenset(v) for k, v in per_line.items()},
+        frozenset(file_wide),
+    )
+
+
+def _package_rel(path: Path) -> Optional[str]:
+    """Path inside the banyandb_tpu package -> package-relative posix
+    path; None for files outside the package (bdlint is project-native
+    and has nothing to say about them)."""
+    parts = list(path.parts)
+    if "banyandb_tpu" not in parts:
+        return None
+    idx = len(parts) - 1 - parts[::-1].index("banyandb_tpu")
+    rel = parts[idx + 1 :]
+    if any(d in _GENERATED_DIRS for d in rel[:-1]):
+        return None  # generated code (api/pb) is out of audit scope
+    return "/".join(rel)
+
+
+def all_rules() -> list:
+    from banyandb_tpu.lint import rules_fabric, rules_jax
+
+    return list(rules_jax.RULES) + list(rules_fabric.RULES)
+
+
+ALL_RULES = all_rules
+
+
+def lint_source(
+    source: str,
+    rel: str = "",
+    path: str = "<memory>",
+    rules: Optional[list] = None,
+) -> tuple[list[Finding], int]:
+    """Lint one source string as if it lived at package-relative `rel`.
+
+    -> (findings, suppressed_count).  The test suite's entry point.
+    """
+    ctx = FileContext(source, path=path, rel=rel)
+    per_line, file_wide = parse_suppressions(ctx.lines)
+    findings: list[Finding] = []
+    suppressed = 0
+    for rule in rules if rules is not None else all_rules():
+        if rule.scope and not any(rel.startswith(s) for s in rule.scope):
+            continue
+        for f in rule.check(ctx):
+            sup = per_line.get(f.line, frozenset()) | file_wide
+            if f.rule in sup or "all" in sup:
+                suppressed += 1
+            else:
+                findings.append(f)
+    findings.sort()
+    return findings, suppressed
+
+
+def lint_file(
+    path: Path, rules: Optional[list] = None
+) -> tuple[list[Finding], int, bool]:
+    """-> (findings, suppressed, was_linted)."""
+    rel = _package_rel(path)
+    if rel is None:
+        return [], 0, False
+    source = path.read_text(encoding="utf-8")
+    try:
+        findings, suppressed = lint_source(
+            source, rel=rel, path=str(path), rules=rules
+        )
+    except SyntaxError as e:
+        return (
+            [
+                Finding(
+                    path=str(path),
+                    line=e.lineno or 1,
+                    col=e.offset or 0,
+                    rule="parse-error",
+                    message=f"file does not parse: {e.msg}",
+                )
+            ],
+            0,
+            True,
+        )
+    return findings, suppressed, True
+
+
+def lint_paths(
+    paths: Iterable[str], rules: Optional[list] = None
+) -> tuple[list[Finding], dict]:
+    """Walk files/dirs -> (sorted findings, summary stats dict)."""
+    files: list[Path] = []
+    for p in paths:
+        pth = Path(p)
+        if pth.is_dir():
+            files.extend(sorted(pth.rglob("*.py")))
+        elif pth.suffix == ".py":
+            files.append(pth)
+    findings: list[Finding] = []
+    suppressed = 0
+    linted = 0
+    for f in files:
+        got, sup, used = lint_file(f, rules=rules)
+        findings.extend(got)
+        suppressed += sup
+        linted += int(used)
+    findings.sort()
+    return findings, {
+        "files": linted,
+        "findings": len(findings),
+        "suppressed": suppressed,
+    }
+
+
+def render_text(findings: list[Finding], summary: dict) -> str:
+    out = [f.render() for f in findings]
+    out.append(
+        "bdlint: {files} files, {findings} findings, "
+        "{suppressed} suppressed".format(**summary)
+    )
+    return "\n".join(out)
+
+
+def render_json(findings: list[Finding], summary: dict) -> str:
+    """SARIF-lite: stable key order, sorted findings, schema-versioned."""
+    doc = {
+        "version": "1.0",
+        "tool": "bdlint",
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "message": f.message,
+            }
+            for f in findings
+        ],
+        "summary": summary,
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

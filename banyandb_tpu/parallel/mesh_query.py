@@ -153,8 +153,14 @@ class MeshExecutor:
             empty = self._to_partials(plan, gd, None, want_percentile)
             return measure_exec.finalize_partials(m, req, [empty])
 
-        out = dist_exec.distributed_aggregate(
-            self.mesh, plan, chunks, pred_codes=pred_codes
+        import jax
+
+        # bdlint: disable=host-sync -- mesh result boundary: the whole
+        # replicated pytree moves in one batched transfer
+        out = jax.device_get(
+            dist_exec.distributed_aggregate(
+                self.mesh, plan, chunks, pred_codes=pred_codes
+            )
         )
         self.executions += 1
 
@@ -179,13 +185,16 @@ class MeshExecutor:
                 eq_preds=plan.eq_preds,
                 want_hist=f,
             )
-            out = dist_exec.distributed_aggregate(
-                self.mesh,
-                hist_plan,
-                chunks,
-                pred_codes=pred_codes,
-                hist_lo=lo,
-                hist_span=span,
+            # bdlint: disable=host-sync -- second-pass result boundary
+            out = jax.device_get(
+                dist_exec.distributed_aggregate(
+                    self.mesh,
+                    hist_plan,
+                    chunks,
+                    pred_codes=pred_codes,
+                    hist_lo=lo,
+                    hist_span=span,
+                )
             )
             partial = self._to_partials(
                 hist_plan, gd, out, True, hist_lo=lo, hist_span=span
@@ -245,10 +254,10 @@ class MeshExecutor:
             return measure_exec.Partials(
                 group_tags=plan.group_tags,
                 groups=[],
-                count=np.zeros(0),
-                sums={f: np.zeros(0) for f in plan.fields},
-                mins={f: np.zeros(0) for f in plan.fields},
-                maxs={f: np.zeros(0) for f in plan.fields},
+                count=np.zeros(0, dtype=np.float64),
+                sums={f: np.zeros(0, dtype=np.float64) for f in plan.fields},
+                mins={f: np.zeros(0, dtype=np.float64) for f in plan.fields},
+                maxs={f: np.zeros(0, dtype=np.float64) for f in plan.fields},
             )
         count = np.asarray(out["count"], dtype=np.float64)
         nz = np.nonzero(count > 0)[0]

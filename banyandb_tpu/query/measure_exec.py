@@ -327,7 +327,9 @@ def _build_rank_lut(values: list) -> np.ndarray:
     """code -> bytes-lexicographic rank among `values` (inverse argsort)."""
     order = sorted(range(len(values)), key=values.__getitem__)
     lut = np.empty(len(values), dtype=np.int64)
-    lut[np.asarray(order, dtype=np.int64)] = np.arange(len(values))
+    lut[np.asarray(order, dtype=np.int64)] = np.arange(
+        len(values), dtype=np.int64
+    )
     return lut
 
 
@@ -798,8 +800,8 @@ def _reduce_partials(
     G = spec.num_groups
     count = np.zeros(G, dtype=np.float64)
     sums = {f: np.zeros(G, dtype=np.float64) for f in spec.fields}
-    mins = {f: np.full(G, np.inf) for f in spec.fields}
-    maxs = {f: np.full(G, -np.inf) for f in spec.fields}
+    mins = {f: np.full(G, np.inf, dtype=np.float64) for f in spec.fields}
+    maxs = {f: np.full(G, -np.inf, dtype=np.float64) for f in spec.fields}
     hist = np.zeros((G, _NUM_HIST_BUCKETS), dtype=np.float64) if want_percentile else None
     rep_ts_acc = rep_row_acc = None
     if want_rep:
@@ -817,6 +819,39 @@ def _reduce_partials(
         from banyandb_tpu.storage.cache import device_cache
 
         dev_cache = device_cache()
+
+    def _absorb(out: dict) -> None:
+        """Fold ONE chunk's partials (already on host) into the f64
+        accumulators — the host half of the precision contract."""
+        nonlocal count, hist, rep_ts_acc, rep_row_acc
+        count += out["count"].astype(np.float64)
+        for f in spec.fields:
+            sums[f] += out["sums"][f].astype(np.float64)
+            if want_minmax:
+                mins[f] = np.minimum(mins[f], out["mins"][f])
+                maxs[f] = np.maximum(maxs[f], out["maxs"][f])
+        if hist is not None:
+            hist += out["hist"].astype(np.float64)
+        if rep_ts_acc is not None:
+            rts = out["rep_ts"].astype(np.int64) + epoch
+            rrow = out["rep_row"].astype(np.int64)
+            if rep_desc:
+                better = (rts > rep_ts_acc) | (
+                    (rts == rep_ts_acc) & (rrow > rep_row_acc)
+                )
+            else:
+                better = (rts < rep_ts_acc) | (
+                    (rts == rep_ts_acc) & (rrow < rep_row_acc)
+                )
+            rep_ts_acc = np.where(better, rts, rep_ts_acc)
+            rep_row_acc = np.where(better, rrow, rep_row_acc)
+
+    # One-deep dispatch pipeline: chunk k's device->host transfer happens
+    # AFTER chunk k+1's kernel is dispatched, so transfer overlaps
+    # compute.  The whole result pytree moves in a single batched
+    # device_get per chunk instead of one blocking np.asarray per column
+    # (the 29-site host-sync audit that motivated bdlint).
+    pending = None
     for start in range(0, max(n, 1), spec.nrows):
         end = min(start + spec.nrows, n)
         if end <= start:
@@ -840,27 +875,14 @@ def _reduce_partials(
         else:
             chunk = _device_chunk(chunks_np, start, end, spec, epoch)
         out = kernel(chunk, pred_vals, hist_lo_dev, hist_span_dev)
-        count += np.asarray(out["count"], dtype=np.float64)
-        for f in spec.fields:
-            sums[f] += np.asarray(out["sums"][f], dtype=np.float64)
-            if want_minmax:
-                mins[f] = np.minimum(mins[f], np.asarray(out["mins"][f]))
-                maxs[f] = np.maximum(maxs[f], np.asarray(out["maxs"][f]))
-        if hist is not None:
-            hist += np.asarray(out["hist"], dtype=np.float64)
-        if rep_ts_acc is not None:
-            rts = np.asarray(out["rep_ts"], dtype=np.int64) + epoch
-            rrow = np.asarray(out["rep_row"], dtype=np.int64)
-            if rep_desc:
-                better = (rts > rep_ts_acc) | (
-                    (rts == rep_ts_acc) & (rrow > rep_row_acc)
-                )
-            else:
-                better = (rts < rep_ts_acc) | (
-                    (rts == rep_ts_acc) & (rrow < rep_row_acc)
-                )
-            rep_ts_acc = np.where(better, rts, rep_ts_acc)
-            rep_row_acc = np.where(better, rrow, rep_row_acc)
+        if pending is not None:
+            # bdlint: disable=host-sync -- the result boundary: one
+            # batched transfer per chunk, overlapped with dispatch above
+            _absorb(jax.device_get(pending))
+        pending = out
+    if pending is not None:
+        # bdlint: disable=host-sync -- final chunk's result boundary
+        _absorb(jax.device_get(pending))
 
     # --- dense [G] arrays -> nonempty-group records (codes stay dense
     # int32 rows; value tuples materialize lazily, Partials.groups) -------
@@ -986,8 +1008,8 @@ def _host_float_partials(
     for f in spec.fields:
         vals = chunks["fields"][f][sel].astype(np.float64)
         sums[f] = np.bincount(k, weights=vals, minlength=G)
-        mn = np.full(G, np.inf)
-        mx = np.full(G, -np.inf)
+        mn = np.full(G, np.inf, dtype=np.float64)
+        mx = np.full(G, -np.inf, dtype=np.float64)
         np.minimum.at(mn, k, vals)
         np.maximum.at(mx, k, vals)
         mins[f] = mn
@@ -1230,11 +1252,15 @@ def combine_partials(partials: list[Partials]) -> Partials:
         maps.append(idx)
 
     K = len(index)
-    count = np.zeros(K)
-    sums = {f: np.zeros(K) for f in fields}
-    mins = {f: np.full(K, np.inf) for f in fields}
-    maxs = {f: np.full(K, -np.inf) for f in fields}
-    hist = np.zeros((K, _NUM_HIST_BUCKETS)) if want_hist else None
+    count = np.zeros(K, dtype=np.float64)
+    sums = {f: np.zeros(K, dtype=np.float64) for f in fields}
+    mins = {f: np.full(K, np.inf, dtype=np.float64) for f in fields}
+    maxs = {f: np.full(K, -np.inf, dtype=np.float64) for f in fields}
+    hist = (
+        np.zeros((K, _NUM_HIST_BUCKETS), dtype=np.float64)
+        if want_hist
+        else None
+    )
     field_stats: dict[str, tuple[float, float]] = {}
     rep_key = (
         np.full((K, 2), -(2**62) if rep_desc else 2**62, dtype=np.int64)
@@ -1323,7 +1349,7 @@ def finalize_partials(
         group_ids = np.asarray([0]) if len(p.groups) else np.zeros(0, int)
         if not len(p.groups):
             p.groups = [()]
-            count = np.zeros(1)
+            count = np.zeros(1, dtype=np.float64)
             group_ids = np.asarray([0])
     else:
         # Canonical lexicographic order for group lists.  The dense
@@ -1464,7 +1490,7 @@ def _invert_histogram(
     if hist is None:
         return [[lo] * len(qs) for _ in range(ids.size)]
     valid = ids < len(hist)
-    counts = np.zeros((ids.size, hist.shape[1]))
+    counts = np.zeros((ids.size, hist.shape[1]), dtype=np.float64)
     counts[valid] = hist[ids[valid]]
     cdf = np.cumsum(counts, axis=1)  # [G, B]
     total = cdf[:, -1:]  # [G, 1]

@@ -109,7 +109,11 @@ def device_tag_mask(src: ColumnData, conds: list[Condition]):
     kernel = _KERNEL_CACHE.get(spec)
     if kernel is None:
         kernel = _KERNEL_CACHE[spec] = _build_kernel(spec)
-    mask = np.asarray(kernel(tuple(cols), tuple(pred_vals)))
+    import jax
+
+    # bdlint: disable=host-sync -- the retrieval result boundary: the
+    # whole bool mask moves in one transfer; the host gather needs it
+    mask = jax.device_get(kernel(tuple(cols), tuple(pred_vals)))
     return mask[:n]
 
 

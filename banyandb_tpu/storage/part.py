@@ -282,6 +282,8 @@ class Part:
             off, size = block["extents"][col]
             f = handles.get(col)
             if f is None:
+                # bdlint: disable=resource-hygiene -- per-column handle
+                # cache for the block loop; closed in the finally below
                 f = handles[col] = open(self.dir / _col_file(col), "rb")
             f.seek(off)
             return f.read(size)

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# One-stop pre-PR gate: ruff (generic lint) + bdlint (project-native
+# invariants, docs/linting.md) + the tier-1 test command from ROADMAP.md.
+# Run from the repo root:  ./scripts/check.sh [--fast]
+#   --fast  skip the tier-1 pytest run (lint-only, seconds not minutes)
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+fail=0
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check banyandb_tpu tests scripts || fail=1
+else
+    # the container this repo grows in does not ship ruff; the config
+    # (ruff.toml) still pins the style for environments that do
+    echo "ruff not installed; skipping (config: ruff.toml)"
+fi
+
+echo "== bdlint =="
+python -m banyandb_tpu.lint --check banyandb_tpu || fail=1
+
+if [ "${1:-}" != "--fast" ]; then
+    echo "== tier-1 tests (ROADMAP.md) =="
+    rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+        | tee /tmp/_t1.log
+    rc=${PIPESTATUS[0]}
+    echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+    [ "$rc" -ne 0 ] && fail=1
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "check.sh: FAILED"
+else
+    echo "check.sh: all gates green"
+fi
+exit "$fail"

@@ -182,12 +182,12 @@ def test_migration_resumes_after_failure(cluster):
             self.calls = 0
             self.fail_after = fail_after
 
-        def call(self, addr, topic, env):
+        def call(self, addr, topic, env, timeout=30.0):
             if topic == "sync-part" and env.get("phase") == "finish":
                 self.calls += 1
                 if self.calls == self.fail_after:
                     raise ConnectionError("injected mid-migration crash")
-            return self.inner.call(addr, topic, env)
+            return self.inner.call(addr, topic, env, timeout=timeout)
 
     flaky = FlakyTransport(transport, fail_after=2)
     with pytest.raises(ConnectionError):
@@ -237,7 +237,7 @@ def test_late_write_during_migration_is_shipped_not_lost(cluster):
             self.inner = inner
             self.fired = False
 
-        def call(self, addr, topic, env):
+        def call(self, addr, topic, env, timeout=30.0):
             if (
                 topic == "sync-part"
                 and env.get("phase") == "finish"
@@ -250,7 +250,7 @@ def test_late_write_during_migration_is_shipped_not_lost(cluster):
                         {"value": 777.0}, version=1,
                     ),
                 )))
-            return self.inner.call(addr, topic, env)
+            return self.inner.call(addr, topic, env, timeout=timeout)
 
     lt = LateWriteTransport(transport)
     stats = TierMigrator(hot, lt, warm_addr).run(T_OLD + DAY)
