@@ -52,30 +52,9 @@ class LocalDirFS:
         )
 
 
-class _PrefixedCloudFS:
-    """Shared key/prefix handling for bucket-store drivers.
-
-    Directory semantics (match LocalDirFS): a non-empty list() prefix
-    only matches keys *under* it, never string-prefix siblings like
-    "<prefix>-archive/...".
-    """
-
-    prefix: str
-
-    def _key(self, rel: str) -> str:
-        return f"{self.prefix}/{rel}" if self.prefix else rel
-
-    def _probe(self, prefix: str) -> str:
-        full = self._key(prefix).strip("/")
-        return full + "/" if full else ""
-
-    def _strip(self, key: str) -> str:
-        return key[len(self.prefix) + 1 :] if self.prefix else key
-
-    def list(self, prefix: str) -> list[str]:
-        return sorted(
-            self._strip(k) for k in self._iter_keys(self._probe(prefix))
-        )
+# the shared prefix/key base lives with the platform-level drivers
+# (utils/object_store.py) so this L6 module depends strictly downward
+from banyandb_tpu.utils.object_store import _PrefixedCloudFS
 
 
 class S3FS(_PrefixedCloudFS):

@@ -236,6 +236,9 @@ class GrpcTransport:
         self._server_name_override = server_name_override
 
     def _stub(self, addr: str):
+        """-> (unary-unary stub, the channel it rides) for addr.  The
+        channel is returned so a failing call can evict exactly the
+        channel it used (see _evict)."""
         import grpc
 
         with self._lock:
@@ -262,26 +265,47 @@ class GrpcTransport:
                 else:
                     ch = grpc.insecure_channel(addr, options=options)
                 self._channels[addr] = ch
-            return ch.unary_unary(
-                _METHOD,
-                request_serializer=lambda b: b,
-                response_deserializer=lambda b: b,
+            return (
+                ch.unary_unary(
+                    _METHOD,
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b,
+                ),
+                ch,
             )
 
     def channel(self, addr: str):
         """Raw grpc channel for streaming services (chunked sync)."""
-        self._stub(addr)  # ensure the channel exists
+        return self._stub(addr)[1]
+
+    def _evict(self, addr: str, failed) -> None:
+        """Drop the channel a call just failed on so the next call dials
+        a fresh one.  A channel whose connect wedged can stay in
+        TRANSIENT_FAILURE long after the peer is reachable — observed on
+        gVisor-class kernels, where a dial racing the server's bind
+        establishes at the TCP layer but the client event engine misses
+        the writability event, burning the full connect timeout per
+        retry — while a fresh dial to the same address connects
+        instantly.  Evicting on UNAVAILABLE bounds the damage to one
+        failed call.  Identity-checked (a concurrent re-dial's healthy
+        replacement is never dropped) and NOT closed: a streaming user
+        (chunked sync holds channels via .channel()) may still ride it,
+        and close() would cancel its in-flight RPCs — the dropped
+        channel is released when its last user lets go."""
         with self._lock:
-            return self._channels[addr]
+            if self._channels.get(addr) is failed:
+                del self._channels[addr]
 
     def call(self, addr: str, topic: str, envelope: dict, timeout: float = 30.0) -> dict:
         import grpc
 
-        stub = self._stub(addr)
+        stub, ch = self._stub(addr)
         payload = json.dumps({"topic": topic, "envelope": envelope}).encode()
         try:
             raw = stub(payload, timeout=timeout)
         except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.UNAVAILABLE:
+                self._evict(addr, ch)
             raise TransportError(f"rpc to {addr} failed: {e.code()}") from e
         msg = json.loads(raw)
         if not msg.get("ok"):

@@ -3,12 +3,19 @@
 Exit codes: without ``--check`` the run is report-only (exit 0 even
 with findings — the editor/exploration mode); ``--check`` is the CI
 gate (exit 1 on any finding); 2 on usage error.
+
+``--check`` also runs the whole-program analyses (layering, call-graph
+sync/lock propagation, lock-order cycles, eval_shape plan audit) when a
+target path is — or contains — the real ``banyandb_tpu`` package;
+``--whole-program`` runs them report-only without the gate.
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
+from pathlib import Path
+from typing import Optional
 
 from banyandb_tpu.lint.core import (
     all_rules,
@@ -16,6 +23,20 @@ from banyandb_tpu.lint.core import (
     render_json,
     render_text,
 )
+
+
+def _find_pkg_root(paths: list[str]) -> Optional[Path]:
+    """The banyandb_tpu package dir among the CLI targets, if any.
+    Whole-program analyses need the whole package, so a single-file or
+    out-of-package target runs the per-file rules only."""
+    for p in paths:
+        pth = Path(p)
+        if pth.name == "banyandb_tpu" and (pth / "__init__.py").is_file():
+            return pth
+        cand = pth / "banyandb_tpu"
+        if pth.is_dir() and (cand / "__init__.py").is_file():
+            return cand
+    return None
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -32,13 +53,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--check",
         action="store_true",
-        help="CI mode: exit 1 on any finding (default: report-only)",
+        help="CI mode: exit 1 on any finding; includes the whole-program "
+        "analyses (default: report-only)",
+    )
+    ap.add_argument(
+        "--whole-program",
+        action="store_true",
+        help="run the whole-program analyses (layering, call-graph facts, "
+        "lock-order, plan audit) report-only",
     )
     ap.add_argument(
         "--format",
         choices=("text", "json"),
         default="text",
-        help="output format (json is SARIF-lite, stable ordering)",
+        help="output format (json is SARIF 2.1.0, deterministic)",
     )
     ap.add_argument(
         "--rules",
@@ -50,21 +78,55 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = ap.parse_args(argv)
 
+    from banyandb_tpu.lint.whole_program import WP_RULES
+
     rules = all_rules()
     if args.list_rules:
         for r in rules:
             scope = ",".join(r.scope) or "(package)"
             print(f"{r.name:18s} [{scope}] {r.summary}")
+        for name, summary in WP_RULES:
+            print(f"{name:18s} [whole-program] {summary}")
         return 0
+    wanted = None
     if args.rules:
         wanted = {n.strip() for n in args.rules.split(",") if n.strip()}
-        unknown = wanted - {r.name for r in rules}
+        known = {r.name for r in rules} | {n for n, _ in WP_RULES}
+        unknown = wanted - known
         if unknown:
             print(f"bdlint: unknown rule(s): {sorted(unknown)}", file=sys.stderr)
             return 2
         rules = [r for r in rules if r.name in wanted]
 
     findings, summary = lint_paths(args.paths, rules=rules)
+
+    wp_root = _find_pkg_root(args.paths)
+    wp_names = {n for n, _ in WP_RULES}
+    # naming a whole-program rule via --rules implies running the
+    # whole-program analyses even without --check/--whole-program — a
+    # rule the user asked for by name must never silently not run
+    run_wp = (
+        args.check
+        or args.whole_program
+        or (wanted is not None and bool(wanted & wp_names))
+    ) and wp_root is not None
+    if wanted is not None and not (wanted & wp_names):
+        run_wp = False
+    if run_wp:
+        from banyandb_tpu.lint.whole_program import run_whole_program
+
+        wp_findings, wp_stats = run_whole_program(
+            wp_root,
+            plan_audit=(wanted is None or "plan-audit" in wanted),
+        )
+        if wanted is not None:
+            wp_findings = [f for f in wp_findings if f.rule in wanted]
+            wp_stats["wp_findings"] = len(wp_findings)
+        findings = sorted(findings + wp_findings)
+        summary["findings"] += len(wp_findings)
+        summary["suppressed"] += wp_stats["wp_suppressed"]
+        summary.update(wp_stats)
+
     if args.format == "json":
         print(render_json(findings, summary))
     else:

@@ -244,28 +244,88 @@ def lint_paths(
 
 def render_text(findings: list[Finding], summary: dict) -> str:
     out = [f.render() for f in findings]
-    out.append(
+    tail = (
         "bdlint: {files} files, {findings} findings, "
         "{suppressed} suppressed".format(**summary)
     )
+    if "wp_functions" in summary:
+        tail += (
+            "; whole-program: {wp_findings} findings, "
+            "{wp_suppressed} suppressed over {wp_functions} "
+            "functions".format(**summary)
+        )
+    out.append(tail)
     return "\n".join(out)
 
 
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _rule_catalog() -> list[tuple[str, str]]:
+    """(id, summary) for every rule bdlint can emit, stable order:
+    per-file rules, whole-program analyses, then the parse sentinel."""
+    cat = [(r.name, r.summary) for r in all_rules()]
+    from banyandb_tpu.lint.whole_program import WP_RULES
+
+    cat += list(WP_RULES)
+    cat.append(("parse-error", "file does not parse"))
+    return cat
+
+
 def render_json(findings: list[Finding], summary: dict) -> str:
-    """SARIF-lite: stable key order, sorted findings, schema-versioned."""
-    doc = {
-        "version": "1.0",
-        "tool": "bdlint",
-        "findings": [
+    """Real SARIF 2.1.0 (editors and code-scanning UIs ingest it):
+    tool.driver rule metadata, results[].locations, run-level summary
+    under properties.  Deterministic: sorted findings, sorted keys."""
+    catalog = _rule_catalog()
+    rule_index = {name: i for i, (name, _) in enumerate(catalog)}
+    results = []
+    for f in findings:
+        results.append(
             {
-                "rule": f.rule,
-                "path": f.path,
-                "line": f.line,
-                "col": f.col,
-                "message": f.message,
+                "ruleId": f.rule,
+                "ruleIndex": rule_index.get(f.rule, -1),
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {"uri": f.path},
+                            "region": {
+                                "startLine": f.line,
+                                # bdlint columns are 0-based; SARIF's are 1-based
+                                "startColumn": f.col + 1,
+                            },
+                        }
+                    }
+                ],
             }
-            for f in findings
+        )
+    doc = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        # informationUri omitted: SARIF §3.19.2 requires an
+                        # absolute URI and this repo has no canonical URL;
+                        # docs/linting.md is the human entry point
+                        "name": "bdlint",
+                        "rules": [
+                            {
+                                "id": name,
+                                "shortDescription": {"text": text},
+                            }
+                            for name, text in catalog
+                        ],
+                    }
+                },
+                "results": results,
+                "properties": summary,
+            }
         ],
-        "summary": summary,
     }
     return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,117 @@
+"""Whole-program analyses: the cross-module invariants bdlint's per-file
+rules cannot see.
+
+Four analyzers, all surfaced through ``python -m banyandb_tpu.lint``
+(``--check`` runs them; ``--whole-program`` runs them report-only):
+
+- ``layering``        import-graph enforcement of the SURVEY.md §1
+                      L0-L6 layer map (layer_config.py is the checked-in
+                      policy; pre-existing violations ride a ratcheted
+                      baseline that only shrinks)
+- ``wp-sync-in-jit``  interprocedural "performs host sync / blocks"
+                      facts: a function transitively reaching
+                      jax.device_get or a blocking call from inside a
+                      jit-traced region is flagged across files
+- ``wp-lock-blocking``the cross-file extension of lock-across-rpc: a
+                      call made while holding a lock whose CALLEE
+                      (transitively) blocks
+- ``lock-order``      potential deadlock cycles in the
+                      acquires-while-holding lock graph
+- ``plan-audit``      jax.eval_shape abstract trace of every registered
+                      measure/stream kernel entry point against a matrix
+                      of representative plan shapes: dtype promotion,
+                      shape mismatch and retrace hazards, zero device
+                      execution
+
+Findings reuse bdlint's Finding/suppression machinery: a whole-program
+finding anchors at a real source line and honors the same
+``# bdlint: disable=<rule> -- reason`` comments.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from banyandb_tpu.lint.core import Finding, parse_suppressions
+
+# (name, summary) catalog for --list-rules; checks live in the sibling
+# modules, not in per-file rule objects.
+WP_RULES = (
+    ("layering", "import respects the SURVEY L0-L6 layer map"),
+    ("wp-sync-in-jit", "transitive host sync/block inside a jit region"),
+    ("wp-lock-blocking", "callee transitively blocks while a lock is held"),
+    ("lock-order", "potential deadlock cycle in the lock-order graph"),
+    ("plan-audit", "eval_shape plan matrix: dtype/shape/retrace hazards"),
+)
+
+
+def apply_suppressions(
+    findings: list[Finding],
+) -> tuple[list[Finding], int]:
+    """Filter whole-program findings through per-file bdlint suppressions.
+
+    -> (kept findings, suppressed count).  Files are read lazily and only
+    when they actually carry findings.
+    """
+    kept: list[Finding] = []
+    suppressed = 0
+    cache: dict[str, tuple[dict, frozenset]] = {}
+    for f in findings:
+        maps = cache.get(f.path)
+        if maps is None:
+            try:
+                lines = Path(f.path).read_text(encoding="utf-8").splitlines()
+                maps = parse_suppressions(lines)
+            except OSError:
+                maps = ({}, frozenset())
+            cache[f.path] = maps
+        per_line, file_wide = maps
+        sup = per_line.get(f.line, frozenset()) | file_wide
+        if f.rule in sup or "all" in sup:
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def run_whole_program(
+    pkg_root: Path, plan_audit: bool = True
+) -> tuple[list[Finding], dict]:
+    """Run every whole-program analyzer against the banyandb_tpu package
+    rooted at ``pkg_root`` -> (findings after suppressions, stats)."""
+    from banyandb_tpu.lint.whole_program import layer_config
+    from banyandb_tpu.lint.whole_program.callgraph import (
+        Program,
+        analyze_lock_blocking,
+        analyze_sync_in_jit,
+    )
+    from banyandb_tpu.lint.whole_program.layers import (
+        analyze_layers,
+        parse_package,
+    )
+    from banyandb_tpu.lint.whole_program.lockorder import analyze_lock_order
+
+    trees = parse_package(pkg_root, layer_config.PACKAGE)
+    findings: list[Finding] = []
+    findings += analyze_layers(
+        pkg_root,
+        layer_config.PACKAGE,
+        layer_config.CONFIG,
+        baseline=layer_config.BASELINE,
+        trees=trees,
+    )
+    program = Program.build(pkg_root, layer_config.PACKAGE, trees=trees)
+    findings += analyze_sync_in_jit(program)
+    findings += analyze_lock_blocking(program)
+    findings += analyze_lock_order(program)
+    if plan_audit:
+        from banyandb_tpu.lint.whole_program.plan_audit import run_plan_audit
+
+        findings += run_plan_audit()
+    findings, suppressed = apply_suppressions(findings)
+    findings.sort()
+    return findings, {
+        "wp_findings": len(findings),
+        "wp_suppressed": suppressed,
+        "wp_functions": len(program.functions),
+    }

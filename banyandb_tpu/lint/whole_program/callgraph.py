@@ -1,0 +1,559 @@
+"""Module-resolution call graph + interprocedural fact propagation.
+
+Builds one symbol table over the whole package AST (functions, methods,
+nested kernel builders, import aliases), resolves call sites to
+fully-qualified functions, and propagates two facts to a fixed point:
+
+- ``sync``:  the function (transitively) performs a device->host sync
+  (``jax.device_get`` / ``.block_until_ready()``);
+- ``block``: the function (transitively) blocks the thread (transport
+  RPC, ``time.sleep``, ``urlopen``, ``socket.create_connection``,
+  ``subprocess`` waits).
+
+Two analyses consume the facts:
+
+- ``analyze_sync_in_jit``: a call *inside a jit-traced function* whose
+  callee transitively syncs or blocks is flagged — across files, which
+  the per-file host-sync rule cannot see.  Direct (depth-0) calls to the
+  sync APIs stay the per-file rule's business; this analysis only
+  reports what an intra-file reading would miss.
+- ``analyze_lock_blocking``: the cross-file half of lock-across-rpc — a
+  call made while holding a lock whose callee transitively blocks.
+
+Resolution is module-level and deliberately conservative: a call that
+cannot be resolved to a package function creates no edge (no facts, no
+false chains).  ``self.m()`` resolves through the enclosing class and
+its in-package bases; aliased module and symbol imports (including
+function-local lazy imports) resolve through one merged per-module
+import table.
+
+Lock identity is *declaration-based*: ``self._lock`` in class ``C`` of
+module ``m`` is ``m.C._lock`` — one id per declaration site, not per
+instance (see lockorder.py for the deadlock-graph consequences).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, Optional
+
+from banyandb_tpu.lint.core import Finding, dotted_name
+from banyandb_tpu.lint.rules_fabric import _attr_chain_ids, _is_transport_call
+from banyandb_tpu.lint.rules_jax import _is_jax_jit
+from banyandb_tpu.lint.whole_program.layers import (
+    parse_package,
+    resolve_relative_base,
+)
+
+_SYNC_APIS = {"jax.device_get"}
+_SYNC_ATTRS = {"block_until_ready"}
+_BLOCK_APIS = {
+    "time.sleep",
+    "_time.sleep",
+    "urllib.request.urlopen",
+    "request.urlopen",
+    "urlopen",
+    "socket.create_connection",
+    "create_connection",
+    "subprocess.run",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.call",
+}
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    callee: Optional[str]  # resolved qualname ("mod:Class.fn") or None
+    line: int
+    col: int
+
+
+@dataclass
+class LockRegion:
+    lock_id: str
+    node: ast.AST  # the With node
+    calls: list[CallSite] = field(default_factory=list)
+    inner_locks: list[tuple[str, ast.AST]] = field(default_factory=list)
+
+
+@dataclass
+class FuncInfo:
+    qual: str  # "module:fn", "module:Class.fn", "module:fn.inner"
+    module: str
+    path: str
+    node: ast.AST
+    cls: Optional[str]
+    calls: list[CallSite] = field(default_factory=list)
+    lock_regions: list[LockRegion] = field(default_factory=list)
+    direct_sync: Optional[str] = None
+    direct_block: Optional[str] = None
+    traced: bool = False
+    # propagated facts: (base api, witness chain of quals) or None
+    sync: Optional[tuple[str, tuple[str, ...]]] = None
+    block: Optional[tuple[str, tuple[str, ...]]] = None
+
+
+def _walk_own(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs (each
+    nested def is its own FuncInfo)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def lock_identity(
+    expr: ast.AST,
+    module: str,
+    cls: Optional[str],
+    imports: Optional[dict[str, str]] = None,
+) -> Optional[str]:
+    """Declaration-based lock id for a with-context expression, or None
+    when the expression is not lock-shaped (last segment contains
+    'lock').  An imported head resolves through the module's import
+    table, so ``other.GLOBAL_LOCK`` names the same declaration from
+    every module that touches it."""
+    if isinstance(expr, ast.Call):  # with self._lock_for(x): style
+        expr = expr.func
+    ids = _attr_chain_ids(expr)
+    if not ids or "lock" not in ids[-1].lower():
+        return None
+    if ids[0] in ("self", "cls"):
+        owner = f"{module}.{cls}" if cls else module
+        return ".".join([owner, *ids[1:]])
+    if imports and ids[0] in imports:
+        return ".".join([imports[ids[0]], *ids[1:]])
+    return f"{module}." + ".".join(ids)
+
+
+class Program:
+    """The whole-package call graph with propagated facts."""
+
+    def __init__(self) -> None:
+        self.functions: dict[str, FuncInfo] = {}
+        self.modules: set[str] = set()
+        # lock declarations assigned threading.RLock() — reentrant, so a
+        # self re-acquisition is not a self-deadlock
+        self.reentrant_locks: set[str] = set()
+        # module -> {class name -> {method name -> qual}}
+        self._classes: dict[str, dict[str, dict[str, str]]] = {}
+        self._bases: dict[tuple[str, str], list[str]] = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(
+        cls, pkg_root: Path, pkgname: str, trees: Optional[dict] = None
+    ) -> "Program":
+        """Pass pre-parsed ``trees`` (layers.parse_package) to share one
+        parse of the package across analyzers."""
+        self = cls()
+        if trees is None:
+            trees = parse_package(pkg_root, pkgname)
+        self.modules = set(trees)
+        for mod, (path, tree) in trees.items():
+            self._collect_defs(mod, str(path), tree)
+        tables = {
+            mod: self._import_table(mod, tree, path.name == "__init__.py")
+            for mod, (path, tree) in trees.items()
+        }
+        for mod, (_path, tree) in trees.items():
+            self._resolve_module(mod, tree, tables[mod])
+        self._mark_traced(trees, tables)
+        self._propagate()
+        return self
+
+    def _collect_defs(self, mod: str, path: str, tree: ast.Module) -> None:
+        classes: dict[str, dict[str, str]] = {}
+
+        def visit(node: ast.AST, prefix: str, cls_name: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{mod}:{prefix}{child.name}"
+                    self.functions[qual] = FuncInfo(
+                        qual=qual, module=mod, path=path, node=child, cls=cls_name
+                    )
+                    if cls_name and not prefix.replace(cls_name + ".", "", 1):
+                        classes.setdefault(cls_name, {})[child.name] = qual
+                    visit(child, f"{prefix}{child.name}.", cls_name)
+                elif isinstance(child, ast.ClassDef):
+                    bases = [dotted_name(b) for b in child.bases]
+                    self._bases[(mod, child.name)] = [b for b in bases if b]
+                    classes.setdefault(child.name, {})
+                    visit(child, f"{child.name}.", child.name)
+
+        visit(tree, "", None)
+        self._classes[mod] = classes
+
+        # reentrant-lock declarations: self.X = threading.RLock() inside
+        # class C -> "mod.C.X"; NAME = threading.RLock() -> "mod.NAME"
+        def scan_rlocks(node: ast.AST, cls_name: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan_rlocks(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign) and isinstance(
+                    child.value, ast.Call
+                ):
+                    if dotted_name(child.value.func) in (
+                        "threading.RLock",
+                        "RLock",
+                    ):
+                        for t in child.targets:
+                            lid = lock_identity(t, mod, cls_name)
+                            if lid:
+                                self.reentrant_locks.add(lid)
+                scan_rlocks(child, cls_name)
+
+        scan_rlocks(tree, None)
+
+    def _import_table(
+        self, mod: str, tree: ast.Module, is_pkg: bool
+    ) -> dict[str, str]:
+        """Merged alias -> dotted-target table (module-level AND
+        function-local imports: lazy boundaries still carry facts)."""
+        table: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    table[(a.asname or a.name).split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+                    if a.asname:
+                        table[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = resolve_relative_base(mod, node, is_pkg)
+                for a in node.names:
+                    table[a.asname or a.name] = f"{base}.{a.name}"
+        return table
+
+    def _find_function(self, dotted: str) -> Optional[str]:
+        """Fully-dotted path -> qualname, trying module prefixes longest
+        first ("pkg.a.b.C.f" -> "pkg.a.b:C.f")."""
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod in self.modules:
+                rest = ".".join(parts[cut:])
+                if not rest:
+                    return None
+                qual = f"{mod}:{rest}"
+                if qual in self.functions:
+                    return qual
+                # class instantiation -> __init__
+                init = f"{mod}:{rest}.__init__"
+                if init in self.functions:
+                    return init
+                return None
+        return None
+
+    def _method_on(self, mod: str, cls_name: str, name: str) -> Optional[str]:
+        """Method lookup through the in-package MRO (single inheritance
+        chains only — enough for this codebase)."""
+        seen = set()
+        queue = [(mod, cls_name)]
+        while queue:
+            m, c = queue.pop(0)
+            if (m, c) in seen:
+                continue
+            seen.add((m, c))
+            qual = self._classes.get(m, {}).get(c, {}).get(name)
+            if qual:
+                return qual
+            for b in self._bases.get((m, c), []):
+                # base may be local ("Base") or imported — local only here
+                if b in self._classes.get(m, {}):
+                    queue.append((m, b))
+        return None
+
+    def _resolve_call(
+        self,
+        mod: str,
+        imports: dict[str, str],
+        enclosing: list[str],
+        cls_name: Optional[str],
+        node: ast.Call,
+    ) -> Optional[str]:
+        d = dotted_name(node.func)
+        if not d:
+            return None
+        head, _, rest = d.partition(".")
+        if head in ("self", "cls") and cls_name:
+            if rest and "." not in rest:
+                return self._method_on(mod, cls_name, rest)
+            return None
+        if head in imports:
+            return self._find_function(
+                imports[head] + (("." + rest) if rest else "")
+            )
+        if not rest:
+            # bare name: enclosing nested scopes innermost-first, then
+            # module-level function, then local class __init__
+            for prefix in reversed(enclosing):
+                qual = f"{mod}:{prefix}{head}"
+                if qual in self.functions:
+                    return qual
+            if f"{mod}:{head}" in self.functions:
+                return f"{mod}:{head}"
+            if head in self._classes.get(mod, {}):
+                return self._classes[mod].get(head, {}).get("__init__")
+        return None
+
+    def _resolve_module(
+        self, mod: str, tree: ast.Module, imports: dict[str, str]
+    ) -> None:
+        def visit_fn(fn_node: ast.AST, qual: str, enclosing: list[str]) -> None:
+            info = self.functions[qual]
+            for node in _walk_own(fn_node):
+                if isinstance(node, ast.Call):
+                    callee = self._resolve_call(
+                        mod, imports, enclosing, info.cls, node
+                    )
+                    site = CallSite(
+                        node=node,
+                        callee=callee,
+                        line=node.lineno,
+                        col=node.col_offset,
+                    )
+                    info.calls.append(site)
+                    d = dotted_name(node.func)
+                    if d in _SYNC_APIS or (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_ATTRS
+                    ):
+                        info.direct_sync = d or node.func.attr
+                    if d in _BLOCK_APIS or _is_transport_call(node):
+                        info.direct_block = d or "transport.call"
+            # lock regions: with-items whose context is lock-shaped
+            for node in _walk_own(fn_node):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                for item in node.items:
+                    lock_id = lock_identity(
+                        item.context_expr, mod, info.cls, imports
+                    )
+                    if lock_id is None:
+                        continue
+                    region = LockRegion(lock_id=lock_id, node=node)
+                    for inner in _walk_own(node):
+                        if isinstance(inner, ast.Call):
+                            region.calls.append(
+                                CallSite(
+                                    node=inner,
+                                    callee=self._resolve_call(
+                                        mod, imports, enclosing, info.cls, inner
+                                    ),
+                                    line=inner.lineno,
+                                    col=inner.col_offset,
+                                )
+                            )
+                        elif isinstance(inner, (ast.With, ast.AsyncWith)):
+                            for it in inner.items:
+                                lid = lock_identity(
+                                    it.context_expr, mod, info.cls, imports
+                                )
+                                if lid is not None:
+                                    region.inner_locks.append((lid, inner))
+                    info.lock_regions.append(region)
+
+        def descend(node: ast.AST, prefix: str, enclosing: list[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{mod}:{prefix}{child.name}"
+                    if qual in self.functions:
+                        # a function's own prefix is in scope for its own
+                        # body: `outer` calling its nested `h` resolves to
+                        # "mod:outer.h", not the non-existent "mod:h"
+                        inner = enclosing + [f"{prefix}{child.name}."]
+                        visit_fn(child, qual, inner)
+                        descend(child, f"{prefix}{child.name}.", inner)
+                elif isinstance(child, ast.ClassDef):
+                    descend(child, f"{child.name}.", enclosing)
+
+        descend(tree, "", [])
+
+    def _mark_traced(self, trees: dict, tables: dict) -> None:
+        """jit regions: @jax.jit-decorated defs plus any function whose
+        name (or dotted path) is passed to jax.jit(...) anywhere."""
+        for mod, (_path, tree) in trees.items():
+            imports = tables[mod]
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if any(_is_jax_jit(d) for d in node.decorator_list):
+                        for qual, info in self.functions.items():
+                            if info.node is node:
+                                info.traced = True
+                elif isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                    if not node.args:
+                        continue
+                    target = node.args[0]
+                    d = dotted_name(target)
+                    if not d:
+                        continue
+                    qual = None
+                    if "." not in d:
+                        # bare name: any (possibly nested) def in this module
+                        cands = [
+                            q
+                            for q in self.functions
+                            if q.startswith(f"{mod}:")
+                            and q.rsplit(".", 1)[-1].split(":")[-1] == d
+                        ]
+                        qual = cands[0] if len(cands) == 1 else (
+                            f"{mod}:{d}" if f"{mod}:{d}" in self.functions else None
+                        )
+                        if qual is None and cands:
+                            for q in cands:
+                                self.functions[q].traced = True
+                    else:
+                        head, _, rest = d.partition(".")
+                        if head in imports:
+                            qual = self._find_function(f"{imports[head]}.{rest}")
+                    if qual and qual in self.functions:
+                        self.functions[qual].traced = True
+
+    # -- fact propagation --------------------------------------------------
+
+    def _propagate(self) -> None:
+        callers: dict[str, list[str]] = {}
+        for qual, info in self.functions.items():
+            for site in info.calls:
+                if site.callee:
+                    callers.setdefault(site.callee, []).append(qual)
+            if info.direct_sync:
+                info.sync = (info.direct_sync, ())
+            if info.direct_block:
+                info.block = (info.direct_block, ())
+        work = [q for q, i in self.functions.items() if i.sync or i.block]
+        while work:
+            q = work.pop()
+            info = self.functions[q]
+            for caller in callers.get(q, ()):  # propagate up one edge
+                ci = self.functions[caller]
+                changed = False
+                if info.sync and ci.sync is None:
+                    ci.sync = (info.sync[0], (q, *info.sync[1]))
+                    changed = True
+                if info.block and ci.block is None:
+                    ci.block = (info.block[0], (q, *info.block[1]))
+                    changed = True
+                if changed:
+                    work.append(caller)
+
+    def lock_acquires(self) -> dict[str, set[str]]:
+        """qual -> set of lock ids the function may (transitively)
+        acquire.  Fixed point over the call graph."""
+        acq: dict[str, set[str]] = {
+            q: {r.lock_id for r in i.lock_regions}
+            for q, i in self.functions.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for q, info in self.functions.items():
+                for site in info.calls:
+                    if site.callee and site.callee in acq:
+                        extra = acq[site.callee] - acq[q]
+                        if extra:
+                            acq[q] |= extra
+                            changed = True
+        return acq
+
+
+def _chain(start: str, fact: tuple[str, tuple[str, ...]]) -> str:
+    api, path = fact
+    hops = " -> ".join(
+        q.split(":", 1)[1] + f" ({q.split(':', 1)[0].split('.')[-1]})"
+        for q in path
+    )
+    return f"{start} -> {hops} -> {api}" if hops else f"{start} -> {api}"
+
+
+def analyze_sync_in_jit(program: Program) -> list[Finding]:
+    """Calls inside jit-traced functions whose callee transitively syncs
+    or blocks.  Depth-0 (direct API) calls are the per-file rule's job —
+    only the cross-function reach is reported here."""
+    findings: list[Finding] = []
+    for info in program.functions.values():
+        if not info.traced:
+            continue
+        for site in info.calls:
+            if not site.callee:
+                continue
+            callee = program.functions.get(site.callee)
+            if callee is None:
+                continue
+            short = site.callee.split(":", 1)[1]
+            if callee.sync:
+                findings.append(
+                    Finding(
+                        path=info.path,
+                        line=site.line,
+                        col=site.col,
+                        rule="wp-sync-in-jit",
+                        message=(
+                            f"jit-traced `{info.qual.split(':', 1)[1]}` "
+                            f"calls `{short}` which transitively performs "
+                            f"a host sync: {_chain(short, callee.sync)}; "
+                            "syncs belong at the result boundary, outside "
+                            "the traced region"
+                        ),
+                    )
+                )
+            elif callee.block:
+                findings.append(
+                    Finding(
+                        path=info.path,
+                        line=site.line,
+                        col=site.col,
+                        rule="wp-sync-in-jit",
+                        message=(
+                            f"jit-traced `{info.qual.split(':', 1)[1]}` "
+                            f"calls `{short}` which transitively blocks: "
+                            f"{_chain(short, callee.block)}; a traced "
+                            "function must stay pure device work"
+                        ),
+                    )
+                )
+    return findings
+
+
+def analyze_lock_blocking(program: Program) -> list[Finding]:
+    """The interprocedural extension of lock-across-rpc: a call made
+    while holding a lock whose callee (transitively) blocks.  Direct
+    blocking calls in the region are the per-file rule's findings and
+    are not duplicated here."""
+    findings: list[Finding] = []
+    for info in program.functions.values():
+        for region in info.lock_regions:
+            for site in region.calls:
+                if not site.callee:
+                    continue
+                callee = program.functions.get(site.callee)
+                if callee is None or not callee.block:
+                    continue
+                if callee.qual == info.qual:
+                    continue
+                short = site.callee.split(":", 1)[1]
+                findings.append(
+                    Finding(
+                        path=info.path,
+                        line=site.line,
+                        col=site.col,
+                        rule="wp-lock-blocking",
+                        message=(
+                            f"`{short}` transitively blocks "
+                            f"({_chain(short, callee.block)}) while "
+                            f"`{region.lock_id}` is held; snapshot under "
+                            "the lock, call outside it"
+                        ),
+                    )
+                )
+    return findings

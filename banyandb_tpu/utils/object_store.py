@@ -131,7 +131,31 @@ def sigv4_headers(
     }
 
 
-from banyandb_tpu.admin.backup import _PrefixedCloudFS  # noqa: E402
+class _PrefixedCloudFS:
+    """Shared key/prefix handling for bucket-store drivers (the base of
+    admin/backup's gated-SDK drivers AND the raw-REST drivers below).
+
+    Directory semantics (match LocalDirFS): a non-empty list() prefix
+    only matches keys *under* it, never string-prefix siblings like
+    "<prefix>-archive/...".
+    """
+
+    prefix: str
+
+    def _key(self, rel: str) -> str:
+        return f"{self.prefix}/{rel}" if self.prefix else rel
+
+    def _probe(self, prefix: str) -> str:
+        full = self._key(prefix).strip("/")
+        return full + "/" if full else ""
+
+    def _strip(self, key: str) -> str:
+        return key[len(self.prefix) + 1 :] if self.prefix else key
+
+    def list(self, prefix: str) -> list[str]:
+        return sorted(
+            self._strip(k) for k in self._iter_keys(self._probe(prefix))
+        )
 
 
 class HttpS3FS(_PrefixedCloudFS):

@@ -19,6 +19,11 @@ def pytest_configure(config):
     cold) so the C paths are TESTED, never skipped: test_native.py's
     skipif evaluates after this.  A failed build degrades to the old
     skip behavior rather than failing collection."""
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running E2E; tier-1 runs -m 'not slow' (ROADMAP.md), "
+        "fast smoke variants keep the coverage",
+    )
     import subprocess
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

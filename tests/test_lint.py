@@ -422,7 +422,7 @@ def test_unsuppressed_rule_still_fires():
     assert [f.rule for f in findings] == ["host-sync"]
 
 
-def test_findings_sorted_and_json_stable():
+def test_findings_sorted_and_sarif_2_1_0():
     src = (
         "import numpy as np\n"
         "a = np.zeros(3)\n"
@@ -431,12 +431,30 @@ def test_findings_sorted_and_json_stable():
     )
     findings, _ = lint_source(src, rel="query/x.py")
     assert findings == sorted(findings)
-    doc = json.loads(render_json(findings, {"files": 1, "findings": len(findings), "suppressed": 0}))
-    assert doc["version"] == "1.0" and doc["tool"] == "bdlint"
-    assert [f["rule"] for f in doc["findings"]] == [f.rule for f in findings]
+    summary = {"files": 1, "findings": len(findings), "suppressed": 0}
+    doc = json.loads(render_json(findings, summary))
+    # real SARIF 2.1.0: code-scanning UIs and editors ingest this shape
+    assert doc["version"] == "2.1.0" and doc["$schema"].endswith(
+        "sarif-schema-2.1.0.json"
+    )
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "bdlint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert "host-sync" in rule_ids and "layering" in rule_ids
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    assert [r["ruleId"] for r in run["results"]] == [f.rule for f in findings]
+    for res, f in zip(run["results"], findings):
+        # every result carries a physical location; ruleIndex round-trips
+        # into the driver rule table; columns are SARIF 1-based
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == f.path
+        assert loc["region"]["startLine"] == f.line
+        assert loc["region"]["startColumn"] == f.col + 1
+        assert driver["rules"][res["ruleIndex"]]["id"] == f.rule
+    assert run["properties"] == summary
     # serialization is deterministic (stable CI diffing)
-    again = render_json(findings, {"files": 1, "findings": len(findings), "suppressed": 0})
-    assert again == render_json(findings, {"files": 1, "findings": len(findings), "suppressed": 0})
+    assert render_json(findings, summary) == render_json(findings, summary)
 
 
 def test_cli_check_mode_and_rule_filter(tmp_path):

@@ -59,6 +59,23 @@ def _spawn(args: list[str], logf) -> subprocess.Popen:
     )
 
 
+def _wait_banner(log_path: Path, timeout_s: float = 120.0) -> None:
+    """Poll the child's log for its listening banner before dialing.
+    On this kernel a gRPC dial racing the server's bind can wedge the
+    channel (the TCP connect establishes later but the client misses
+    the writability event), so the boot wait reads the log instead of
+    probing the socket."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if "banyandb-tpu" in log_path.read_text(errors="replace"):
+                return
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise TimeoutError(f"{log_path} never printed its listening banner")
+
+
 def _wait_health(call, addr, timeout_s=60.0, role=None):
     from banyandb_tpu.cluster.bus import Topic
 
@@ -80,100 +97,157 @@ def _wait_health(call, addr, timeout_s=60.0, role=None):
     raise TimeoutError(f"{addr} never became healthy: {last}")
 
 
-def test_kill_data_node_under_load(tmp_path):
-    from banyandb_tpu.cluster.bus import Topic
-    from banyandb_tpu.cluster.rpc import GrpcTransport
-    from banyandb_tpu.server import TOPIC_QL, TOPIC_REGISTRY
+class _Cluster:
+    """Shared bring-up for the failover tests: 2 data nodes + 1 liaison
+    as real subprocesses, a parent-side transport, and the registry
+    schema both tests write into.  Everything spawns up front so the
+    jax boots overlap; waits are banner-then-health (dialing before the
+    child's banner wedges a gRPC channel on this kernel)."""
 
-    ports = [_free_port() for _ in range(3)]
-    nodes_file = tmp_path / "nodes.json"
-    nodes_file.write_text(json.dumps([
-        {"name": f"n{i}", "addr": f"127.0.0.1:{ports[i]}", "roles": ["data"]}
-        for i in range(2)
-    ]))
-    logs = [(tmp_path / f"proc{i}.log").open("w") for i in range(3)]
-    procs: dict[str, subprocess.Popen] = {}
-    transport = GrpcTransport()
+    def __init__(self, tmp_path: Path):
+        from banyandb_tpu.cluster.rpc import GrpcTransport
 
-    def call(addr, topic, env, timeout=30.0):
-        return transport.call(addr, topic, env, timeout=timeout)
+        self.tmp = tmp_path
+        self.ports = [_free_port() for _ in range(3)]
+        self.nodes_file = tmp_path / "nodes.json"
+        self.nodes_file.write_text(json.dumps([
+            {"name": f"n{i}", "addr": f"127.0.0.1:{self.ports[i]}",
+             "roles": ["data"]}
+            for i in range(2)
+        ]))
+        self.logs = [(tmp_path / f"proc{i}.log").open("w") for i in range(3)]
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.transport = GrpcTransport()
+        self.laddr = f"127.0.0.1:{self.ports[2]}"
 
-    def spawn_data(i: int) -> subprocess.Popen:
+    def call(self, addr, topic, env, timeout=30.0):
+        return self.transport.call(addr, topic, env, timeout=timeout)
+
+    def data_addr(self, i: int) -> str:
+        return f"127.0.0.1:{self.ports[i]}"
+
+    def spawn_data(self, i: int) -> subprocess.Popen:
         p = _spawn(
-            ["--role", "data", "--root", str(tmp_path / f"n{i}"),
-             "--name", f"n{i}", "--port", str(ports[i])],
-            logs[i],
+            ["--role", "data", "--root", str(self.tmp / f"n{i}"),
+             "--name", f"n{i}", "--port", str(self.ports[i])],
+            self.logs[i],
         )
-        procs[f"n{i}"] = p
+        self.procs[f"n{i}"] = p
         return p
 
-    try:
-        for i in range(2):
-            spawn_data(i)
-        for i in range(2):
-            _wait_health(call, f"127.0.0.1:{ports[i]}")
-        procs["liaison"] = _spawn(
-            ["--role", "liaison", "--root", str(tmp_path / "l"),
-             "--discovery", str(nodes_file), "--replicas", "1",
-             "--port", str(ports[2])],
-            logs[2],
+    def spawn_liaison(self) -> subprocess.Popen:
+        p = _spawn(
+            ["--role", "liaison", "--root", str(self.tmp / "l"),
+             "--discovery", str(self.nodes_file), "--replicas", "1",
+             "--port", str(self.ports[2])],
+            self.logs[2],
         )
-        laddr = f"127.0.0.1:{ports[2]}"
-        _wait_health(call, laddr, role="liaison")
+        self.procs["liaison"] = p
+        return p
 
-        call(laddr, TOPIC_REGISTRY, {"op": "create", "kind": "group", "item": {
-            "name": "fg", "catalog": "measure",
-            "resource_opts": {
-                "shard_num": 2, "replicas": 1,
-                "segment_interval": {"num": 1, "unit": "day"},
-                "ttl": {"num": 7, "unit": "day"}, "stages": [],
-            },
-        }})
-        call(laddr, TOPIC_REGISTRY, {"op": "create", "kind": "measure", "item": {
-            "group": "fg", "name": "m",
-            "tags": [{"name": "svc", "type": "string"}],
-            "fields": [{"name": "v", "type": "float"}],
-            "entity": {"tag_names": ["svc"]}, "interval": "", "index_mode": False,
-        }})
+    def boot(self) -> None:
+        """Spawn everything, then wait banner -> health in layer order."""
+        for i in range(2):
+            self.spawn_data(i)
+        self.spawn_liaison()
+        for i in range(2):
+            _wait_banner(self.tmp / f"proc{i}.log")
+        for i in range(2):
+            _wait_health(self.call, self.data_addr(i))
+        _wait_banner(self.tmp / "proc2.log")
+        _wait_health(self.call, self.laddr, role="liaison")
 
-        written = 0
+    def create_schema(self) -> None:
+        from banyandb_tpu.server import TOPIC_REGISTRY
 
-        def write_batch(n=100):
-            nonlocal written
-            pts = [{
-                "ts": T0 + (written + j),
-                "tags": {"svc": f"s{(written + j) % 7}"},
-                "fields": {"v": float(j)},
-                "version": 1,
-            } for j in range(n)]
-            call(laddr, Topic.MEASURE_WRITE.value,
-                 {"request": {"group": "fg", "name": "m", "points": pts}})
-            written += n
+        self.call(self.laddr, TOPIC_REGISTRY, {
+            "op": "create", "kind": "group", "item": {
+                "name": "fg", "catalog": "measure",
+                "resource_opts": {
+                    "shard_num": 2, "replicas": 1,
+                    "segment_interval": {"num": 1, "unit": "day"},
+                    "ttl": {"num": 7, "unit": "day"}, "stages": [],
+                },
+            }})
+        self.call(self.laddr, TOPIC_REGISTRY, {
+            "op": "create", "kind": "measure", "item": {
+                "group": "fg", "name": "m",
+                "tags": [{"name": "svc", "type": "string"}],
+                "fields": [{"name": "v", "type": "float"}],
+                "entity": {"tag_names": ["svc"]}, "interval": "",
+                "index_mode": False,
+            }})
 
-        def count_total() -> int:
-            r = call(laddr, TOPIC_QL, {
-                "ql": ("SELECT count(v) FROM MEASURE m IN fg "
-                       f"TIME BETWEEN {T0} AND {T0 + 10_000_000}")
-            }, timeout=60.0)
-            vals = r["result"]["values"].get("count", [0])
-            return int(sum(vals))
+    def write_batch(self, base: int, n: int, mod: int) -> None:
+        from banyandb_tpu.cluster.bus import Topic
+
+        pts = [{
+            "ts": T0 + base + j,
+            "tags": {"svc": f"s{(base + j) % mod}"},
+            "fields": {"v": float(j)},
+            "version": 1,
+        } for j in range(n)]
+        self.call(self.laddr, Topic.MEASURE_WRITE.value,
+                  {"request": {"group": "fg", "name": "m", "points": pts}})
+
+    def count_total(self) -> int:
+        from banyandb_tpu.server import TOPIC_QL
+
+        r = self.call(self.laddr, TOPIC_QL, {
+            "ql": ("SELECT count(v) FROM MEASURE m IN fg "
+                   f"TIME BETWEEN {T0} AND {T0 + 10_000_000}")
+        }, timeout=60.0)
+        return int(sum(r["result"]["values"].get("count", [0])))
+
+    def flush_and_kill(self, name: str = "n0") -> None:
+        """Flush both nodes, then SIGKILL one: the direct-row write
+        plane's documented durability window is the unflushed memtable
+        (the wqueue plane ships sealed PARTS; rows acked into a memtable
+        and killed before the 1s flush tick exist only on the surviving
+        replica) — these tests exercise handoff + failover, not WAL-less
+        crash durability."""
+        for i in range(2):
+            self.call(self.data_addr(i), "flush", {})
+        os.killpg(self.procs[name].pid, signal.SIGKILL)
+        self.procs[name].wait()
+
+    def teardown(self) -> None:
+        self.transport.close()
+        for p in self.procs.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    p.kill()
+                p.wait()
+        for f in self.logs:
+            f.close()
+
+
+@pytest.mark.slow  # full kill/restart/convergence E2E: minutes of boot +
+# poll budget; the tier-1 run keeps the fast smoke variant below
+def test_kill_data_node_under_load(tmp_path):
+    from banyandb_tpu.cluster.bus import Topic
+
+    c = _Cluster(tmp_path)
+    written = 0
+
+    def write_batch(n=100):
+        nonlocal written
+        c.write_batch(written, n, mod=7)
+        written += n
+
+    try:
+        c.boot()
+        c.create_schema()
 
         # Phase 1: healthy-cluster load
         for _ in range(5):
             write_batch()
-        assert count_total() == written
+        assert c.count_total() == written
 
-        # Phase 2: SIGKILL n0 mid-load; ingest + queries must continue.
-        # Flush both nodes first: the direct-row write plane's documented
-        # durability window is the unflushed memtable (the reference's
-        # wqueue plane ships sealed PARTS, making data nodes lossless on
-        # kill; rows acked into a memtable and killed before the 1s
-        # flush tick exist only on the surviving replica) — this test
-        # exercises handoff + failover, not WAL-less crash durability.
-        for i in range(2):
-            call(f"127.0.0.1:{ports[i]}", "flush", {})
-        os.killpg(procs["n0"].pid, signal.SIGKILL)
-        procs["n0"].wait()
+        # Phase 2: SIGKILL n0 mid-load; ingest + queries must continue
+        c.flush_and_kill("n0")
         outage_errors = 0
         for _ in range(10):
             try:
@@ -183,38 +257,78 @@ def test_kill_data_node_under_load(tmp_path):
             time.sleep(0.2)
         assert outage_errors <= 1, "ingest did not ride through the outage"
         # queries keep answering from the surviving replica (the killed
-        # node's shards are covered because replicas=1)
-        c = count_total()
-        assert c == written, f"query during outage lost rows: {c} != {written}"
+        # node's shards are covered because replicas=1).  Every acked
+        # write must be readable; a write that errored back may still
+        # have been partially applied, so the ceiling allows those rows
+        got = c.count_total()
+        assert written <= got <= written + outage_errors * 100, (
+            f"query during outage lost rows: {got} vs {written} acked"
+        )
 
         # Phase 3: restart n0 on the same root/port; handoff replays and
         # the cluster converges on every written point
-        spawn_data(0)
-        _wait_health(call, f"127.0.0.1:{ports[0]}")
+        c.spawn_data(0)
+        _wait_health(c.call, c.data_addr(0))
         write_batch()  # post-recovery traffic
         deadline = time.monotonic() + 60
+        got = -1
         while time.monotonic() < deadline:
-            if count_total() == written:
+            got = c.count_total()
+            if got >= written:
                 break
             time.sleep(2)
-        assert count_total() == written
+        assert written <= got <= written + outage_errors * 100
 
         # the liaison sees both nodes alive again after its next probe
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
-            h = call(laddr, Topic.HEALTH.value, {})
+            h = c.call(c.laddr, Topic.HEALTH.value, {})
             if sorted(h.get("alive", [])) == ["n0", "n1"]:
                 break
             time.sleep(1)
         assert sorted(h["alive"]) == ["n0", "n1"]
     finally:
-        transport.close()
-        for p in procs.values():
-            if p.poll() is None:
-                try:
-                    os.killpg(p.pid, signal.SIGKILL)
-                except OSError:
-                    p.kill()
-                p.wait()
-        for f in logs:
-            f.close()
+        c.teardown()
+
+
+def test_failover_smoke(tmp_path):
+    """The tier-1 slice of the E2E above: kill one replica under a small
+    load, assert ingest + query continuity from the survivor.  No
+    restart/convergence phase (that poll budget is what made the full
+    test bust the suite timeout on loaded CPU runners) and every wait is
+    a poll-with-deadline, not a fixed sleep."""
+    c = _Cluster(tmp_path)
+    try:
+        c.boot()
+        c.create_schema()
+
+        c.write_batch(0, 50, mod=5)
+        assert c.count_total() == 50
+
+        c.flush_and_kill("n0")
+
+        # ingest and queries ride through on the surviving replica; the
+        # first write may race the liaison noticing the kill
+        written, outage_errors = 50, 0
+        for _ in range(3):
+            try:
+                c.write_batch(written, 50, mod=5)
+                written += 50
+            except Exception:  # noqa: BLE001
+                outage_errors += 1
+                time.sleep(0.2)
+        assert outage_errors <= 1, "ingest did not ride through the outage"
+        # every acked write must be readable; an errored write may still
+        # have been partially applied, so the ceiling allows those rows
+        deadline = time.monotonic() + 30
+        got = -1
+        while time.monotonic() < deadline:
+            got = c.count_total()
+            if got >= written:
+                break
+            time.sleep(0.5)
+        assert written <= got <= written + outage_errors * 50, (
+            f"query during outage lost rows: {got} vs {written} acked"
+        )
+    finally:
+        c.teardown()

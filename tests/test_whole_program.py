@@ -1,0 +1,582 @@
+"""Whole-program analyses: seeded-violation proofs for every analyzer
+(upward import, transitive host-sync in jit, lock-order cycle,
+dtype-promoting plan), the layer-map golden test, and the audited-tree
+meta-tests.
+
+Seeded packages are written to tmp_path and analyzed with a purpose-built
+LayerConfig / Program, so detection is proven without touching the real
+tree; the meta-tests then pin the real tree to zero findings.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from banyandb_tpu.lint.whole_program import apply_suppressions, layer_config
+from banyandb_tpu.lint.whole_program.callgraph import (
+    Program,
+    analyze_lock_blocking,
+    analyze_sync_in_jit,
+)
+from banyandb_tpu.lint.whole_program.layers import (
+    LayerConfig,
+    analyze_layers,
+    iter_py_modules,
+)
+from banyandb_tpu.lint.whole_program.lockorder import analyze_lock_order
+from banyandb_tpu.lint.whole_program.plan_audit import KernelAudit, audit_kernel
+
+
+def _pkg(tmp_path: Path, files: dict[str, str], name: str = "mypkg") -> Path:
+    root = tmp_path / name
+    root.mkdir(parents=True, exist_ok=True)
+    (root / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        if p.name != "__init__.py" and not (p.parent / "__init__.py").exists():
+            (p.parent / "__init__.py").write_text("")
+        p.write_text(src)
+    return root
+
+
+_TWO_LAYERS = LayerConfig(
+    layers=("low", "high"),
+    may_import={"low": (), "high": ("low",)},
+    layer_of={"": "low", "lo": "low", "hi": "high"},
+)
+
+
+# -- layering ----------------------------------------------------------------
+
+
+def test_layering_upward_import_flagged(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "lo/a.py": "from mypkg.hi.b import f\n",
+            "hi/b.py": "def f():\n    return 1\n",
+        },
+    )
+    fs = analyze_layers(root, "mypkg", _TWO_LAYERS)
+    assert len(fs) == 1 and fs[0].rule == "layering"
+    assert "upward import" in fs[0].message
+    assert fs[0].path.endswith("lo/a.py") and fs[0].line == 1
+
+
+def test_layering_downward_import_clean(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "hi/b.py": "from mypkg.lo.a import g\n",
+            "lo/a.py": "def g():\n    return 1\n",
+        },
+    )
+    assert analyze_layers(root, "mypkg", _TWO_LAYERS) == []
+
+
+def test_layering_skip_layer_flagged(tmp_path):
+    cfg = LayerConfig(
+        layers=("l0", "l1", "l2"),
+        # l2 may only reach l1 — touching l0 directly is a skip
+        may_import={"l0": (), "l1": ("l0",), "l2": ("l1",)},
+        layer_of={"": "l0", "base": "l0", "mid": "l1", "top": "l2"},
+    )
+    root = _pkg(
+        tmp_path,
+        {
+            "base/a.py": "X = 1\n",
+            "top/c.py": "from mypkg.base import a\n",
+        },
+    )
+    fs = analyze_layers(root, "mypkg", cfg)
+    assert len(fs) == 1 and "skip-layer" in fs[0].message
+
+
+def test_layering_lazy_and_type_checking_imports_exempt(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "lo/a.py": (
+                "from typing import TYPE_CHECKING\n"
+                "if TYPE_CHECKING:\n"
+                "    from mypkg.hi.b import f\n"
+                "def g():\n"
+                "    from mypkg.hi.b import f\n"
+                "    return f()\n"
+            ),
+            "hi/b.py": "def f():\n    return 1\n",
+        },
+    )
+    assert analyze_layers(root, "mypkg", _TWO_LAYERS) == []
+
+
+def test_layering_unknown_module_is_failure(tmp_path):
+    root = _pkg(tmp_path, {"elsewhere/x.py": "X = 1\n"})
+    fs = analyze_layers(root, "mypkg", _TWO_LAYERS)
+    assert [f for f in fs if "maps to no layer" in f.message]
+
+
+def test_layering_ratchet_baseline(tmp_path):
+    files = {
+        "lo/a.py": "from mypkg.hi.b import f\n",
+        "hi/b.py": "def f():\n    return 1\n",
+    }
+    root = _pkg(tmp_path, files)
+    edge = frozenset({"mypkg.lo.a -> mypkg.hi.b"})
+    # baselined live violation: tolerated
+    assert analyze_layers(root, "mypkg", _TWO_LAYERS, baseline=edge) == []
+    # fixed violation with a lingering entry: stale-baseline failure
+    (root / "lo" / "a.py").write_text("A = 1\n")
+    fs = analyze_layers(root, "mypkg", _TWO_LAYERS, baseline=edge)
+    assert len(fs) == 1 and "stale baseline" in fs[0].message
+
+
+def test_real_layer_map_is_total_and_unambiguous():
+    """The golden test: every module of the real package maps to exactly
+    one layer (unknown modules are gate failures by construction)."""
+    import banyandb_tpu
+
+    pkg = Path(banyandb_tpu.__file__).parent
+    cfg = layer_config.CONFIG
+    for mod, _path in iter_py_modules(pkg, "banyandb_tpu"):
+        rel = mod[len("banyandb_tpu") + 1 :] if mod != "banyandb_tpu" else ""
+        layer = cfg.module_layer(rel)
+        assert layer is not None, f"{mod} maps to no layer; extend layer_config"
+        assert layer in cfg.layers, f"{mod} -> {layer} is not a known layer"
+
+
+def test_real_tree_layering_clean():
+    import banyandb_tpu
+
+    pkg = Path(banyandb_tpu.__file__).parent
+    fs = analyze_layers(
+        pkg, "banyandb_tpu", layer_config.CONFIG, layer_config.BASELINE
+    )
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+def test_baseline_entries_all_still_live():
+    """The ratchet's other half, stated positively: every baselined edge
+    still exists (stale entries would have failed the clean-tree test)."""
+    import banyandb_tpu
+
+    pkg = Path(banyandb_tpu.__file__).parent
+    from banyandb_tpu.lint.whole_program.layers import scan_import_edges
+
+    edges, _ = scan_import_edges(pkg, "banyandb_tpu")
+    live = {f"{e.src} -> {e.dst}" for e in edges}
+    assert layer_config.BASELINE <= live
+
+
+# -- call-graph facts --------------------------------------------------------
+
+
+def test_transitive_host_sync_in_jit_flagged(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import jax\n"
+                "from mypkg.b import helper\n"
+                "@jax.jit\n"
+                "def k(x):\n"
+                "    return helper(x)\n"
+            ),
+            "b.py": (
+                "import jax\n"
+                "from mypkg.c import deep\n"
+                "def helper(x):\n"
+                "    return deep(x)\n"
+            ),
+            "c.py": (
+                "import jax\n"
+                "def deep(x):\n"
+                "    return jax.device_get(x)\n"
+            ),
+        },
+    )
+    program = Program.build(root, "mypkg")
+    fs = analyze_sync_in_jit(program)
+    assert len(fs) == 1 and fs[0].rule == "wp-sync-in-jit"
+    assert fs[0].path.endswith("a.py") and fs[0].line == 5
+    # the witness chain names the whole path to the base API
+    assert "helper" in fs[0].message and "deep" in fs[0].message
+    assert "jax.device_get" in fs[0].message
+
+
+def test_blocking_call_in_jit_flagged(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import jax\n"
+                "from mypkg.b import probe\n"
+                "@jax.jit\n"
+                "def k(x):\n"
+                "    probe()\n"
+                "    return x\n"
+            ),
+            "b.py": (
+                "import time\n"
+                "def probe():\n"
+                "    time.sleep(1)\n"
+            ),
+        },
+    )
+    fs = analyze_sync_in_jit(Program.build(root, "mypkg"))
+    assert len(fs) == 1 and "transitively blocks" in fs[0].message
+
+
+def test_direct_sync_in_jit_not_duplicated(tmp_path):
+    # depth-0 is the per-file host-sync rule's finding, not ours
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def k(x):\n"
+                "    return jax.device_get(x)\n"
+            ),
+        },
+    )
+    assert analyze_sync_in_jit(Program.build(root, "mypkg")) == []
+
+
+def test_nested_kernel_builder_traced(tmp_path):
+    # the measure_exec pattern: nested kernel passed to jax.jit by name
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import jax\n"
+                "from mypkg.b import leak\n"
+                "def build(spec):\n"
+                "    def kernel(c):\n"
+                "        return leak(c)\n"
+                "    return jax.jit(kernel)\n"
+            ),
+            "b.py": (
+                "import jax\n"
+                "def leak(c):\n"
+                "    return jax.device_get(c)\n"
+            ),
+        },
+    )
+    fs = analyze_sync_in_jit(Program.build(root, "mypkg"))
+    assert len(fs) == 1 and fs[0].path.endswith("a.py")
+
+
+def test_own_nested_helper_resolved(tmp_path):
+    # a function calling its OWN nested def resolves ("outer.h", not a
+    # non-existent module-level "h"), so facts propagate through the
+    # common build-a-closure-and-use-it pattern
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import jax\n"
+                "from mypkg.b import outer\n"
+                "@jax.jit\n"
+                "def k(x):\n"
+                "    return outer(x)\n"
+            ),
+            "b.py": (
+                "import jax\n"
+                "def outer(x):\n"
+                "    def h(y):\n"
+                "        return jax.device_get(y)\n"
+                "    return h(x)\n"
+            ),
+        },
+    )
+    fs = analyze_sync_in_jit(Program.build(root, "mypkg"))
+    assert len(fs) == 1 and "outer" in fs[0].message
+    assert "jax.device_get" in fs[0].message
+
+
+def test_lock_blocking_across_files_flagged(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "from mypkg.b import push\n"
+                "class S:\n"
+                "    def send(self, env):\n"
+                "        with self._lock:\n"
+                "            return push(env)\n"
+            ),
+            "b.py": (
+                "def push(env):\n"
+                "    return env.transport.call('n1', 'topic', env, timeout=5)\n"
+            ),
+        },
+    )
+    fs = analyze_lock_blocking(Program.build(root, "mypkg"))
+    assert len(fs) == 1 and fs[0].rule == "wp-lock-blocking"
+    assert "S._lock" in fs[0].message and "transport.call" in fs[0].message
+
+
+def test_lock_blocking_direct_call_not_duplicated(tmp_path):
+    # a DIRECT blocking call under the lock is lock-across-rpc's finding
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import time\n"
+                "class S:\n"
+                "    def send(self):\n"
+                "        with self._lock:\n"
+                "            time.sleep(1)\n"
+            ),
+        },
+    )
+    assert analyze_lock_blocking(Program.build(root, "mypkg")) == []
+
+
+# -- lock-order cycles -------------------------------------------------------
+
+
+def test_lock_order_cycle_flagged(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "m.py": (
+                "import threading\n"
+                "ingest_lock = threading.Lock()\n"
+                "flush_lock = threading.Lock()\n"
+                "def fwd():\n"
+                "    with ingest_lock:\n"
+                "        with flush_lock:\n"
+                "            pass\n"
+                "def rev():\n"
+                "    with flush_lock:\n"
+                "        with ingest_lock:\n"
+                "            pass\n"
+            ),
+        },
+    )
+    fs = analyze_lock_order(Program.build(root, "mypkg"))
+    assert len(fs) == 1 and fs[0].rule == "lock-order"
+    assert "potential deadlock cycle" in fs[0].message
+    assert "ingest_lock" in fs[0].message and "flush_lock" in fs[0].message
+
+
+def test_lock_order_cycle_through_call_chain(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import threading\n"
+                "from mypkg.b import grab_b\n"
+                "a_lock = threading.Lock()\n"
+                "def fwd():\n"
+                "    with a_lock:\n"
+                "        grab_b()\n"
+            ),
+            "b.py": (
+                "import threading\n"
+                "import mypkg.a\n"
+                "b_lock = threading.Lock()\n"
+                "def grab_b():\n"
+                "    with b_lock:\n"
+                "        pass\n"
+                "def rev():\n"
+                "    with b_lock:\n"
+                "        with mypkg.a.a_lock:\n"
+                "            pass\n"
+            ),
+        },
+    )
+    fs = analyze_lock_order(Program.build(root, "mypkg"))
+    assert len(fs) == 1 and "via grab_b" in fs[0].message
+
+
+def test_lock_order_self_reacquire_flagged_for_plain_lock(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import threading\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def outer(self):\n"
+                "        with self._lock:\n"
+                "            self.inner()\n"
+                "    def inner(self):\n"
+                "        with self._lock:\n"
+                "            pass\n"
+            ),
+        },
+    )
+    fs = analyze_lock_order(Program.build(root, "mypkg"))
+    assert len(fs) == 1 and "acquired while already held" in fs[0].message
+
+
+def test_lock_order_rlock_self_reacquire_exempt(tmp_path):
+    root = _pkg(
+        tmp_path,
+        {
+            "a.py": (
+                "import threading\n"
+                "class S:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.RLock()\n"
+                "    def outer(self):\n"
+                "        with self._lock:\n"
+                "            self.inner()\n"
+                "    def inner(self):\n"
+                "        with self._lock:\n"
+                "            pass\n"
+            ),
+        },
+    )
+    assert analyze_lock_order(Program.build(root, "mypkg")) == []
+
+
+def test_real_tree_callgraph_analyses_clean():
+    import banyandb_tpu
+
+    pkg = Path(banyandb_tpu.__file__).parent
+    program = Program.build(pkg, "banyandb_tpu")
+    # the audit found real jit entry points — the analyses are not vacuous
+    assert sum(1 for i in program.functions.values() if i.traced) >= 4
+    assert sum(1 for i in program.functions.values() if i.block) >= 10
+    fs = (
+        analyze_sync_in_jit(program)
+        + analyze_lock_blocking(program)
+        + analyze_lock_order(program)
+    )
+    fs, _suppressed = apply_suppressions(fs)
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# -- plan auditor ------------------------------------------------------------
+
+
+def _entry(fn, expect, cache_key=None, args=None):
+    import jax
+    import jax.numpy as jnp
+
+    if args is None:
+        args = (jax.ShapeDtypeStruct((64,), jnp.int32),)
+    return KernelAudit(
+        name="seeded",
+        path="query/x.py",
+        line=1,
+        fn=fn,
+        args=args,
+        expect=expect,
+        cache_key=cache_key,
+    )
+
+
+def test_plan_audit_dtype_promotion_flagged():
+    # an int32 key column silently promoted to float: the contract table
+    # pins int32, the audit reports the drift
+    fs = audit_kernel(
+        _entry(lambda x: x + 0.5, {"<out>": ("int32", (64,))})
+    )
+    assert len(fs) == 1 and fs[0].rule == "plan-audit"
+    assert "float32" in fs[0].message and "int32" in fs[0].message
+
+
+def test_plan_audit_64bit_output_flagged():
+    import jax
+
+    if not hasattr(jax.experimental, "enable_x64"):
+        pytest.skip("no x64 context manager in this jax")
+    import jax.numpy as jnp
+
+    with jax.experimental.enable_x64():
+        fs = audit_kernel(
+            _entry(
+                lambda x: x.astype(jnp.float64),
+                None,
+                args=(jax.ShapeDtypeStruct((64,), jnp.float32),),
+            )
+        )
+    assert len(fs) == 1 and "float64" in fs[0].message
+
+
+def test_plan_audit_shape_mismatch_flagged():
+    import jax.numpy as jnp
+
+    fs = audit_kernel(
+        # reduces away the row axis while the contract expects [64]
+        _entry(lambda x: jnp.sum(x), {"<out>": ("int32", (64,))})
+    )
+    assert len(fs) == 1 and "shape=()" in fs[0].message
+
+
+def test_plan_audit_trace_failure_flagged():
+    import jax.numpy as jnp
+
+    fs = audit_kernel(
+        _entry(lambda x: x + jnp.zeros((3, 5)), {"<out>": ("int32", (64,))})
+    )
+    assert len(fs) == 1 and "abstract trace failed" in fs[0].message
+
+
+def test_plan_audit_retrace_hazard_mutable_cache_key():
+    import numpy as np
+
+    fs = audit_kernel(
+        _entry(
+            lambda x: x,
+            {"<out>": ("int32", (64,))},
+            cache_key=("plan", np.zeros(3)),
+        )
+    )
+    assert any("not deeply immutable" in f.message for f in fs)
+
+
+def test_plan_audit_retrace_hazard_identity_hash_key():
+    class IdentityKey:  # hashes by id(): equal rebuilt plans miss the cache
+        pass
+
+    fs = audit_kernel(
+        _entry(lambda x: x, {"<out>": ("int32", (64,))}, cache_key=IdentityKey())
+    )
+    assert any("not deeply immutable" in f.message for f in fs) or any(
+        "identity" in f.message for f in fs
+    )
+
+
+def test_plan_audit_real_matrix_clean():
+    from banyandb_tpu.lint.whole_program.plan_audit import run_plan_audit
+
+    fs = run_plan_audit()
+    assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# -- CLI / suppressions ------------------------------------------------------
+
+
+def test_wp_findings_honor_suppressions(tmp_path):
+    p = tmp_path / "x.py"
+    p.write_text(
+        "import jax\n"
+        "# bdlint: disable=wp-sync-in-jit -- seeded, documented\n"
+        "y = 1\n"
+    )
+    from banyandb_tpu.lint.core import Finding
+
+    f = Finding(path=str(p), line=3, col=0, rule="wp-sync-in-jit", message="m")
+    kept, suppressed = apply_suppressions([f])
+    assert kept == [] and suppressed == 1
+
+
+def test_cli_whole_program_gate_green():
+    """The acceptance run: --check over the real package exits 0 with the
+    whole-program analyses folded in (kernel audit included)."""
+    from banyandb_tpu.lint.__main__ import main
+
+    import banyandb_tpu
+
+    pkg = Path(banyandb_tpu.__file__).parent
+    assert main(["--check", str(pkg)]) == 0
