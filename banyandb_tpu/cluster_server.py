@@ -49,11 +49,21 @@ class DataServer:
         return self.grpc.addr
 
     def start(self) -> "DataServer":
+        # data nodes run the scan kernels: bind the plan-signature store
+        # and warm recorded + builtin plans before the first query lands
+        from banyandb_tpu.query.precompile import default_registry
+
+        reg = default_registry()
+        reg.attach_store(self.root / "plan-registry.json")
+        reg.warm_async()
         self.grpc.start()
         self.node.start_lifecycle()
         return self
 
     def stop(self) -> None:
+        from banyandb_tpu.query.precompile import default_registry
+
+        default_registry().shutdown()
         self.node.stop_lifecycle()
         self.grpc.stop()
 

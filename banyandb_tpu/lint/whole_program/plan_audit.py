@@ -218,40 +218,24 @@ def _bucket_findings() -> list[Finding]:
 
 
 def default_entries() -> list[KernelAudit]:
-    """The checked-in plan matrix for the banyandb_tpu query layer."""
+    """The checked-in plan matrix for the banyandb_tpu query layer.
+
+    The measure/stream kernel signatures come from the precompile
+    registry's builtin matrix (query/precompile.builtin_plans/_masks) —
+    ONE list feeds both warming and auditing, and the agreement is
+    pinned by a meta-test (tests/test_cold_path.py), so a signature the
+    server precompiles is exactly a signature this audit contracts."""
     import inspect
 
     import jax
     import jax.numpy as jnp
 
     from banyandb_tpu import ops
-    from banyandb_tpu.query import measure_exec, stream_exec
-    from banyandb_tpu.query.measure_exec import PlanSpec, _PredSpec
+    from banyandb_tpu.query import measure_exec, precompile, stream_exec
+    from banyandb_tpu.query.measure_exec import PlanSpec
 
     S = jax.ShapeDtypeStruct
     f32, i32, b8 = jnp.float32, jnp.int32, jnp.bool_
-
-    def chunk_struct(spec: PlanSpec):
-        n = spec.nrows
-        return {
-            "ts": S((n,), i32),
-            "series": S((n,), i32),
-            "valid": S((n,), b8),
-            "row": S((n,), i32),
-            "tags_code": {t: S((n,), i32) for t in spec.tags_code},
-            "fields": {f: S((n,), f32) for f in spec.fields},
-        }
-
-    def pred_struct(spec: PlanSpec):
-        out = {}
-        for i, p in enumerate(spec.preds):
-            if p.kind == "lut":
-                out[f"p{i}"] = S((p.nvals,), b8)
-            elif p.op in ("in", "not_in"):
-                out[f"p{i}"] = S((p.nvals,), i32)
-            else:
-                out[f"p{i}"] = S((), i32)
-        return out
 
     mpath = _rel_path(inspect.getsourcefile(measure_exec))
     mline = inspect.getsourcelines(measure_exec._build_kernel)[1]
@@ -267,8 +251,8 @@ def default_entries() -> list[KernelAudit]:
             line=mline,
             fn=measure_exec._build_kernel(spec),
             args=(
-                chunk_struct(spec),
-                pred_struct(spec),
+                precompile.chunk_struct(spec),
+                precompile.pred_struct(spec),
                 S((), f32),
                 S((), f32),
             ),
@@ -293,83 +277,21 @@ def default_entries() -> list[KernelAudit]:
 
     entries: list[KernelAudit] = []
 
-    # 1. flat count (no groups, no predicates) — the cheapest dashboard tile
-    flat = PlanSpec(
-        tags_code=(),
-        fields=("v",),
-        preds=(),
-        group_tags=(),
-        radices=(),
-        num_groups=1,
-        want_minmax=True,
-        nrows=8192,
-    )
-    entries.append(measure_entry("measure/flat-count", flat, base_expect(flat)))
+    for name, spec in precompile.builtin_plans():
+        entries.append(measure_entry(name, spec, base_expect(spec)))
 
-    # 2. grouped eq+LUT predicates with scan-order (rep) tracking
-    grouped = PlanSpec(
-        tags_code=("region", "svc"),
-        fields=("v",),
-        preds=(
-            _PredSpec("code", "svc", "eq"),
-            _PredSpec("lut", "region", "le", nvals=4),
-        ),
-        group_tags=("svc", "region"),
-        radices=(8, 4),
-        num_groups=32,
-        want_minmax=True,
-        nrows=8192,
-        want_rep=True,
-    )
-    entries.append(measure_entry("measure/group-eq-lut", grouped, base_expect(grouped)))
-
-    # 3. percentile histogram at a scan-chunk bucket (the two-pass plan)
-    pct = PlanSpec(
-        tags_code=("svc",),
-        fields=("lat",),
-        preds=(),
-        group_tags=("svc",),
-        radices=(16,),
-        num_groups=16,
-        want_minmax=True,
-        hist_field="lat",
-        nrows=65536,
-    )
-    entries.append(measure_entry("measure/percentile-hist", pct, base_expect(pct)))
-
-    # 4. OR expression tree over an in-set + eq predicate (Criteria lowering)
-    orplan = PlanSpec(
-        tags_code=("svc",),
-        fields=("v",),
-        preds=(
-            _PredSpec("code", "svc", "in", nvals=4),
-            _PredSpec("code", "svc", "eq"),
-        ),
-        group_tags=(),
-        radices=(),
-        num_groups=1,
-        want_minmax=False,
-        nrows=8192,
-        expr=("or", ("p", 0), ("p", 1)),
-    )
-    entries.append(measure_entry("measure/or-expr", orplan, base_expect(orplan)))
-
-    # 5. stream retrieval mask kernel (eq + padded in-set)
-    mspec = stream_exec._MaskSpec(preds=(("eq", 1), ("in", 4)), nrows=32768)
-    entries.append(
-        KernelAudit(
-            name="stream/mask-eq-in",
-            path=str(spath),
-            line=sline,
-            fn=stream_exec._build_kernel(mspec),
-            args=(
-                (S((32768,), i32), S((32768,), i32)),
-                (S((), i32), S((4,), i32)),
-            ),
-            expect={"<out>": ("bool", (32768,))},
-            cache_key=mspec,
+    for name, mspec in precompile.builtin_masks():
+        entries.append(
+            KernelAudit(
+                name=name,
+                path=str(spath),
+                line=sline,
+                fn=stream_exec._build_kernel(mspec),
+                args=precompile.mask_structs(mspec),
+                expect={"<out>": ("bool", (mspec.nrows,))},
+                cache_key=mspec,
+            )
         )
-    )
 
     # 6. the shared ops reductions every plan lowers onto, at a
     # representative grouped shape (method dispatch goes through "auto")

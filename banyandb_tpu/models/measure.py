@@ -541,6 +541,14 @@ class MeasureEngine:
         for name, db in self._tsdbs.items():
             if group is None or name == group:
                 out.extend(db.flush_all())
+        if out:
+            # first-flush hook: parts now exist on disk, so the next
+            # query is the cold one — warm recorded plan kernels in the
+            # background (no-op unless BYDB_PRECOMPILE; lazy import keeps
+            # the engines layer from depending upward on query/)
+            from banyandb_tpu.query.precompile import default_registry
+
+            default_registry().note_flush()
         return out
 
     # -- query path (query.go:88 analog) -----------------------------------
@@ -678,7 +686,16 @@ class MeasureEngine:
     def _gather_sources(
         self, db: TSDB, m: Measure, req: QueryRequest, shard_ids=None
     ) -> list[ColumnData]:
-        sources: list[ColumnData] = []
+        """Collect per-source decode thunks (metadata-only work: segment
+        selection, series-index pruning, block selection), then evaluate
+        them through the prefetchable chunk stream — part *k+1* decodes
+        on the prefetch thread while part *k*'s rows series-filter and
+        append on this one.  Thunk order is the serial iteration order,
+        so the concatenation (and every downstream dedup/accumulation)
+        is byte-identical to the strict-serial path (BYDB_PIPELINE=0)."""
+        from banyandb_tpu.storage.chunk_stream import prefetched
+
+        read_ops = []
         tag_names = _tag_col_names(m)  # incl. '@f:' raw-field columns
         field_names = [f.name for f in _numeric_fields(m)]
         entity_conds = _entity_eq_conditions(m, req)
@@ -713,14 +730,19 @@ class MeasureEngine:
             if series_ids is not None:
                 sfilter_key = hash(series_ids.tobytes())
 
-            def _series_rows(src: ColumnData, ckey) -> Optional[ColumnData]:
-                if series_ids is None:
+            # evaluation is DEFERRED to the prefetch stream below, and
+            # series_ids/sfilter_key are reassigned per segment — bind
+            # this segment's values as defaults, not closure cells
+            def _series_rows(
+                src: ColumnData, ckey, sids=series_ids, skey=sfilter_key
+            ) -> Optional[ColumnData]:
+                if sids is None:
                     return src
                 keep = np.zeros(src.series.shape[0], dtype=bool)
-                if series_ids.size:
-                    pos = np.searchsorted(series_ids, src.series)
-                    pos[pos >= series_ids.size] = 0
-                    keep = series_ids[pos] == src.series
+                if sids.size:
+                    pos = np.searchsorted(sids, src.series)
+                    pos[pos >= sids.size] = 0
+                    keep = sids[pos] == src.series
                 if not keep.any():
                     return None
                 if keep.all():
@@ -733,18 +755,28 @@ class MeasureEngine:
                     fields={f: v[keep] for f, v in src.fields.items()},
                     dicts=src.dicts,
                     cache_key=(
-                        (*ckey, "sfilter", sfilter_key) if ckey else None
+                        (*ckey, "sfilter", skey) if ckey else None
                     ),
                 )
+
+            def _read_part(part, blocks, filt):
+                src = part.read(
+                    blocks,
+                    tags=[t for t in tag_names if t in part.meta["tags"]],
+                    fields=[f for f in field_names if f in part.meta["fields"]],
+                )
+                return filt(src, src.cache_key)
 
             for shard_idx, shard in enumerate(seg.shards):
                 if shard_ids is not None and shard_idx not in shard_ids:
                     continue
                 mem_cols = shard.mem.columns_for(m.name)
                 if mem_cols is not None and mem_cols.ts.size:
-                    mem_cols = _series_rows(mem_cols, mem_cols.cache_key)
-                    if mem_cols is not None:
-                        sources.append(mem_cols)
+                    read_ops.append(
+                        lambda mc=mem_cols, filt=_series_rows: filt(
+                            mc, mc.cache_key
+                        )
+                    )
                 for part in shard.parts:
                     if part.meta.get("measure") != m.name:
                         continue
@@ -754,15 +786,18 @@ class MeasureEngine:
                         series_ids=series_ids,
                     )
                     if blocks:
-                        src = part.read(
-                            blocks,
-                            tags=[t for t in tag_names if t in part.meta["tags"]],
-                            fields=[f for f in field_names if f in part.meta["fields"]],
+                        read_ops.append(
+                            lambda p=part, b=blocks, filt=_series_rows,
+                            rd=_read_part: rd(p, b, filt)
                         )
-                        src = _series_rows(src, src.cache_key)
-                        if src is not None:
-                            sources.append(src)
-        return sources
+        # a mid-stream decode error (e.g. a part merged away under us)
+        # re-raises here exactly as the serial loop would — query()'s
+        # FileNotFoundError retry still applies
+        return [
+            src
+            for src in prefetched(read_ops, name="bydb-part-prefetch")
+            if src is not None
+        ]
 
 
 def _tag_to_bytes(value, tag_type: TagType) -> bytes:

@@ -222,6 +222,15 @@ class StreamEngine:
         tag_names = [t.name for t in s.tags]
         inverted, skipping = self._index_tags(req.groups[0], s.name)
         stats = {"blocks_selected": 0, "blocks_read": 0, "blocks_skipped": 0}
+        from banyandb_tpu.storage.chunk_stream import prefetched
+
+        # the stream analog of the measure gather/compute pipeline: the
+        # loop below only does metadata work (block selection, sidecar
+        # pruning) and collects decode thunks; evaluation through the
+        # prefetch stream overlaps part k+1's disk decode with part k's
+        # mask+gather — order (and therefore result order) is identical
+        # to the strict-serial path (BYDB_PIPELINE=0)
+        read_ops: list = []
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
         ):
@@ -229,7 +238,8 @@ class StreamEngine:
                 if shard_ids is not None and shard_idx not in shard_ids:
                     continue
                 mem_cols = shard.mem.columns_for(s.name)
-                sources = [mem_cols] if mem_cols is not None and mem_cols.ts.size else []
+                if mem_cols is not None and mem_cols.ts.size:
+                    read_ops.append(lambda mc=mem_cols: mc)
                 for part in shard.parts:
                     if part.meta.get("stream") != s.name:
                         continue
@@ -245,15 +255,19 @@ class StreamEngine:
                             blocks = [b for b in blocks if b in allowed]
                     stats["blocks_read"] += len(blocks)
                     if blocks:
-                        sources.append(
-                            part.read(
-                                blocks,
-                                tags=[t for t in tag_names if t in part.meta["tags"]],
+                        read_ops.append(
+                            lambda p=part, b=blocks: p.read(
+                                b,
+                                tags=[
+                                    t
+                                    for t in tag_names
+                                    if t in p.meta["tags"]
+                                ],
                                 want_payload=True,
                             )
                         )
-                for src in sources:
-                    rows.extend(self._filter_source(s, src, req, conds))
+        for src in prefetched(read_ops, name="bydb-stream-prefetch"):
+            rows.extend(self._filter_source(s, src, req, conds))
         stats["blocks_skipped"] = stats["blocks_selected"] - stats["blocks_read"]
         self.last_scan_stats = stats
         return rows

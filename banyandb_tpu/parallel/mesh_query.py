@@ -92,12 +92,23 @@ class MeshExecutor:
         gd = measure_exec.GlobalDicts(tags_code)
 
         # --- select sources per node (its assigned shards only) ----------
-        per_node_srcs = []
+        # nodes' gathers are independent (per-node TSDBs; the shared
+        # serving cache is lock-guarded), so decode them concurrently —
+        # parallel_map preserves assignment order, keeping the combine
+        # order (and thus results) identical to the serial loop
+        from banyandb_tpu.storage.chunk_stream import parallel_map
+
+        gather_ops = []
         for node, shards in assignment.items():
             eng = self.engines.get(node.name)
             if eng is None:
                 raise MeshUnsupported(f"no in-process engine for {node.name}")
-            per_node_srcs.append(eng.gather_query_sources(req, shard_ids=shards))
+            gather_ops.append(
+                lambda e=eng, sh=shards: e.gather_query_sources(
+                    req, shard_ids=sh
+                )
+            )
+        per_node_srcs = parallel_map(gather_ops)
 
         # group-cardinality budget BEFORE the expensive row gather/dedup:
         # union the sources' own dictionaries per group tag (dict metadata

@@ -702,6 +702,10 @@ def compute_partials(
     kernel = _KERNEL_CACHE.get(spec)
     if kernel is None:
         kernel = _KERNEL_CACHE[spec] = _build_kernel(spec)
+    # function-local import: precompile imports this module's builders
+    from banyandb_tpu.query.precompile import default_registry
+
+    default_registry().record("measure", spec)
 
     # --- histogram range from host stats (two-pass percentile) ------------
     if hist_range is not None:
@@ -846,16 +850,19 @@ def _reduce_partials(
             rep_ts_acc = np.where(better, rts, rep_ts_acc)
             rep_row_acc = np.where(better, rrow, rep_row_acc)
 
-    # One-deep dispatch pipeline: chunk k's device->host transfer happens
-    # AFTER chunk k+1's kernel is dispatched, so transfer overlaps
-    # compute.  The whole result pytree moves in a single batched
-    # device_get per chunk instead of one blocking np.asarray per column
-    # (the 29-site host-sync audit that motivated bdlint).
-    pending = None
-    for start in range(0, max(n, 1), spec.nrows):
-        end = min(start + spec.nrows, n)
-        if end <= start:
-            break
+    # Gather/compute pipeline, two overlaps stacked per chunk:
+    # (1) while the device executes chunk k, a prefetch thread pads and
+    #     ships chunk k+1 (storage/chunk_stream; BYDB_PIPELINE=0 forces
+    #     the strict-serial path — results are byte-identical either
+    #     way because chunks are absorbed in scan order regardless);
+    # (2) chunk k's device->host transfer happens AFTER chunk k+1's
+    #     kernel is dispatched, so transfer overlaps compute.  The whole
+    #     result pytree moves in a single batched device_get per chunk
+    #     instead of one blocking np.asarray per column (the 29-site
+    #     host-sync audit that motivated bdlint).
+    from banyandb_tpu.storage.chunk_stream import prefetched
+
+    def _make_chunk(start: int, end: int):
         if dev_cache is not None:
             # Chunks depend only on (gathered data, shape, columns): keep
             # the padded device arrays resident so repeat queries skip
@@ -869,11 +876,23 @@ def _reduce_partials(
                 spec.tags_code,
                 spec.fields,
             )
-            chunk = dev_cache.get_or_load(
+            return dev_cache.get_or_load(
                 ck, lambda: _device_chunk(chunks_np, start, end, spec, epoch)
             )
-        else:
-            chunk = _device_chunk(chunks_np, start, end, spec, epoch)
+        return _device_chunk(chunks_np, start, end, spec, epoch)
+
+    spans = []
+    for start in range(0, max(n, 1), spec.nrows):
+        end = min(start + spec.nrows, n)
+        if end <= start:
+            break
+        spans.append((start, end))
+
+    pending = None
+    for chunk in prefetched(
+        [lambda s=s, e=e: _make_chunk(s, e) for s, e in spans],
+        name="bydb-chunk-prefetch",
+    ):
         out = kernel(chunk, pred_vals, hist_lo_dev, hist_span_dev)
         if pending is not None:
             # bdlint: disable=host-sync -- the result boundary: one

@@ -1,0 +1,510 @@
+"""Plan precompile registry: compile query kernels before queries arrive.
+
+Three cooperating pieces close the cold-start compile gap:
+
+1. **Recording**: the measure/stream executors call ``record()`` every
+   time they resolve a plan signature (``PlanSpec`` / ``_MaskSpec``), so
+   the registry always knows the live plan population of this process.
+2. **Persistence**: when a server attaches a store file
+   (``<root>/plan-registry.json``), newly seen signatures are saved (top
+   ``MAX_STORED`` by use count) and reloaded on the next boot — the
+   process remembers WHICH kernels matter across restarts, while
+   ``utils/compile_cache`` remembers their compiled XLA executables.
+3. **Warming**: ``warm_async()`` (server start = schema load, and once
+   after the first flush via ``note_flush``) compiles the stored
+   signatures plus the builtin dashboard matrix on a background daemon
+   thread, by building each kernel into the executors' process-global
+   jit caches and dispatching it once on zero-filled arguments of the
+   exact production shapes/dtypes — so the first real query finds a
+   warm jit cache instead of paying XLA compilation.
+
+``builtin_plans()``/``builtin_masks()`` are the checked-in dashboard
+kernel matrix.  The lint plan auditor
+(``lint/whole_program/plan_audit.py``) eval_shape-audits EXACTLY this
+list — a meta-test pins the agreement, so a signature added here is
+automatically contract-checked and a signature audited is automatically
+precompiled.
+
+``BYDB_PRECOMPILE=0`` disables recording and warming (tests that need a
+deterministic kernel-cache population set this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Optional
+
+MAX_STORED = 64
+
+_ON = ("1", "on", "yes", "true")
+
+
+def enabled() -> bool:
+    return os.environ.get("BYDB_PRECOMPILE", "1").strip().lower() in _ON
+
+
+# -- the builtin dashboard matrix (single source for warm + plan audit) ------
+
+
+def builtin_plans():
+    """(name, PlanSpec) pairs: the dashboard plan population.
+
+    Mirrors the shapes real consoles issue: flat count tiles, grouped
+    eq+LUT filters with scan-order tracking, the two-pass percentile
+    histogram, OR criteria trees, and the TopN ranking shape (grouped
+    mean/minmax + representative tracking at a scan-chunk bucket)."""
+    from banyandb_tpu.query.measure_exec import PlanSpec, _PredSpec
+
+    flat = PlanSpec(
+        tags_code=(),
+        fields=("v",),
+        preds=(),
+        group_tags=(),
+        radices=(),
+        num_groups=1,
+        want_minmax=True,
+        nrows=8192,
+    )
+    grouped = PlanSpec(
+        tags_code=("region", "svc"),
+        fields=("v",),
+        preds=(
+            _PredSpec("code", "svc", "eq"),
+            _PredSpec("lut", "region", "le", nvals=4),
+        ),
+        group_tags=("svc", "region"),
+        radices=(8, 4),
+        num_groups=32,
+        want_minmax=True,
+        nrows=8192,
+        want_rep=True,
+    )
+    pct = PlanSpec(
+        tags_code=("svc",),
+        fields=("lat",),
+        preds=(),
+        group_tags=("svc",),
+        radices=(16,),
+        num_groups=16,
+        want_minmax=True,
+        hist_field="lat",
+        nrows=65536,
+    )
+    orplan = PlanSpec(
+        tags_code=("svc",),
+        fields=("v",),
+        preds=(
+            _PredSpec("code", "svc", "in", nvals=4),
+            _PredSpec("code", "svc", "eq"),
+        ),
+        group_tags=(),
+        radices=(),
+        num_groups=1,
+        want_minmax=False,
+        nrows=8192,
+        expr=("or", ("p", 0), ("p", 1)),
+    )
+    topn = PlanSpec(
+        tags_code=("region", "svc"),
+        fields=("value",),
+        preds=(_PredSpec("code", "region", "ne"),),
+        group_tags=("svc",),
+        radices=(1024,),
+        num_groups=1024,
+        want_minmax=True,
+        nrows=65536,
+        want_rep=True,
+    )
+    return (
+        ("measure/flat-count", flat),
+        ("measure/group-eq-lut", grouped),
+        ("measure/percentile-hist", pct),
+        ("measure/or-expr", orplan),
+        ("measure/topn-dashboard", topn),
+    )
+
+
+def builtin_masks():
+    """(name, _MaskSpec) pairs for the stream retrieval mask kernel."""
+    from banyandb_tpu.query.stream_exec import _MaskSpec
+
+    return (
+        ("stream/mask-eq-in", _MaskSpec(preds=(("eq", 1), ("in", 4)), nrows=32768)),
+    )
+
+
+# -- shape/dtype argument builders (shared with the lint plan auditor) -------
+
+
+def chunk_struct(spec) -> dict:
+    """ShapeDtypeStruct pytree matching _device_chunk's output exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    n = spec.nrows
+    return {
+        "ts": S((n,), jnp.int32),
+        "series": S((n,), jnp.int32),
+        "valid": S((n,), jnp.bool_),
+        "row": S((n,), jnp.int32),
+        "tags_code": {t: S((n,), jnp.int32) for t in spec.tags_code},
+        "fields": {f: S((n,), jnp.float32) for f in spec.fields},
+    }
+
+
+def pred_struct(spec) -> dict:
+    """ShapeDtypeStruct map matching compute_partials' pred_vals."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    out = {}
+    for i, p in enumerate(spec.preds):
+        if p.kind == "lut":
+            out[f"p{i}"] = S((p.nvals,), jnp.bool_)
+        elif p.op in ("in", "not_in"):
+            out[f"p{i}"] = S((p.nvals,), jnp.int32)
+        else:
+            out[f"p{i}"] = S((), jnp.int32)
+    return out
+
+
+def mask_structs(mspec) -> tuple:
+    """(cols, pred_vals) ShapeDtypeStructs matching device_tag_mask."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    cols = tuple(S((mspec.nrows,), jnp.int32) for _ in mspec.preds)
+    vals = tuple(
+        S((nv,), jnp.int32) if op in ("in", "not_in") else S((), jnp.int32)
+        for op, nv in mspec.preds
+    )
+    return cols, vals
+
+
+def _zeros_like_structs(tree):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), tree
+    )
+
+
+def measure_warm_args(spec) -> tuple:
+    """Zero-filled production-shaped args for one measure plan kernel."""
+    import jax.numpy as jnp
+
+    return (
+        _zeros_like_structs(chunk_struct(spec)),
+        _zeros_like_structs(pred_struct(spec)),
+        jnp.float32(0.0),
+        jnp.float32(1.0),
+    )
+
+
+def mask_warm_args(mspec) -> tuple:
+    cols, vals = mask_structs(mspec)
+    return (_zeros_like_structs(cols), _zeros_like_structs(vals))
+
+
+# -- signature (de)serialization ---------------------------------------------
+
+
+def spec_to_json(kind: str, spec) -> dict:
+    d = dataclasses.asdict(spec)
+    d["kind"] = kind
+    return d
+
+
+def _tuplify(node):
+    """JSON lists -> tuples, recursively (expr trees, pred tuples)."""
+    if isinstance(node, list):
+        return tuple(_tuplify(v) for v in node)
+    return node
+
+
+def spec_from_json(d: dict):
+    kind = d["kind"]
+    if kind == "measure":
+        from banyandb_tpu.query.measure_exec import PlanSpec, _PredSpec
+
+        return kind, PlanSpec(
+            tags_code=tuple(d["tags_code"]),
+            fields=tuple(d["fields"]),
+            preds=tuple(_PredSpec(**p) for p in d["preds"]),
+            group_tags=tuple(d["group_tags"]),
+            radices=tuple(d["radices"]),
+            num_groups=int(d["num_groups"]),
+            want_minmax=bool(d["want_minmax"]),
+            hist_field=d.get("hist_field", ""),
+            nrows=int(d["nrows"]),
+            group_method=d.get("group_method", "auto"),
+            want_rep=bool(d.get("want_rep", False)),
+            rep_desc=bool(d.get("rep_desc", False)),
+            expr=_tuplify(d.get("expr", [])),
+        )
+    if kind == "stream_mask":
+        from banyandb_tpu.query.stream_exec import _MaskSpec
+
+        return kind, _MaskSpec(
+            preds=_tuplify(d["preds"]), nrows=int(d["nrows"])
+        )
+    raise ValueError(f"unknown plan signature kind {kind!r}")
+
+
+# -- the registry ------------------------------------------------------------
+
+
+class PrecompileRegistry:
+    """Thread-safe record of live plan signatures + background warming."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (kind, spec) -> use count; insertion order = first-seen order
+        self._recorded: dict[tuple, int] = {}
+        self._store_path: Optional[Path] = None
+        self._warm_thread: Optional[threading.Thread] = None
+        self._warm_pending = False
+        self._cancel = threading.Event()
+        self._save_timer: Optional[threading.Timer] = None
+        self._flush_warmed = False
+        self.compiled = 0
+        self.errors = 0
+
+    # -- recording / persistence --------------------------------------------
+    def record(self, kind: str, spec) -> None:
+        """Called by executors on every plan resolution.  Never blocks
+        the query hot path: a first-seen signature schedules a debounced
+        background save instead of rewriting the store inline."""
+        if not enabled():
+            return
+        new = False
+        with self._lock:
+            n = self._recorded.get((kind, spec))
+            self._recorded[(kind, spec)] = (n or 0) + 1
+            new = n is None and self._store_path is not None
+        if new:
+            self._schedule_save()
+
+    def _schedule_save(self, delay: float = 1.0) -> None:
+        with self._lock:
+            if self._save_timer is not None:
+                return  # a pending save will pick this signature up too
+            t = threading.Timer(delay, self._save_timer_fire)
+            t.daemon = True
+            t.name = "bydb-plan-save"
+            self._save_timer = t
+        t.start()
+
+    def _save_timer_fire(self) -> None:
+        with self._lock:
+            self._save_timer = None
+        self._save()
+
+    def attach_store(self, path) -> None:
+        """Bind (and load) the persistent signature store."""
+        p = Path(path)
+        loaded: list[tuple[tuple, int]] = []
+        try:
+            if p.exists():
+                for rec in json.loads(p.read_text()).get("signatures", []):
+                    try:
+                        kind, spec = spec_from_json(rec)
+                        loaded.append(((kind, spec), int(rec.get("count", 1))))
+                    except Exception:  # noqa: BLE001 — skip stale entries
+                        continue
+        except (OSError, ValueError):
+            loaded = []
+        with self._lock:
+            self._store_path = p
+            for key, count in loaded:
+                self._recorded[key] = max(self._recorded.get(key, 0), count)
+            have_unsaved = len(self._recorded) > len(loaded)
+        if have_unsaved:
+            # signatures recorded before the store was bound (embedded
+            # engines, bench) persist now, not on the next new plan
+            self._save()
+
+    def _save(self) -> None:
+        with self._lock:
+            p = self._store_path
+            if p is None:
+                return
+            top = sorted(
+                self._recorded.items(), key=lambda kv: -kv[1]
+            )[:MAX_STORED]
+            doc = {
+                "signatures": [
+                    {**spec_to_json(kind, spec), "count": count}
+                    for (kind, spec), count in top
+                ]
+            }
+        try:
+            p.parent.mkdir(parents=True, exist_ok=True)
+            tmp = p.with_suffix(".tmp")
+            tmp.write_text(json.dumps(doc, indent=1))
+            os.replace(tmp, p)
+        except OSError:
+            pass  # persistence is an optimization, never a query failure
+
+    def signatures(self) -> list[tuple[str, object]]:
+        with self._lock:
+            return [
+                (k, s)
+                for (k, s), _ in sorted(
+                    self._recorded.items(), key=lambda kv: -kv[1]
+                )
+            ]
+
+    # -- warming -------------------------------------------------------------
+    def _compile_one(self, kind: str, spec) -> None:
+        import jax
+
+        from banyandb_tpu.query import measure_exec, stream_exec
+
+        if kind == "measure":
+            cache, build, args = (
+                measure_exec._KERNEL_CACHE,
+                measure_exec._build_kernel,
+                measure_warm_args(spec),
+            )
+        elif kind == "stream_mask":
+            cache, build, args = (
+                stream_exec._KERNEL_CACHE,
+                stream_exec._build_kernel,
+                mask_warm_args(spec),
+            )
+        else:
+            return
+        kernel = cache.get(spec)
+        if kernel is None:
+            kernel = cache[spec] = build(spec)
+        # one dispatch on zero args of the production shapes: populates
+        # the jit executable cache AND (through utils/compile_cache) the
+        # persistent XLA cache; values are irrelevant to the cache key
+        # bdlint: disable=host-sync -- warming runs on a background
+        # thread and MUST block until the compile finishes; there is no
+        # result to batch
+        jax.block_until_ready(kernel(*args))
+
+    def warm(self, include_builtin: bool = True, sigs=None) -> int:
+        """Compile signatures now (callers wanting async use warm_async)."""
+        if sigs is None:
+            sigs = list(self.signatures())
+            if include_builtin:
+                sigs += [("measure", s) for _, s in builtin_plans()]
+                sigs += [("stream_mask", s) for _, s in builtin_masks()]
+        done = 0
+        seen = set()
+        for kind, spec in sigs:
+            if self._cancel.is_set():
+                break  # shutdown: stop at a kernel boundary, never mid-compile
+            if (kind, spec) in seen:
+                continue
+            seen.add((kind, spec))
+            try:
+                self._compile_one(kind, spec)
+                done += 1
+            except Exception:  # noqa: BLE001 — warm must never take a server down
+                self.errors += 1
+        self.compiled += done
+        return done
+
+    def _warm_loop(self, include_builtin: bool) -> None:
+        """Warm rounds until no more work was queued while running —
+        a note_flush/warm_async arriving mid-round (e.g. plans recorded
+        while the boot warm is still compiling) queues another round
+        instead of being silently dropped."""
+        while True:
+            self.warm(include_builtin=include_builtin)
+            with self._lock:
+                if not self._warm_pending or self._cancel.is_set():
+                    return
+                self._warm_pending = False
+            include_builtin = False  # follow-up rounds: recorded sigs only
+
+    def warm_async(self, include_builtin: bool = True) -> Optional[threading.Thread]:
+        """Background warm (server start / post-flush).  If a warm is
+        already running, queues one more round for when it finishes."""
+        if not enabled():
+            return None
+        with self._lock:
+            if self._warm_thread is not None and self._warm_thread.is_alive():
+                self._warm_pending = True
+                return self._warm_thread
+            t = threading.Thread(
+                target=self._warm_loop,
+                args=(include_builtin,),
+                name="bydb-precompile",
+                daemon=True,
+            )
+            self._warm_thread = t
+        t.start()
+        return t
+
+    def note_flush(self) -> None:
+        """First-flush hook: parts now exist on disk, the next query is
+        the cold one — warm the recorded population once."""
+        if not enabled():
+            return
+        with self._lock:
+            if self._flush_warmed or not self._recorded:
+                return
+            self._flush_warmed = True
+        self.warm_async(include_builtin=False)
+
+    def wait_warm(self, timeout: float = 120.0) -> bool:
+        """Block until the in-flight warm finishes (bench/tests)."""
+        with self._lock:
+            t = self._warm_thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Server-stop hook: cancel warming at the next kernel boundary
+        and join, so process exit never lands mid-XLA-compile (a daemon
+        thread killed inside C++ aborts the interpreter); flushes any
+        pending store save."""
+        with self._lock:
+            self._warm_pending = False
+            self._cancel.set()
+            t = self._warm_thread
+            timer = self._save_timer
+            self._save_timer = None
+        if timer is not None:
+            timer.cancel()
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                return  # leave cancel set; the thread exits at its next check
+        self._cancel.clear()
+        self._save()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": enabled(),
+                "recorded": len(self._recorded),
+                "stored": str(self._store_path) if self._store_path else None,
+                "compiled": self.compiled,
+                "errors": self.errors,
+                "warming": bool(
+                    self._warm_thread and self._warm_thread.is_alive()
+                ),
+            }
+
+
+_registry = PrecompileRegistry()
+
+
+def default_registry() -> PrecompileRegistry:
+    return _registry

@@ -109,6 +109,9 @@ def device_tag_mask(src: ColumnData, conds: list[Condition]):
     kernel = _KERNEL_CACHE.get(spec)
     if kernel is None:
         kernel = _KERNEL_CACHE[spec] = _build_kernel(spec)
+    from banyandb_tpu.query.precompile import default_registry
+
+    default_registry().record("stream_mask", spec)
     import jax
 
     # bdlint: disable=host-sync -- the retrieval result boundary: the

@@ -297,6 +297,27 @@ class StandaloneServer:
 
     def _metrics(self, env):
         self.meter.gauge_set("rss_bytes", _rss())
+        # cache planes surface through /metrics so the bench and
+        # operators read hit/miss/eviction counters from the RUNNING
+        # server, not process-local globals (ISSUE 3 satellite)
+        from banyandb_tpu.query.precompile import default_registry
+        from banyandb_tpu.storage.cache import device_cache, global_cache
+        from banyandb_tpu.utils import compile_cache
+
+        for scope, cache in (
+            ("serving", global_cache()),
+            ("device", device_cache()),
+        ):
+            st = cache.stats()
+            for k in ("hits", "misses", "evictions", "entries", "bytes"):
+                self.meter.gauge_set(f"{scope}_cache_{k}", float(st[k]))
+        cc = compile_cache.stats()
+        self.meter.gauge_set("compile_cache_enabled", float(cc["enabled"]))
+        for k in ("hits", "misses", "entries"):
+            self.meter.gauge_set(f"compile_cache_{k}", float(cc[k]))
+        pr = default_registry().stats()
+        for k in ("recorded", "compiled", "errors"):
+            self.meter.gauge_set(f"precompile_{k}", float(pr[k]))
         return {"prometheus": self.meter.prometheus_text()}
 
     def _topn(self, env):
@@ -475,6 +496,16 @@ class StandaloneServer:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
+        # plan precompile at schema load: bind the per-root signature
+        # store and warm recorded + builtin plan kernels on a background
+        # thread, so the first query after boot hits a warm jit cache
+        # (paired with the persistent XLA cache wired at process start —
+        # see utils/compile_cache and docs/performance.md)
+        from banyandb_tpu.query.precompile import default_registry
+
+        reg = default_registry()
+        reg.attach_store(self.root / "plan-registry.json")
+        reg.warm_async()
         # one lifecycle group drives storage loops for ALL engines' TSDBs
         # AND property-lease GC
         self.measure.start_lifecycle(
@@ -511,6 +542,11 @@ class StandaloneServer:
             pass
 
     def stop(self) -> None:
+        # cancel + join in-flight plan warming FIRST: exiting while the
+        # daemon thread is inside an XLA compile aborts the interpreter
+        from banyandb_tpu.query.precompile import default_registry
+
+        default_registry().shutdown()
         self.measure.stop_lifecycle()
         self.watchdog.stop()
         self.grpc.stop()
@@ -541,6 +577,11 @@ def build_config():
     )
     cfg.register("http-port", 17913, "HTTP/JSON gateway; -1 disables", int)
     cfg.register("pprof-port", -1, "profiling endpoints; -1 disables", int)
+    cfg.register(
+        "compile-cache-dir", "",
+        "persistent XLA compile cache; empty = <root>/compile-cache, "
+        "'off' disables", str,
+    )
     # role topology (pkg/cmdsetup/root.go:89-91 standalone/data/liaison)
     cfg.register("role", "standalone", "standalone | data | liaison", str)
     cfg.register("name", "", "node name (data role)", str)
@@ -555,6 +596,18 @@ def main(argv=None) -> None:
     from banyandb_tpu.run import FuncUnit, Group
 
     s = build_config().load(argv)
+    # persistent XLA compile cache, wired before any kernel compiles:
+    # plan kernels compile once per machine, not once per process.  The
+    # flag has already folded CLI > BYDB_COMPILE_CACHE_DIR env > config
+    # file precedence via config.py.
+    from pathlib import Path as _Path
+
+    from banyandb_tpu.utils import compile_cache
+
+    if s.compile_cache_dir:
+        compile_cache.enable_at(s.compile_cache_dir)
+    else:
+        compile_cache.enable_at(_Path(s.root) / "compile-cache")
     # role-irrelevant flags must not silently do nothing (an operator
     # passing --http-port to a liaison would wait on a port never bound)
     _ignored = {

@@ -52,6 +52,7 @@ class ServingCache:
         self.bytes = 0
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def get_or_load(self, key: tuple, loader: Callable[[], object]):
         with self._lock:
@@ -76,6 +77,7 @@ class ServingCache:
             while self.bytes > self.budget and self._entries:
                 _, (_, evicted) = self._entries.popitem(last=False)
                 self.bytes -= evicted
+                self.evictions += 1
         return value
 
     def invalidate_prefix(self, prefix: tuple) -> int:
@@ -103,6 +105,7 @@ class ServingCache:
                 "budget": self.budget,
                 "hits": self.hits,
                 "misses": self.misses,
+                "evictions": self.evictions,
             }
 
 

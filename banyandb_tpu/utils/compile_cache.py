@@ -1,0 +1,130 @@
+"""Persistent XLA compilation cache wiring (+ hit/miss counters).
+
+The cold path pays one XLA compile per plan signature per PROCESS; on a
+restart every dashboard query recompiles kernels whose HLO has not
+changed.  Pointing ``jax_compilation_cache_dir`` at a directory that
+outlives the process (default: ``<data-root>/compile-cache``) makes plan
+kernels compile once per machine — the Tailwind-style "plans stay
+resident across restarts" property, at the XLA executable layer.
+
+Resolution order for the directory, most specific wins:
+
+    explicit CLI flag (``--compile-cache-dir``, via enable_at)
+      >  BYDB_COMPILE_CACHE_DIR env var (``off``/``0`` disables)
+      >  the caller's computed default (``enable(default_dir)``)
+
+Wiring is process-global and first-wins (the cache key hashes the whole
+HLO, so sharing one directory between roots is safe); ``stats()`` feeds
+the /metrics surface and the bench artifact.  Hit/miss counts come from
+jax's own monitoring events (``/jax/compilation_cache/cache_hits`` and
+``.../cache_misses``) so they reflect what XLA actually did, not what we
+hoped.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_DISABLE_VALUES = ("0", "off", "no", "none", "false", "disabled")
+
+_lock = threading.Lock()
+_state = {
+    "enabled": False,
+    "dir": None,
+    "hits": 0,
+    "misses": 0,
+    "listener": False,
+    "error": None,
+}
+
+
+def _install_listener() -> None:
+    """Count persistent-cache hits/misses via jax monitoring events.
+
+    Private-API dependent (jax._src.monitoring); counters degrade to 0
+    rather than break wiring if the surface moves."""
+    if _state["listener"]:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_event(event: str, **kw) -> None:
+            # int += under the GIL; counters are best-effort telemetry
+            if event.endswith("/cache_hits"):
+                _state["hits"] += 1
+            elif event.endswith("/cache_misses"):
+                _state["misses"] += 1
+
+        monitoring.register_event_listener(_on_event)
+        _state["listener"] = True
+    except Exception as e:  # noqa: BLE001 — counters are optional
+        _state["error"] = f"listener: {type(e).__name__}: {e}"
+
+
+def _wire(target: str) -> str | None:
+    with _lock:
+        if _state["enabled"]:
+            return _state["dir"]  # first wiring wins (process-global)
+        import jax
+
+        try:
+            os.makedirs(target, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", target)
+            # default thresholds skip sub-second compiles — exactly the
+            # population a dashboard's plan kernels live in
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception as e:  # noqa: BLE001 — cache is an optimization
+            _state["error"] = f"{type(e).__name__}: {e}"
+            return None
+        _install_listener()
+        _state["enabled"] = True
+        _state["dir"] = target
+        return target
+
+
+def enable(default_dir=None) -> str | None:
+    """Enable the persistent cache; env overrides the computed default.
+
+    Returns the active directory, or None when disabled (env set to an
+    off-value, or no directory resolvable).  Idempotent; later calls
+    with a different directory keep the first wiring."""
+    env = os.environ.get("BYDB_COMPILE_CACHE_DIR")
+    if env is not None and env.strip().lower() in _DISABLE_VALUES:
+        return None
+    target = env or (str(default_dir) if default_dir else None)
+    if not target:
+        return None
+    return _wire(target)
+
+
+def enable_at(path) -> str | None:
+    """Explicit-path form for CLI flags (flag already folded env/file
+    precedence via config.py); off-values disable."""
+    if str(path).strip().lower() in _DISABLE_VALUES:
+        return None
+    return _wire(str(path))
+
+
+def active_dir() -> str | None:
+    return _state["dir"]
+
+
+def stats() -> dict:
+    """Telemetry for /metrics and the bench artifact."""
+    entries = 0
+    d = _state["dir"]
+    if _state["enabled"] and d and os.path.isdir(d):
+        try:
+            entries = sum(1 for _ in os.scandir(d))
+        except OSError:
+            entries = 0
+    return {
+        "enabled": _state["enabled"],
+        "dir": d,
+        "hits": _state["hits"],
+        "misses": _state["misses"],
+        "entries": entries,
+        "error": _state["error"],
+    }

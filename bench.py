@@ -115,6 +115,10 @@ def child_main() -> None:
     import jax
     import jax.numpy as jnp
 
+    from banyandb_tpu.utils import compile_cache
+
+    compile_cache.enable()  # honors BYDB_COMPILE_CACHE_DIR if set
+
     from banyandb_tpu.query.measure_exec import (
         PlanSpec,
         _PredSpec,
@@ -236,7 +240,8 @@ def e2e_main() -> None:
     )
     from banyandb_tpu.cluster.rpc import GrpcTransport
     from banyandb_tpu.models.measure import MeasureEngine
-    from banyandb_tpu.server import TOPIC_QL, StandaloneServer
+    from banyandb_tpu.server import TOPIC_METRICS, TOPIC_QL, StandaloneServer
+    from banyandb_tpu.utils import compile_cache
 
     backend = jax.default_backend()
     n_rows = int(os.environ.get("BYDB_BENCH_E2E_ROWS", 10_000_000))
@@ -302,8 +307,20 @@ def e2e_main() -> None:
         del eng, reg  # server below re-opens the same root cold
 
         # ---- serve + query over the real gRPC socket --------------------
+        # persistent XLA compile cache, same default wiring as server
+        # main(); BYDB_COMPILE_CACHE_DIR (e.g. a dir that outlives this
+        # run) overrides and makes even the first plan compile a hit
+        compile_cache.enable(root / "compile-cache")
         srv = StandaloneServer(root, port=0)
         srv.start()
+        # server start kicked off the plan precompile warm thread; the
+        # cold numbers below are what a client sees once boot settles,
+        # so wait for warming (bounded) and report how long it took
+        from banyandb_tpu.query.precompile import default_registry
+
+        t_w = time.perf_counter()
+        warm_done = default_registry().wait_warm(timeout=180.0)
+        precompile_wait_ms = (time.perf_counter() - t_w) * 1000
         tr = GrpcTransport()
         end = T0 + n_rows * step + 1
         queries = {
@@ -324,12 +341,68 @@ def e2e_main() -> None:
             tr.call(srv.addr, TOPIC_QL, {"ql": ql}, timeout=600.0)
             return (time.perf_counter() - t0) * 1000
 
+        def cache_counters() -> dict:
+            """Cache planes read from the RUNNING server over the bus
+            (prometheus text), not process-local globals."""
+            txt = tr.call(srv.addr, TOPIC_METRICS, {}, timeout=60.0)[
+                "prometheus"
+            ]
+            out = {}
+            for line in txt.splitlines():
+                name, _, value = line.rpartition(" ")
+                if any(
+                    key in name
+                    for key in ("_cache_", "precompile_")
+                ):
+                    try:
+                        out[name.replace("banyandb_", "")] = float(value)
+                    except ValueError:
+                        pass
+            return out
+
+        def distinct_queries(count: int) -> list[str]:
+            """>= `count` DISTINCT queries (varied time ranges, group
+            predicates, N, quantiles) — the cache-honest warm phase: no
+            two hit the same partials-cache entry, so the p50 reflects
+            real per-query work, not replaying one cached answer."""
+            rq = np.random.default_rng(17)
+            span = n_rows * step
+            out = []
+            for i in range(count):
+                b = T0 + int(rq.integers(0, span // 3))
+                e = b + int(rq.integers(span // 4, span // 2))
+                kind = i % 3
+                if kind == 0:
+                    out.append(
+                        f"SELECT mean(value) FROM MEASURE m IN g TIME "
+                        f"BETWEEN {b} AND {e} WHERE region != 'r{i % 8}' "
+                        f"GROUP BY svc TOP {5 + 5 * (i % 4)} BY value"
+                    )
+                elif kind == 1:
+                    out.append(
+                        f"SELECT PERCENTILE(value, 0.5, 0.9{i % 10}) FROM "
+                        f"MEASURE m IN g TIME BETWEEN {b} AND {e} "
+                        f"GROUP BY region"
+                    )
+                else:
+                    out.append(
+                        f"SELECT sum(value) FROM MEASURE m IN g TIME "
+                        f"BETWEEN {b} AND {e} WHERE region = 'r{i % 8}' "
+                        f"GROUP BY svc TOP 10 BY value"
+                    )
+            return out
+
+        n_distinct = max(50, int(os.environ.get("BYDB_BENCH_DISTINCT", 60)))
         try:
+            counters_boot = cache_counters()
             cold = {k: run(q) for k, q in queries.items()}
             warm: dict[str, list] = {k: [] for k in queries}
             for _ in range(iters):
                 for k, q in queries.items():
                     warm[k].append(run(q))
+            counters_pooled = cache_counters()
+            distinct_ms = [run(q) for q in distinct_queries(n_distinct)]
+            counters_end = cache_counters()
         finally:
             tr.close()
             srv.stop()
@@ -344,7 +417,12 @@ def e2e_main() -> None:
                     "shards": shards,
                     "span_hours": round(n_rows * step / 3_600_000, 1),
                     "ingest_points_per_s": round(n_rows / ingest_s),
+                    "pipeline": os.environ.get("BYDB_PIPELINE", "1"),
+                    "precompile_wait_ms": round(precompile_wait_ms, 1),
+                    "precompile_done": warm_done,
                     "cold_ms": {k: round(v, 1) for k, v in cold.items()},
+                    "cold_topn_ms": round(cold["topn"], 1),
+                    "cold_percentile_ms": round(cold["percentile"], 1),
                     "warm_p50_ms": round(float(np.percentile(pooled, 50)), 1),
                     "warm_p99_ms": round(float(np.percentile(pooled, 99)), 1),
                     "warm_by_query_ms": {
@@ -355,6 +433,18 @@ def e2e_main() -> None:
                         for k, v in warm.items()
                     },
                     "iters": iters,
+                    "distinct_queries": len(distinct_ms),
+                    "warm_distinct_p50_ms": round(
+                        float(np.percentile(distinct_ms, 50)), 1
+                    ),
+                    "warm_distinct_p99_ms": round(
+                        float(np.percentile(distinct_ms, 99)), 1
+                    ),
+                    "cache_counters": {
+                        "at_boot": counters_boot,
+                        "after_pooled_warm": counters_pooled,
+                        "after_distinct": counters_end,
+                    },
                 }
             )
         )
@@ -494,7 +584,9 @@ def main() -> None:
         e2e_rec = _run_child(
             env, max(deadline - time.monotonic(), 120), mode="e2e"
         )
-        print(json.dumps(_compose(rec, e2e_rec) or _FAILED_REC))
+        final = _compose(rec, e2e_rec) or _FAILED_REC
+        print(json.dumps(final))
+        _persist_artifact(final)
         return
     else:
         # Phase 1: cheap claim probe on the ambient (TPU-tunnel) env.  A
@@ -562,7 +654,22 @@ def main() -> None:
                 if e2e_rec is not None:
                     e2e_rec["note"] = "cpu-fallback"
 
-    print(json.dumps(_compose(rec, e2e_rec) or _FAILED_REC))
+    final = _compose(rec, e2e_rec) or _FAILED_REC
+    print(json.dumps(final))
+    _persist_artifact(final)
+
+
+def _persist_artifact(rec: dict) -> None:
+    """On any successful e2e claim, persist the round artifact (backend
+    recorded inside) so the ROADMAP done-bars have a durable receipt."""
+    if not isinstance(rec.get("e2e"), dict) or rec["e2e"].get("e2e") != "ok":
+        return
+    try:
+        with open(os.path.join(_REPO_DIR, "BENCH_r06.json"), "w") as fh:
+            json.dump(rec, fh, indent=1)
+            fh.write("\n")
+    except OSError as e:
+        print(f"# artifact persist failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -20,6 +20,11 @@ fi
 echo "== bdlint =="
 python -m banyandb_tpu.lint --check banyandb_tpu || fail=1
 
+echo "== cold-path smoke =="
+# tiny store: pipelined == serial byte-identical, precompile registry
+# populated + persisted, compile cache active (docs/performance.md)
+env JAX_PLATFORMS=cpu python scripts/cold_smoke.py || fail=1
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== tier-1 tests (ROADMAP.md) =="
     rm -f /tmp/_t1.log

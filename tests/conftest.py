@@ -4,6 +4,10 @@ tests run without TPU hardware (matches the driver's dryrun harness)."""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# keep the kernel-cache population deterministic: no background plan
+# warming in the general suite (tests/test_cold_path.py re-enables it
+# explicitly to exercise the precompile registry)
+os.environ.setdefault("BYDB_PRECOMPILE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -491,5 +491,5 @@ def test_tree_is_bdlint_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
     # every suppression in the tree is a documented decision; pin the
     # exact count so adding (or dropping) one forces a reviewed edit here
-    assert stats["suppressed"] == 8
+    assert stats["suppressed"] == 9
     assert stats["files"] > 90
