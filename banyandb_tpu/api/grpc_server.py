@@ -1451,8 +1451,12 @@ class WireServer:
 
             self.auth = AuthReloader(auth_file, health_auth=health_auth)
             interceptors = (BasicAuthInterceptor(self.auth),)
+        # owned pool, joined in stop(): grpc never shuts down a
+        # caller-provided executor, and leaked idle workers fail the
+        # bdsan thread-parity check
+        self._pool = futures.ThreadPoolExecutor(max_workers=max_workers)
         self.server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers),
+            self._pool,
             interceptors=interceptors,
         )
         s = services
@@ -1716,11 +1720,17 @@ class WireServer:
         self.port = self.server.add_insecure_port(f"{host}:{port}")
 
     def start(self):
+        from banyandb_tpu.cluster.rpc import prespawn_pool
+
+        # workers exist from second one, not first-request time: no lazy
+        # thread-spawn latency, deterministic thread population (bdsan)
+        prespawn_pool(self._pool)
         self.server.start()
         return self
 
     def stop(self, grace: float = 0.5):
-        self.server.stop(grace)
+        self.server.stop(grace).wait()
+        self._pool.shutdown(wait=True)
 
 
 def serve_standalone(root, port: int = 17912):

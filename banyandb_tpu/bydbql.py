@@ -136,6 +136,9 @@ class _Parser:
 
     def next(self):
         t = self.toks[self.i]
+        # bdlint: disable=wp-shared-state -- a _Parser is constructed per
+        # parse() call and never escapes the call stack; every thread
+        # cursors its own instance (declaration-based identity merges them)
         self.i += 1
         return t
 

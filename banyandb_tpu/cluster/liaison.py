@@ -15,6 +15,7 @@
 from __future__ import annotations
 
 import dataclasses
+import threading
 import uuid
 from typing import Optional
 
@@ -87,6 +88,12 @@ class Liaison:
         if discovery is not None:
             nodes = discovery.nodes()
         self.selector = RoundRobinSelector(list(nodes), replicas)
+        # `alive` is read lock-free all over the query/write planes and
+        # written from the probe thread AND every RPC worker that sees a
+        # dead peer: it is therefore treated as an immutable snapshot —
+        # writers REBIND a fresh set under _alive_lock (never mutate in
+        # place), readers see either the old or the new reference
+        self._alive_lock = threading.Lock()
         self.alive: set[str] = {n.name for n in nodes}
         # newest schema content pushed per (kind, key) — the barrier's
         # trusted "node is ahead" witness (see sync_schema)
@@ -107,6 +114,12 @@ class Liaison:
         self.probe()
         return True
 
+    def _mark_dead(self, name: str) -> None:
+        """Drop a peer from the alive snapshot (rebind, never mutate:
+        concurrent lock-free readers hold the old reference)."""
+        with self._alive_lock:
+            self.alive = self.alive - {name}
+
     # -- health -------------------------------------------------------------
     def probe(self) -> set[str]:
         alive = set()
@@ -119,7 +132,8 @@ class Liaison:
                     alive.add(n.name)
             except TransportError:
                 pass
-        self.alive = alive
+        with self._alive_lock:
+            self.alive = alive
         # Hinted-handoff replay (handoff_controller.go:82): drain the spool
         # of EVERY alive node with pending entries — keyed on pending, not
         # on the down->up transition, so a partially failed replay retries
@@ -179,7 +193,7 @@ class Liaison:
                     "key": key,
                 }
             except TransportError:
-                self.alive.discard(n.name)
+                self._mark_dead(n.name)
                 if self.handoff is not None:
                     self.handoff.spool(n.name, Topic.SCHEMA_SYNC.value, env)
                 else:
@@ -270,7 +284,7 @@ class Liaison:
 
                     _fs.atomic_write_json(record, sorted(delivered))
                 except TransportError as e:
-                    self.alive.discard(node.name)
+                    self._mark_dead(node.name)
                     errors.append(f"{node.name}: {e}")
             if errors or not delivered:
                 raise TransportError(
@@ -369,7 +383,7 @@ class Liaison:
                     shed_names.add(name)
                     first_shed = first_shed or e
                 else:
-                    self.alive.discard(name)
+                    self._mark_dead(name)
         if not delivered_to and failed and set(failed) == shed_names:
             # every replica shed load: surface the retryable rejection
             # itself rather than a generic unreachable error

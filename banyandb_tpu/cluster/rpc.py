@@ -71,6 +71,33 @@ class LocalTransport:
             raise
 
 
+def prespawn_pool(pool) -> None:
+    """Start every worker thread of a ThreadPoolExecutor NOW.
+
+    Executor workers normally spawn lazily on first submit, which (a)
+    adds thread-creation latency to the first RPCs a fresh server
+    receives and (b) makes the thread population nondeterministic — the
+    bdsan per-test thread-parity check needs a server's threads to exist
+    when the server starts, not when the first request lands."""
+    import threading as _t
+
+    n = pool._max_workers
+    barrier = _t.Barrier(n + 1)
+
+    def hold():
+        try:
+            barrier.wait(timeout=10)
+        except _t.BrokenBarrierError:  # pragma: no cover - degraded start
+            pass
+
+    for _ in range(n):
+        pool.submit(hold)
+    try:
+        barrier.wait(timeout=10)
+    except _t.BrokenBarrierError:  # pragma: no cover - degraded start
+        pass
+
+
 class GrpcBusServer:
     """Serves a LocalBus over gRPC generic handlers (sub.NewServer analog).
 
@@ -182,8 +209,14 @@ class GrpcBusServer:
                 ),
             },
         )
+        # the server does NOT own a pool it is merely handed: keep the
+        # reference so stop() can join the workers (grpc never shuts a
+        # caller-provided executor down — idle worker threads would
+        # otherwise outlive every stopped server, a leak the bdsan
+        # thread-parity check catches)
+        self._pool = futures.ThreadPoolExecutor(max_workers=8)
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=8),
+            self._pool,
             options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
                      ("grpc.max_send_message_length", 64 * 1024 * 1024)],
         )
@@ -211,10 +244,12 @@ class GrpcBusServer:
         self.addr = f"{host}:{self.port}"
 
     def start(self) -> None:
+        prespawn_pool(self._pool)
         self._server.start()
 
     def stop(self, grace: float = 1.0) -> None:
-        self._server.stop(grace)
+        self._server.stop(grace).wait()
+        self._pool.shutdown(wait=True)
 
 
 class GrpcTransport:

@@ -17,6 +17,12 @@ Four analyzers, all surfaced through ``python -m banyandb_tpu.lint``
                       (transitively) blocks
 - ``lock-order``      potential deadlock cycles in the
                       acquires-while-holding lock graph
+- ``wp-shared-state`` cross-thread race analysis: attributes written
+                      from >= 2 discovered thread roots (Thread targets,
+                      bus subscribers, gRPC servicer methods, HTTP
+                      handlers, executor submissions) with no common
+                      lock guard (shared_state.py; the static half of
+                      bdsan — banyandb_tpu/sanitize is the runtime half)
 - ``plan-audit``      jax.eval_shape abstract trace of every registered
                       measure/stream kernel entry point against a matrix
                       of representative plan shapes: dtype promotion,
@@ -41,6 +47,7 @@ WP_RULES = (
     ("wp-sync-in-jit", "transitive host sync/block inside a jit region"),
     ("wp-lock-blocking", "callee transitively blocks while a lock is held"),
     ("lock-order", "potential deadlock cycle in the lock-order graph"),
+    ("wp-shared-state", "attribute written from >=2 thread roots unguarded"),
     ("plan-audit", "eval_shape plan matrix: dtype/shape/retrace hazards"),
 )
 
@@ -90,6 +97,13 @@ def run_whole_program(
         parse_package,
     )
     from banyandb_tpu.lint.whole_program.lockorder import analyze_lock_order
+    from banyandb_tpu.lint.whole_program.shared_state import (
+        BASELINE as SHARED_STATE_BASELINE,
+    )
+    from banyandb_tpu.lint.whole_program.shared_state import (
+        analyze_shared_state,
+        discover_roots,
+    )
 
     trees = parse_package(pkg_root, layer_config.PACKAGE)
     findings: list[Finding] = []
@@ -104,6 +118,15 @@ def run_whole_program(
     findings += analyze_sync_in_jit(program)
     findings += analyze_lock_blocking(program)
     findings += analyze_lock_order(program)
+    roots = discover_roots(program)
+    findings += analyze_shared_state(
+        program,
+        baseline=SHARED_STATE_BASELINE,
+        baseline_path=str(
+            pkg_root / "lint" / "whole_program" / "shared_state.py"
+        ),
+        roots=roots,
+    )
     if plan_audit:
         from banyandb_tpu.lint.whole_program.plan_audit import run_plan_audit
 
@@ -114,4 +137,5 @@ def run_whole_program(
         "wp_findings": len(findings),
         "wp_suppressed": suppressed,
         "wp_functions": len(program.functions),
+        "wp_roots": len(roots),
     }

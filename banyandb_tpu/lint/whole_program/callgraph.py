@@ -72,6 +72,24 @@ class CallSite:
     col: int
 
 
+@dataclass(frozen=True)
+class Registration:
+    """A function handed to a concurrency primitive: the thread-root
+    discovery surface for the shared-state analyzer.
+
+    kinds: ``thread`` (threading.Thread target), ``timer``
+    (threading.Timer function), ``executor`` (pool.submit fn),
+    ``subscriber`` (bus.subscribe handler), ``http`` (a
+    ThreadingHTTPServer handler-class ``do_*`` method — each request runs
+    it on its own thread)."""
+
+    kind: str
+    target: str  # resolved qualname of the function that will run
+    name: str  # thread name= constant when present, else ""
+    line: int
+    col: int
+
+
 @dataclass
 class LockRegion:
     lock_id: str
@@ -89,6 +107,7 @@ class FuncInfo:
     cls: Optional[str]
     calls: list[CallSite] = field(default_factory=list)
     lock_regions: list[LockRegion] = field(default_factory=list)
+    registrations: list[Registration] = field(default_factory=list)
     direct_sync: Optional[str] = None
     direct_block: Optional[str] = None
     traced: bool = False
@@ -142,9 +161,24 @@ class Program:
         # lock declarations assigned threading.RLock() — reentrant, so a
         # self re-acquisition is not a self-deadlock
         self.reentrant_locks: set[str] = set()
+        # every Lock()/RLock() declaration: (abs file path, lineno of the
+        # constructor call) -> declaration-based lock id.  The runtime
+        # sanitizer (banyandb_tpu/sanitize/lockwatch.py) maps locks it
+        # instruments back to static identities through this table.
+        self.lock_sites: dict[tuple[str, int], str] = {}
         # module -> {class name -> {method name -> qual}}
         self._classes: dict[str, dict[str, dict[str, str]]] = {}
         self._bases: dict[tuple[str, str], list[str]] = {}
+        # merged per-module import tables (kept for late resolution needs)
+        self.tables: dict[str, dict[str, str]] = {}
+        # one-hop attribute types: (mod, cls, attr) -> (mod2, cls2) for
+        # `self.attr = SomePackageClass(...)` — lets `self.liaison.probe()`
+        # resolve to the Liaison method
+        self.attr_types: dict[tuple[str, str, str], tuple[str, str]] = {}
+        # ctor dotted name per attribute declaration: (mod, cls, attr) ->
+        # "threading.Event" etc.; the shared-state analyzer classifies
+        # thread-safe primitives from this
+        self.attr_ctor: dict[tuple[str, str, str], str] = {}
 
     # -- construction ------------------------------------------------------
 
@@ -164,6 +198,9 @@ class Program:
             mod: self._import_table(mod, tree, path.name == "__init__.py")
             for mod, (path, tree) in trees.items()
         }
+        self.tables = tables
+        for mod, (_path, tree) in trees.items():
+            self._collect_attr_types(mod, tree, tables[mod])
         for mod, (_path, tree) in trees.items():
             self._resolve_module(mod, tree, tables[mod])
         self._mark_traced(trees, tables)
@@ -192,27 +229,37 @@ class Program:
         visit(tree, "", None)
         self._classes[mod] = classes
 
-        # reentrant-lock declarations: self.X = threading.RLock() inside
-        # class C -> "mod.C.X"; NAME = threading.RLock() -> "mod.NAME"
-        def scan_rlocks(node: ast.AST, cls_name: Optional[str]) -> None:
+        # lock declarations: self.X = threading.Lock()/RLock() inside
+        # class C -> "mod.C.X"; NAME = threading.Lock() -> "mod.NAME".
+        # RLock declarations are additionally reentrant (not a
+        # self-deadlock); every declaration records its constructor-call
+        # source site for the runtime sanitizer's identity mapping.
+        def scan_locks(node: ast.AST, cls_name: Optional[str]) -> None:
             for child in ast.iter_child_nodes(node):
                 if isinstance(child, ast.ClassDef):
-                    scan_rlocks(child, child.name)
+                    scan_locks(child, child.name)
                     continue
                 if isinstance(child, ast.Assign) and isinstance(
                     child.value, ast.Call
                 ):
-                    if dotted_name(child.value.func) in (
+                    ctor = dotted_name(child.value.func)
+                    if ctor in (
+                        "threading.Lock",
+                        "Lock",
                         "threading.RLock",
                         "RLock",
                     ):
                         for t in child.targets:
                             lid = lock_identity(t, mod, cls_name)
                             if lid:
-                                self.reentrant_locks.add(lid)
-                scan_rlocks(child, cls_name)
+                                if ctor.endswith("RLock"):
+                                    self.reentrant_locks.add(lid)
+                                self.lock_sites[
+                                    (path, child.value.lineno)
+                                ] = lid
+                scan_locks(child, cls_name)
 
-        scan_rlocks(tree, None)
+        scan_locks(tree, None)
 
     def _import_table(
         self, mod: str, tree: ast.Module, is_pkg: bool
@@ -233,6 +280,93 @@ class Program:
                 for a in node.names:
                     table[a.asname or a.name] = f"{base}.{a.name}"
         return table
+
+    def _find_class(
+        self, mod: str, imports: dict[str, str], dotted: str
+    ) -> Optional[tuple[str, str]]:
+        """Resolve a dotted constructor name to an in-package class ref
+        (module, class) — local class, imported symbol, or imported
+        module attribute."""
+        if not dotted:
+            return None
+        head, _, rest = dotted.partition(".")
+        if not rest and head in self._classes.get(mod, {}):
+            return (mod, head)
+        if head in imports:
+            dotted = imports[head] + (("." + rest) if rest else "")
+        elif rest:
+            return None
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            m = ".".join(parts[:cut])
+            if m in self.modules:
+                r = ".".join(parts[cut:])
+                if r and "." not in r and r in self._classes.get(m, {}):
+                    return (m, r)
+                return None
+        return None
+
+    def _collect_attr_types(
+        self, mod: str, tree: ast.Module, imports: dict[str, str]
+    ) -> None:
+        """One-hop attribute typing: `self.attr = Ctor(...)` anywhere in a
+        class records the ctor (for primitive classification) and, when
+        Ctor is an in-package class, the attribute's type — enabling
+        `self.attr.method()` call resolution."""
+
+        def scan(node: ast.AST, cls_name: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    scan(child, child.name)
+                    continue
+                if (
+                    cls_name
+                    and isinstance(child, (ast.Assign, ast.AnnAssign))
+                    and isinstance(child.value, ast.Call)
+                ):
+                    ctor = dotted_name(child.value.func)
+                    targets = (
+                        child.targets
+                        if isinstance(child, ast.Assign)
+                        else [child.target]
+                    )
+                    if ctor:
+                        for t in targets:
+                            ids = _attr_chain_ids(t)
+                            if len(ids) == 2 and ids[0] in ("self", "cls"):
+                                key = (mod, cls_name, ids[1])
+                                self.attr_ctor.setdefault(key, ctor)
+                                ref = self._find_class(mod, imports, ctor)
+                                if ref is not None:
+                                    self.attr_types.setdefault(key, ref)
+                scan(child, cls_name)
+
+        scan(tree, None)
+
+    # -- public class-table accessors (shared_state root discovery) --------
+
+    def iter_classes(self):
+        """-> (module, class name, {method name -> qual}) triples."""
+        for mod, classes in self._classes.items():
+            for cls_name, methods in classes.items():
+                yield mod, cls_name, methods
+
+    def class_bases(self, mod: str, cls_name: str) -> list[str]:
+        """Dotted base names through the in-package inheritance chain
+        (the class's own bases plus in-package ancestors' bases)."""
+        out: list[str] = []
+        seen = set()
+        queue = [(mod, cls_name)]
+        while queue:
+            m, c = queue.pop(0)
+            if (m, c) in seen:
+                continue
+            seen.add((m, c))
+            for b in self._bases.get((m, c), []):
+                out.append(b)
+                if b in self._classes.get(m, {}):
+                    queue.append((m, b))
+        return out
 
     def _find_function(self, dotted: str) -> Optional[str]:
         """Fully-dotted path -> qualname, trying module prefixes longest
@@ -273,22 +407,73 @@ class Program:
                     queue.append((m, b))
         return None
 
-    def _resolve_call(
+    def _attr_type_on(
+        self, mod: str, cls_name: str, attr: str
+    ) -> Optional[tuple[str, str]]:
+        """Attribute type lookup through the in-package MRO (declared in
+        the class or any in-package ancestor)."""
+        seen = set()
+        queue = [(mod, cls_name)]
+        while queue:
+            m, c = queue.pop(0)
+            if (m, c) in seen:
+                continue
+            seen.add((m, c))
+            ref = self.attr_types.get((m, c, attr))
+            if ref is not None:
+                return ref
+            for b in self._bases.get((m, c), []):
+                if b in self._classes.get(m, {}):
+                    queue.append((m, b))
+        return None
+
+    def attr_ctor_on(
+        self, mod: str, cls_name: str, attr: str
+    ) -> Optional[str]:
+        """Constructor dotted name an attribute is assigned from, looked
+        up through the in-package MRO ("threading.Event", ...)."""
+        seen = set()
+        queue = [(mod, cls_name)]
+        while queue:
+            m, c = queue.pop(0)
+            if (m, c) in seen:
+                continue
+            seen.add((m, c))
+            ctor = self.attr_ctor.get((m, c, attr))
+            if ctor is not None:
+                return ctor
+            for b in self._bases.get((m, c), []):
+                if b in self._classes.get(m, {}):
+                    queue.append((m, b))
+        return None
+
+    def _resolve_ref(
         self,
         mod: str,
         imports: dict[str, str],
         enclosing: list[str],
         cls_name: Optional[str],
-        node: ast.Call,
+        local_types: dict[str, tuple[str, str]],
+        d: str,
     ) -> Optional[str]:
-        d = dotted_name(node.func)
+        """Resolve a dotted function reference (call target or a function
+        handed to Thread/submit/subscribe) to a qualname, or None."""
         if not d:
             return None
         head, _, rest = d.partition(".")
         if head in ("self", "cls") and cls_name:
             if rest and "." not in rest:
                 return self._method_on(mod, cls_name, rest)
+            if rest and rest.count(".") == 1:
+                # one typed hop: self.liaison.probe -> Liaison.probe
+                attr, _, meth = rest.partition(".")
+                ref = self._attr_type_on(mod, cls_name, attr)
+                if ref is not None:
+                    return self._method_on(ref[0], ref[1], meth)
             return None
+        if head in local_types and rest and "." not in rest:
+            # typed local: srv = StandaloneServer(...); srv.start()
+            return self._method_on(*local_types[head], rest)
         if head in imports:
             return self._find_function(
                 imports[head] + (("." + rest) if rest else "")
@@ -306,15 +491,183 @@ class Program:
                 return self._classes[mod].get(head, {}).get("__init__")
         return None
 
+    def _resolve_call(
+        self,
+        mod: str,
+        imports: dict[str, str],
+        enclosing: list[str],
+        cls_name: Optional[str],
+        node: ast.Call,
+        local_types: Optional[dict[str, tuple[str, str]]] = None,
+    ) -> Optional[str]:
+        return self._resolve_ref(
+            mod,
+            imports,
+            enclosing,
+            cls_name,
+            local_types or {},
+            dotted_name(node.func),
+        )
+
+    def _registrations_of(
+        self,
+        mod: str,
+        imports: dict[str, str],
+        enclosing: list[str],
+        cls_name: Optional[str],
+        local_types: dict[str, tuple[str, str]],
+        node: ast.Call,
+        fn_node: Optional[ast.AST] = None,
+    ) -> list[Registration]:
+        """Concurrency registrations made by this call, resolved to the
+        function(s) that will run on another thread."""
+
+        def resolve(expr: ast.AST) -> Optional[str]:
+            return self._resolve_ref(
+                mod, imports, enclosing, cls_name, local_types,
+                dotted_name(expr),
+            )
+
+        def reg(kind: str, expr: ast.AST, name: str = "") -> list[Registration]:
+            target = resolve(expr)
+            if target is None and isinstance(expr, ast.Name) and fn_node:
+                # the loops.py idiom: `for target, name in ((self._a,
+                # "a"), (self._b, "b")): Thread(target=target)` — chase
+                # the for-loop's literal iterable for every resolvable
+                # function reference bound to this name
+                return [
+                    Registration(
+                        kind=kind, target=t, name=n,
+                        line=node.lineno, col=node.col_offset,
+                    )
+                    for t, n in self._loop_bound_targets(
+                        fn_node, expr.id, resolve
+                    )
+                ]
+            if target is None:
+                return []
+            return [
+                Registration(
+                    kind=kind,
+                    target=target,
+                    name=name,
+                    line=node.lineno,
+                    col=node.col_offset,
+                )
+            ]
+
+        d = dotted_name(node.func)
+        if d:
+            # normalize the head through the import table, so
+            # `import threading as _threading` still matches
+            head, _, rest = d.partition(".")
+            if head in imports:
+                d = imports[head] + (("." + rest) if rest else "")
+        kw = {k.arg: k.value for k in node.keywords if k.arg}
+        if d in ("threading.Thread", "Thread") and "target" in kw:
+            name = ""
+            if isinstance(kw.get("name"), ast.Constant):
+                name = str(kw["name"].value)
+            return reg("thread", kw["target"], name)
+        if d in ("threading.Timer", "Timer"):
+            fn = kw.get("function") or (
+                node.args[1] if len(node.args) > 1 else None
+            )
+            return reg("timer", fn) if fn is not None else []
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "submit" and node.args:
+                return reg("executor", node.args[0])
+            if node.func.attr == "subscribe" and len(node.args) >= 2:
+                return reg("subscriber", node.args[1])
+        if d.endswith("ThreadingHTTPServer") and len(node.args) >= 2:
+            # each request runs the handler class's do_* method on its
+            # own thread: every one is a root
+            ref = None
+            hd = dotted_name(node.args[1])
+            if hd and "." not in hd:
+                if hd in self._classes.get(mod, {}):
+                    ref = (mod, hd)
+                else:
+                    ref = self._find_class(mod, imports, hd)
+            if ref is None:
+                return []
+            out = []
+            for meth, qual in sorted(self._classes[ref[0]][ref[1]].items()):
+                if meth.startswith("do_"):
+                    out.append(
+                        Registration(
+                            kind="http",
+                            target=qual,
+                            name="",
+                            line=node.lineno,
+                            col=node.col_offset,
+                        )
+                    )
+            return out
+        return []
+
+    @staticmethod
+    def _loop_bound_targets(fn_node: ast.AST, var: str, resolve):
+        """(target qual, thread name) pairs for a name bound by a for
+        loop over a LITERAL tuple/list of (callable, name, ...) tuples —
+        the table-driven thread-spawn idiom."""
+        out = []
+        for forn in ast.walk(fn_node):
+            if not isinstance(forn, ast.For):
+                continue
+            tgt = forn.target
+            idx = None
+            if isinstance(tgt, ast.Name) and tgt.id == var:
+                idx = -1  # bare `for target in (...)`
+            elif isinstance(tgt, ast.Tuple):
+                for i, el in enumerate(tgt.elts):
+                    if isinstance(el, ast.Name) and el.id == var:
+                        idx = i
+            if idx is None or not isinstance(forn.iter, (ast.Tuple, ast.List)):
+                continue
+            for row in forn.iter.elts:
+                expr = row
+                name = ""
+                if isinstance(row, (ast.Tuple, ast.List)) and idx >= 0:
+                    if idx >= len(row.elts):
+                        continue
+                    expr = row.elts[idx]
+                    consts = [
+                        e.value
+                        for e in row.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+                    name = consts[0] if consts else ""
+                q = resolve(expr)
+                if q is not None:
+                    out.append((q, name))
+        return out
+
     def _resolve_module(
         self, mod: str, tree: ast.Module, imports: dict[str, str]
     ) -> None:
         def visit_fn(fn_node: ast.AST, qual: str, enclosing: list[str]) -> None:
             info = self.functions[qual]
+            # typed locals first: `srv = StandaloneServer(...)` lets the
+            # later `srv.start()` resolve (single-assignment idiom only —
+            # a rebound name keeps its first type, conservatively)
+            local_types: dict[str, tuple[str, str]] = {}
+            for node in _walk_own(fn_node):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    ref = self._find_class(
+                        mod, imports, dotted_name(node.value.func)
+                    )
+                    if ref is not None:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local_types.setdefault(t.id, ref)
             for node in _walk_own(fn_node):
                 if isinstance(node, ast.Call):
                     callee = self._resolve_call(
-                        mod, imports, enclosing, info.cls, node
+                        mod, imports, enclosing, info.cls, node, local_types
                     )
                     site = CallSite(
                         node=node,
@@ -323,6 +676,17 @@ class Program:
                         col=node.col_offset,
                     )
                     info.calls.append(site)
+                    info.registrations.extend(
+                        self._registrations_of(
+                            mod,
+                            imports,
+                            enclosing,
+                            info.cls,
+                            local_types,
+                            node,
+                            fn_node,
+                        )
+                    )
                     d = dotted_name(node.func)
                     if d in _SYNC_APIS or (
                         isinstance(node.func, ast.Attribute)
@@ -348,7 +712,12 @@ class Program:
                                 CallSite(
                                     node=inner,
                                     callee=self._resolve_call(
-                                        mod, imports, enclosing, info.cls, inner
+                                        mod,
+                                        imports,
+                                        enclosing,
+                                        info.cls,
+                                        inner,
+                                        local_types,
                                     ),
                                     line=inner.lineno,
                                     col=inner.col_offset,

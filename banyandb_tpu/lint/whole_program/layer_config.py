@@ -96,6 +96,9 @@ CONFIG = LayerConfig(
         "run": L6,
         "cli": L6,
         "lint": L6,
+        # bdsan runtime sanitizers: tooling like lint/ (its static lock
+        # model loads lint.whole_program lazily — no import-time edge)
+        "sanitize": L6,
     },
 )
 

@@ -165,6 +165,15 @@ class MeasureEngine:
             self._loops.stop()
             self._loops = None
 
+    def close(self) -> None:
+        """Deterministic shutdown: stop the loops and release every
+        TSDB's index memory and file handles (bdsan fd hygiene)."""
+        self.stop_lifecycle()
+        with self._tsdb_lock:
+            dbs = list(self._tsdbs.values())
+        for db in dbs:
+            db.close()
+
     # -- plumbing ----------------------------------------------------------
     def _tsdb(self, group: str) -> TSDB:
         # Locked get-or-create: two racing creators would own duplicate

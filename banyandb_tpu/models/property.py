@@ -41,6 +41,14 @@ class PropertyEngine:
         self._shards: dict[tuple[str, int], InvertedIndex] = {}
         self._revision = int(time.time() * 1000)
 
+    def close(self) -> None:
+        """Persist + release every shard index's memory and mmaps (bdsan
+        fd hygiene; indexes lazily reopen on next use)."""
+        with self._lock:
+            shards = list(self._shards.values())
+        for idx in shards:
+            idx.reclaim()
+
     def _shard_idx(self, group: str, shard: int) -> InvertedIndex:
         with self._lock:
             key = (group, shard)

@@ -66,6 +66,14 @@ class StreamEngine:
         self._tsdbs: dict[str, TSDB] = {}
         self._tsdb_lock = threading.Lock()
 
+    def close(self) -> None:
+        """Release every TSDB's index memory/file handles (bdsan fd
+        hygiene; reopen stays lazy)."""
+        with self._tsdb_lock:
+            dbs = list(self._tsdbs.values())
+        for db in dbs:
+            db.close()
+
     def create_stream(self, s: Stream) -> None:
         self.registry.create_stream(s)
 
@@ -269,6 +277,9 @@ class StreamEngine:
         for src in prefetched(read_ops, name="bydb-stream-prefetch"):
             rows.extend(self._filter_source(s, src, req, conds))
         stats["blocks_skipped"] = stats["blocks_selected"] - stats["blocks_read"]
+        # bdlint: disable=wp-shared-state -- diagnostic last-query
+        # snapshot: an atomic rebind of a fresh dict, last-writer-wins by
+        # design (readers only ever dereference one complete snapshot)
         self.last_scan_stats = stats
         return rows
 

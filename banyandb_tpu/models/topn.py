@@ -14,6 +14,7 @@ measure — rides the standard device executor.
 
 from __future__ import annotations
 
+import threading
 from collections import defaultdict
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Optional
@@ -190,6 +191,18 @@ class TopNProcessorManager:
         self._emit_seq = 0
         # parsed rule-criteria cache: (group, rule) -> (criteria_dict, tree)
         self._crit_cache: dict[tuple, tuple] = {}
+        # One manager serves every write thread of the engine (gRPC pool
+        # workers, the bus executor, bulk columnar ingest): window sums
+        # are read-modify-write and _flush_closed iterates _windows, so
+        # ALL accumulation state is guarded by one reentrant lock
+        # (reentrant: flush_all_windows and observe share _emit).
+        self._obs_lock = threading.RLock()
+        # ranked emissions queue here UNDER the lock and are written to
+        # the result measure AFTER it is released (_drain_emits): holding
+        # _obs_lock across engine.write would nest it over the whole
+        # storage/registry lock family for no benefit — result-measure
+        # (series, window) version dedup makes drain order irrelevant
+        self._pending_emits: list[tuple[str, tuple]] = []
 
     def _cached_criteria(self, key: tuple, rule: TopNAggregation):
         hit = self._crit_cache.get(key)
@@ -201,6 +214,11 @@ class TopNProcessorManager:
 
     def observe(self, m: Measure, p: DataPointValue) -> None:
         """Feed one written point through all TopN rules of its measure."""
+        with self._obs_lock:
+            self._observe_locked(m, p)
+        self._drain_emits()
+
+    def _observe_locked(self, m: Measure, p: DataPointValue) -> None:
         for rule in self.engine.registry.list_topn(m.group):
             if rule.source_measure != m.name:
                 continue
@@ -258,6 +276,11 @@ class TopNProcessorManager:
         Measures with no rules pay one registry scan and return; rule
         accumulation matches observe() row-for-row (same window routing,
         late-drop, counters bound, watermark and flush behavior)."""
+        with self._obs_lock:
+            self._observe_columns_locked(m, ts_millis, tags, fields)
+        self._drain_emits()
+
+    def _observe_columns_locked(self, m: Measure, ts_millis, tags, fields) -> None:
         import numpy as np
 
         rules = [
@@ -356,22 +379,26 @@ class TopNProcessorManager:
 
     def flush_all_windows(self) -> None:
         """Emit every dirty window (shutdown / test hook); state kept."""
-        for (group, rname), wins in list(self._windows.items()):
-            rule = next(
-                (r for r in self.engine.registry.list_topn(group) if r.name == rname),
-                None,
-            )
-            if rule is None:
-                continue
-            for win in wins.values():
-                if win.dirty:
-                    win.dirty = False
-                    self._emit(group, rule, win)
+        with self._obs_lock:
+            for (group, rname), wins in list(self._windows.items()):
+                rule = next(
+                    (r for r in self.engine.registry.list_topn(group) if r.name == rname),
+                    None,
+                )
+                if rule is None:
+                    continue
+                for win in wins.values():
+                    if win.dirty:
+                        win.dirty = False
+                        self._emit(group, rule, win)
+        self._drain_emits()
 
     def _emit(self, group: str, rule: TopNAggregation, win: _Window) -> None:
+        """Rank + QUEUE one window's counters (called with _obs_lock
+        held); the actual result-measure write happens lock-free in
+        _drain_emits."""
         if not win.sums:
             return
-        self.engine.ensure_result_measure(group)
         directions = (
             ("desc", "asc")
             if rule.field_value_sort == "all"
@@ -400,10 +427,22 @@ class TopNProcessorManager:
                         version=self._emit_seq,
                     )
                 )
-        self.engine.write(
-            WriteRequest(group, RESULT_MEASURE, tuple(points)),
-            _internal=True,
-        )
+        self._pending_emits.append((group, tuple(points)))
+
+    def _drain_emits(self) -> None:
+        """Write queued emissions with NO manager lock held.  Concurrent
+        drainers may interleave batches; the result measure's (series,
+        window-start) max-version dedup makes arrival order irrelevant."""
+        while True:
+            with self._obs_lock:
+                if not self._pending_emits:
+                    return
+                group, points = self._pending_emits.pop(0)
+            self.engine.ensure_result_measure(group)
+            self.engine.write(
+                WriteRequest(group, RESULT_MEASURE, points),
+                _internal=True,
+            )
 
 
 def query_topn(

@@ -82,6 +82,14 @@ class TraceEngine:
 
         self.pipeline = TracePipelineRegistry()
 
+    def close(self) -> None:
+        """Release every TSDB's index memory/file handles (bdsan fd
+        hygiene; reopen stays lazy)."""
+        with self._tsdb_lock:
+            dbs = list(self._tsdbs.values())
+        for db in dbs:
+            db.close()
+
     def create_trace(self, t: Trace) -> None:
         self.registry.create_trace(t)
 

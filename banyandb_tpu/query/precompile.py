@@ -40,11 +40,11 @@ from typing import Optional
 
 MAX_STORED = 64
 
-_ON = ("1", "on", "yes", "true")
-
 
 def enabled() -> bool:
-    return os.environ.get("BYDB_PRECOMPILE", "1").strip().lower() in _ON
+    from banyandb_tpu.utils.envflag import env_flag
+
+    return env_flag("BYDB_PRECOMPILE", default=True)
 
 
 # -- the builtin dashboard matrix (single source for warm + plan audit) ------

@@ -557,6 +557,11 @@ class StandaloneServer:
         if self.pprof is not None:
             self.pprof.stop()
         self.access_log.close()
+        # release index mmaps/fds deterministically (bdsan fd hygiene)
+        self.measure.close()
+        self.stream.close()
+        self.trace.close()
+        self.property.close()
 
     @property
     def addr(self) -> str:

@@ -30,12 +30,12 @@ import queue
 import threading
 from typing import Callable, Iterator, Sequence
 
-_ON = ("1", "on", "yes", "true")
+from banyandb_tpu.utils.envflag import env_flag
 
 
 def pipeline_enabled() -> bool:
     """Strict-serial fallback flag; default on."""
-    return os.environ.get("BYDB_PIPELINE", "1").strip().lower() in _ON
+    return env_flag("BYDB_PIPELINE", default=True)
 
 
 def default_depth() -> int:

@@ -511,6 +511,15 @@ class TSDB:
                 closed += 1
         return closed
 
+    def close(self) -> None:
+        """Deterministic shutdown: persist + release every segment's
+        index memory and mmap'd files (the explicit analog of
+        close_idle_segments — without it, sidx segment handles outlive
+        the database and fail the bdsan fd-leak gate).  Reopen stays
+        lazy, so a closed TSDB that is touched again just reloads."""
+        for seg in self.segments:
+            seg.reset_index()
+
     def select_segments(self, begin: int, end: int) -> list[Segment]:
         """Segments overlapping [begin, end) (storage.go:118 analog)."""
         with self._lock:

@@ -29,6 +29,10 @@ class Bloom:
 
     def add(self, value: bytes) -> None:
         for pos in self._hashes(value, self.k, self.m):
+            # bdlint: disable=wp-shared-state -- a Bloom under
+            # construction is function-local to one part build
+            # (write_trace_bloom / flush); it crosses threads only after
+            # serialization, as immutable bytes on disk
             self.bits[pos >> 6] |= np.uint64(1 << (pos & 63))
 
     def __contains__(self, value: bytes) -> bool:

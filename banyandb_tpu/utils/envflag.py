@@ -1,0 +1,22 @@
+"""One definition of boolean env-flag parsing.
+
+Every BYDB_* on/off switch accepts the same spellings; keeping the
+accepted set in one place stops the copies from drifting (the fourth
+hand-rolled ``_ON`` tuple is where "y" silently works in one module and
+not the next).
+"""
+
+from __future__ import annotations
+
+import os
+
+_ON = ("1", "on", "yes", "true")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean env flag: unset -> ``default``; set -> value must spell
+    truth (``1/on/yes/true``, case/space-insensitive) to be True."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in _ON

@@ -25,10 +25,16 @@ echo "== cold-path smoke =="
 # populated + persisted, compile cache active (docs/performance.md)
 env JAX_PLATFORMS=cpu python scripts/cold_smoke.py || fail=1
 
+echo "== sanitize smoke (bdsan) =="
+# live-engine stress slice under BYDB_SANITIZE=1: lock-order witnesses
+# consistent with the declared graph, zero leaked threads/fds, seeded
+# leak caught (docs/sanitizers.md)
+env JAX_PLATFORMS=cpu BYDB_SANITIZE=1 python scripts/sanitize_smoke.py || fail=1
+
 if [ "${1:-}" != "--fast" ]; then
-    echo "== tier-1 tests (ROADMAP.md) =="
+    echo "== tier-1 tests (ROADMAP.md, BYDB_SANITIZE=1 via conftest) =="
     rm -f /tmp/_t1.log
-    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    timeout -k 10 870 env JAX_PLATFORMS=cpu BYDB_SANITIZE=1 python -m pytest tests/ -q \
         -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
         | tee /tmp/_t1.log

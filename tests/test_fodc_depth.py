@@ -220,9 +220,15 @@ def fodc_stack(tmp_path):
     from banyandb_tpu.admin.fodc_api import FodcApiServer
 
     state = fodc_wire.FodcProxyState()
-    server = grpc.server(_f.ThreadPoolExecutor(max_workers=8))
+    # own the pool: grpc never shuts down a caller-provided executor,
+    # and its lazily spawned workers would trip the bdsan parity check
+    pool = _f.ThreadPoolExecutor(max_workers=8)
+    server = grpc.server(pool)
     server.add_generic_rpc_handlers((fodc_wire.generic_handler(state),))
     port = server.add_insecure_port("127.0.0.1:0")
+    from banyandb_tpu.cluster.rpc import prespawn_pool
+
+    prespawn_pool(pool)
     server.start()
 
     pp = PressureProfiler(
@@ -253,7 +259,8 @@ def fodc_stack(tmp_path):
     finally:
         api.stop()
         agent.stop()
-        server.stop(grace=0.2)
+        server.stop(grace=0.2).wait()
+        pool.shutdown(wait=True)
 
 
 def _get(url: str):

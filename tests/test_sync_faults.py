@@ -25,7 +25,11 @@ def sync_stack(tmp_path):
         with lock:
             installs.append((meta.group, [dict(f) for _, f in parts]))
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    # own the pool: grpc never shuts down a caller-provided executor, and
+    # a worker left behind (its exit otherwise rides GC timing) trips the
+    # bdsan thread-parity check
+    pool = futures.ThreadPoolExecutor(max_workers=4)
+    server = grpc.server(pool)
     server.add_generic_rpc_handlers((chunked_sync.generic_handler(install_cb),))
     port = server.add_insecure_port("127.0.0.1:0")
     server.start()
@@ -39,7 +43,8 @@ def sync_stack(tmp_path):
     yield chan, part, installs
     chunked_sync.clear_failure_injector()
     chan.close()
-    server.stop(grace=0.2)
+    server.stop(grace=0.2).wait()
+    pool.shutdown(wait=True)
 
 
 def _ship(chan, part):
@@ -134,7 +139,8 @@ def test_install_failure_reported_in_band(tmp_path):
     def install_cb(meta, parts):
         raise IOError("disk full on data node")
 
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    pool = futures.ThreadPoolExecutor(max_workers=2)
+    server = grpc.server(pool)
     server.add_generic_rpc_handlers((chunked_sync.generic_handler(install_cb),))
     port = server.add_insecure_port("127.0.0.1:0")
     server.start()
@@ -147,7 +153,8 @@ def test_install_failure_reported_in_band(tmp_path):
             chunked_sync.sync_part_dirs(chan, [part], group="g", shard_id=0)
     finally:
         chan.close()
-        server.stop(grace=0.2)
+        server.stop(grace=0.2).wait()
+        pool.shutdown(wait=True)
 
 
 # -- pub-side eviction / shed semantics under repeated failure ---------------

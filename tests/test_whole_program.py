@@ -27,6 +27,11 @@ from banyandb_tpu.lint.whole_program.layers import (
 )
 from banyandb_tpu.lint.whole_program.lockorder import analyze_lock_order
 from banyandb_tpu.lint.whole_program.plan_audit import KernelAudit, audit_kernel
+from banyandb_tpu.lint.whole_program.shared_state import (
+    analyze_shared_state,
+    collect_accesses,
+    discover_roots,
+)
 
 
 def _pkg(tmp_path: Path, files: dict[str, str], name: str = "mypkg") -> Path:
@@ -453,6 +458,223 @@ def test_real_tree_callgraph_analyses_clean():
     )
     fs, _suppressed = apply_suppressions(fs)
     assert fs == [], "\n".join(f.render() for f in fs)
+
+
+# -- shared-state race analysis ----------------------------------------------
+
+
+_RACY_PKG = {
+    "svc.py": (
+        "import threading\n"
+        "class Svc:\n"
+        "    def __init__(self):\n"
+        "        self.count = 0\n"
+        "        self._lock = threading.Lock()\n"
+        "    def on_write(self, env):\n"  # bus subscriber root
+        "        self.count += 1\n"
+        "        return {}\n"
+        "    def _loop(self):\n"
+        "        self.count = 0\n"
+        "    def start(self, bus):\n"
+        "        bus.subscribe('write', self.on_write)\n"
+        "        threading.Thread(target=self._loop, name='svc-loop').start()\n"
+    ),
+}
+
+
+def test_shared_state_unguarded_two_root_write_flagged(tmp_path):
+    program = Program.build(_pkg(tmp_path, _RACY_PKG), "mypkg")
+    roots = {r.qual for r in discover_roots(program)}
+    assert "mypkg.svc:Svc.on_write" in roots  # subscriber
+    assert "mypkg.svc:Svc._loop" in roots  # thread target
+    fs = analyze_shared_state(program)
+    assert len(fs) == 1 and fs[0].rule == "wp-shared-state"
+    assert "mypkg.svc.Svc.count" in fs[0].message
+    # witness chains name both roots
+    assert "svc-loop" in fs[0].message and "subscriber" in fs[0].message
+
+
+def test_shared_state_common_guard_is_clean(tmp_path):
+    files = {
+        "svc.py": _RACY_PKG["svc.py"]
+        .replace(
+            "        self.count += 1\n",
+            "        with self._lock:\n            self.count += 1\n",
+        )
+        .replace(
+            "        self.count = 0\n    def start",
+            "        with self._lock:\n            self.count = 0\n    def start",
+        )
+    }
+    program = Program.build(_pkg(tmp_path, files), "mypkg")
+    assert analyze_shared_state(program) == []
+
+
+def test_shared_state_single_root_write_is_clean(tmp_path):
+    files = {
+        "svc.py": (
+            "import threading\n"
+            "class Svc:\n"
+            "    def _loop(self):\n"
+            "        self.count = 0\n"  # only ONE root ever writes
+            "    def on_read(self, env):\n"
+            "        return {'n': self.count}\n"
+            "    def start(self, bus):\n"
+            "        bus.subscribe('read', self.on_read)\n"
+            "        threading.Thread(target=self._loop).start()\n"
+        ),
+    }
+    program = Program.build(_pkg(tmp_path, files), "mypkg")
+    assert analyze_shared_state(program) == []
+
+
+def test_shared_state_interprocedural_guard_via_must_hold(tmp_path):
+    # the lock is taken by the CALLER; the helper that writes inherits it
+    # through must-hold propagation across both roots
+    files = {
+        "svc.py": (
+            "import threading\n"
+            "class Svc:\n"
+            "    def _bump(self):\n"
+            "        self.count += 1\n"
+            "    def on_write(self, env):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+            "    def _loop(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"
+            "    def start(self, bus):\n"
+            "        bus.subscribe('write', self.on_write)\n"
+            "        threading.Thread(target=self._loop).start()\n"
+        ),
+    }
+    program = Program.build(_pkg(tmp_path, files), "mypkg")
+    assert analyze_shared_state(program) == []
+
+
+def test_shared_state_constructor_writes_exempt(tmp_path):
+    # __init__ (and helpers only reachable through it) are pre-publication
+    files = {
+        "svc.py": (
+            "import threading\n"
+            "class Svc:\n"
+            "    def __init__(self):\n"
+            "        self._setup()\n"
+            "    def _setup(self):\n"
+            "        self.count = 0\n"
+            "    def on_a(self, env):\n"
+            "        s = Svc()\n"
+            "        return {}\n"
+            "    def on_b(self, env):\n"
+            "        s = Svc()\n"
+            "        return {}\n"
+            "    def start(self, bus):\n"
+            "        bus.subscribe('a', self.on_a)\n"
+            "        bus.subscribe('b', self.on_b)\n"
+        ),
+    }
+    program = Program.build(_pkg(tmp_path, files), "mypkg")
+    assert analyze_shared_state(program) == []
+
+
+def test_shared_state_sync_primitives_exempt(tmp_path):
+    files = {
+        "svc.py": (
+            "import threading, queue\n"
+            "class Svc:\n"
+            "    def __init__(self):\n"
+            "        self._stop = threading.Event()\n"
+            "        self._q = queue.Queue()\n"
+            "    def on_write(self, env):\n"
+            "        self._q.put(env)\n"
+            "        return {}\n"
+            "    def _loop(self):\n"
+            "        self._q.put(None)\n"
+            "        self._stop.set()\n"
+            "    def start(self, bus):\n"
+            "        bus.subscribe('write', self.on_write)\n"
+            "        threading.Thread(target=self._loop).start()\n"
+        ),
+    }
+    program = Program.build(_pkg(tmp_path, files), "mypkg")
+    assert analyze_shared_state(program) == []
+
+
+def test_shared_state_mutator_calls_count_as_writes(tmp_path):
+    files = {
+        "svc.py": (
+            "import threading\n"
+            "class Svc:\n"
+            "    def on_write(self, env):\n"
+            "        self.items.append(env)\n"
+            "        return {}\n"
+            "    def _loop(self):\n"
+            "        self.items.clear()\n"
+            "    def start(self, bus):\n"
+            "        bus.subscribe('write', self.on_write)\n"
+            "        threading.Thread(target=self._loop).start()\n"
+        ),
+    }
+    program = Program.build(_pkg(tmp_path, files), "mypkg")
+    fs = analyze_shared_state(program)
+    assert len(fs) == 1 and "Svc.items" in fs[0].message
+    accesses = [
+        a for a in collect_accesses(program) if a.attr.endswith("items")
+    ]
+    assert all(a.write for a in accesses)
+
+
+def test_shared_state_baseline_ratchet(tmp_path):
+    program = Program.build(_pkg(tmp_path, _RACY_PKG), "mypkg")
+    live = frozenset({"mypkg.svc.Svc.count"})
+    # baselined live race: tolerated
+    assert analyze_shared_state(program, baseline=live) == []
+    # stale entry: fails so the set only shrinks
+    fs = analyze_shared_state(
+        program,
+        baseline=live | {"mypkg.svc.Svc.gone"},
+        baseline_path="<bl>",
+    )
+    assert len(fs) == 1 and "stale baseline" in fs[0].message
+
+
+def test_shared_state_grpc_servicer_and_timer_roots(tmp_path):
+    files = {
+        "api.py": (
+            "import threading\n"
+            "class WireServices:\n"
+            "    def measure_write(self, req):\n"
+            "        self.total += 1\n"
+            "        return req\n"
+            "class Saver:\n"
+            "    def _fire(self):\n"
+            "        self.total = 0\n"
+            "    def schedule(self):\n"
+            "        threading.Timer(1.0, self._fire).start()\n"
+        ),
+    }
+    program = Program.build(_pkg(tmp_path, files), "mypkg")
+    kinds = {r.qual: r.kind for r in discover_roots(program)}
+    assert kinds.get("mypkg.api:WireServices.measure_write") == "grpc"
+    assert kinds.get("mypkg.api:Saver._fire") == "timer"
+
+
+def test_real_tree_shared_state_clean_with_pinned_suppressions():
+    """The audited-tree meta-test: zero findings, and the suppression
+    population is a pinned, reviewed number — adding or dropping one
+    forces an edit here (same contract as test_tree_is_bdlint_clean)."""
+    import banyandb_tpu
+    from banyandb_tpu.lint.whole_program import run_whole_program
+
+    pkg = Path(banyandb_tpu.__file__).parent
+    findings, stats = run_whole_program(pkg, plan_audit=False)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # 3 wp-shared-state suppressions: bydbql._Parser (per-call instance),
+    # StreamEngine.last_scan_stats (atomic diagnostic rebind),
+    # Bloom.bits (function-local during part build)
+    assert stats["wp_suppressed"] == 3
+    # root discovery is not vacuous: threads, subscribers, grpc methods
+    assert stats["wp_roots"] >= 60
 
 
 # -- plan auditor ------------------------------------------------------------
