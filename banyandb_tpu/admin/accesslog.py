@@ -15,15 +15,26 @@ from typing import Optional
 
 
 class AccessLog:
+    # one default for every surface that marks queries slow (access log,
+    # flight recorder); overridable per instance, via the server config
+    # flag --slow-query-ms, or the BYDB_SLOW_QUERY_MS env
+    DEFAULT_SLOW_QUERY_MS = 500.0
+
     def __init__(
         self,
         path: str | Path,
         *,
-        slow_query_ms: float = 500.0,
+        slow_query_ms: Optional[float] = None,
         max_bytes: int = 64 << 20,
     ):
+        from banyandb_tpu.utils.envflag import env_float
+
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        if slow_query_ms is None:
+            slow_query_ms = env_float(
+                "BYDB_SLOW_QUERY_MS", self.DEFAULT_SLOW_QUERY_MS
+            )
         self.slow_query_ms = slow_query_ms
         self.max_bytes = max_bytes
         self._lock = threading.Lock()

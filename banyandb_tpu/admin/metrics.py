@@ -2,6 +2,9 @@
 
 - in-memory registry with Prometheus text exposition
   (pkg/meter/prom analog — scrape via the server's "metrics" topic),
+  now with exponential-bucket histograms so latency quantiles are
+  recoverable from ``/metrics`` (the core lives in obs/metrics.py at
+  the platform layer; this module re-exports it for admin callers),
 - self-measure writer: periodic dump of all instruments as data points
   into the `_monitoring` group (pkg/meter/native/provider.go:39,81
   analog), so the database monitors itself with its own query engine.
@@ -11,79 +14,46 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
 from typing import Optional
 
-
-class Meter:
-    """Scoped instrument registry: counters, gauges, histograms."""
-
-    def __init__(self, scope: str = ""):
-        self.scope = scope
-        self._lock = threading.Lock()
-        self._counters: dict[tuple, float] = defaultdict(float)
-        self._gauges: dict[tuple, float] = {}
-        # histograms keep running (count, sum) — bounded memory per key
-        self._hist: dict[tuple, tuple[int, float]] = {}
-
-    def _key(self, name: str, labels: Optional[dict]) -> tuple:
-        return (name, tuple(sorted((labels or {}).items())))
-
-    def counter_add(self, name: str, value: float = 1.0, labels: Optional[dict] = None):
-        with self._lock:
-            self._counters[self._key(name, labels)] += value
-
-    def gauge_set(self, name: str, value: float, labels: Optional[dict] = None):
-        with self._lock:
-            self._gauges[self._key(name, labels)] = value
-
-    def observe(self, name: str, value: float, labels: Optional[dict] = None):
-        with self._lock:
-            k = self._key(name, labels)
-            count, total = self._hist.get(k, (0, 0.0))
-            self._hist[k] = (count + 1, total + value)
-
-    # -- exposition ---------------------------------------------------------
-    def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "counters": dict(self._counters),
-                "gauges": dict(self._gauges),
-                "histograms": dict(self._hist),
-            }
-
-    def prometheus_text(self) -> str:
-        """Prometheus exposition format (pkg/meter/prom analog)."""
-        pfx = (self.scope + "_") if self.scope else ""
-        lines = []
-
-        def fmt_labels(lbls: tuple) -> str:
-            if not lbls:
-                return ""
-            inner = ",".join(f'{k}="{v}"' for k, v in lbls)
-            return "{" + inner + "}"
-
-        snap = self.snapshot()
-        for (name, lbls), v in sorted(snap["counters"].items()):
-            lines.append(f"{pfx}{name}_total{fmt_labels(lbls)} {v}")
-        for (name, lbls), v in sorted(snap["gauges"].items()):
-            lines.append(f"{pfx}{name}{fmt_labels(lbls)} {v}")
-        for (name, lbls), (count, total) in sorted(snap["histograms"].items()):
-            lines.append(f"{pfx}{name}_count{fmt_labels(lbls)} {count}")
-            lines.append(f"{pfx}{name}_sum{fmt_labels(lbls)} {total}")
-        return "\n".join(lines) + "\n"
+from banyandb_tpu.obs.metrics import (  # noqa: F401 - the admin surface
+    DEFAULT_BOUNDS,
+    Histogram,
+    Meter,
+    global_meter,
+)
 
 
 class SelfMeasureSink:
     """Write instruments as measure points into `_monitoring`
-    (the reference's native meter provider)."""
+    (the reference's native meter provider).
+
+    ``start()`` runs a background flusher (``bydb-self-measure``) so the
+    group is periodically populated without operator action; histograms
+    land as count/sum plus p50/p99 estimates so the self-measures carry
+    the same attribution ``/metrics`` does."""
 
     GROUP = "_monitoring"
     MEASURE = "instruments"
+    DEFAULT_INTERVAL_S = 30.0
 
-    def __init__(self, meter: Meter, measure_engine):
+    def __init__(
+        self,
+        meter: Meter,
+        measure_engine,
+        interval_s: Optional[float] = None,
+    ):
+        from banyandb_tpu.utils.envflag import env_float
+
         self.meter = meter
         self.engine = measure_engine
+        self.interval_s = (
+            interval_s
+            if interval_s is not None
+            else env_float("BYDB_SELF_MEASURE_INTERVAL_S", self.DEFAULT_INTERVAL_S)
+        )
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
         self._ensure_schema()
 
     def _ensure_schema(self) -> None:
@@ -122,6 +92,34 @@ class SelfMeasureSink:
                 )
             )
 
+    # -- periodic flusher ---------------------------------------------------
+    def start(self) -> None:
+        """Populate `_monitoring` on a cadence (idempotent)."""
+        if self._thread is not None:
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="bydb-self-measure", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 - the sink must not die with
+                # a transient engine error (e.g. mid-shutdown write refusal)
+                import logging
+
+                logging.getLogger(__name__).exception("self-measure flush failed")
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+            self._thread = None
+
     def flush(self, now_millis: Optional[int] = None) -> int:
         from banyandb_tpu.api.model import DataPointValue, WriteRequest
 
@@ -147,6 +145,15 @@ class SelfMeasureSink:
         for (name, lbls), (count, total) in snap["histograms"].items():
             add("histogram_count", name, lbls, count)
             add("histogram_sum", name, lbls, total)
+            bounds, counts = snap["hist_buckets"][(name, lbls)]
+            if count:
+                from banyandb_tpu.obs.metrics import quantile_from_buckets
+
+                for q, kind in ((0.5, "histogram_p50"), (0.99, "histogram_p99")):
+                    add(
+                        kind, name, lbls,
+                        quantile_from_buckets(bounds, counts, count, q),
+                    )
         if points:
             self.engine.write(
                 WriteRequest(self.GROUP, self.MEASURE, tuple(points)),

@@ -20,6 +20,8 @@ Routes (base path /api as upstream):
     POST /api/v1/stream/schema         StreamRegistryService.Create
     GET  /api/v1/stream/schema/{g}/{n}    StreamRegistryService.Get
     GET  /api/healthz
+    GET  /metrics                      Prometheus exposition (obs plane)
+    GET  /api/v1/slowlog?limit=N       slow-query flight recorder
 """
 
 from __future__ import annotations
@@ -75,12 +77,17 @@ class HttpGateway:
         host: str = "127.0.0.1",
         port: int = 17913,
         auth=None,
+        slowlog=None,
     ):
         """auth: optional banyandb_tpu.api.auth.AuthReloader — when set,
         every API route (healthz excepted) requires HTTP Basic credentials
-        from the same hot-reloaded users file as the gRPC surface."""
+        from the same hot-reloaded users file as the gRPC surface.
+
+        slowlog: optional obs.SlowQueryRecorder — enables
+        GET /api/v1/slowlog (the flight recorder's HTTP surface)."""
         self.services = services
         self.auth = auth
+        self.slowlog = slowlog
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -156,6 +163,42 @@ class HttpGateway:
             def do_GET(self):
                 if self.path == "/api/healthz":
                     return self._send(200, {"status": "ok"})
+                if self.path == "/metrics":
+                    # Prometheus scrape surface: the process-global meter
+                    # (stage histograms, rpc, lifecycle, caches)
+                    from banyandb_tpu.obs.metrics import global_meter
+
+                    if not self._check_auth():
+                        return
+                    body = global_meter().prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.split("?")[0] == "/api/v1/slowlog":
+                    if not self._check_auth():
+                        return
+                    if gateway.slowlog is None:
+                        return self._send(
+                            404, {"error": "slow-query recorder not wired"}
+                        )
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    limit = None
+                    if q.get("limit"):
+                        try:
+                            limit = int(q["limit"][0])
+                        except ValueError:
+                            limit = None
+                    return self._send(
+                        200,
+                        {"entries": gateway.slowlog.entries(limit=limit)},
+                    )
                 if self.path in ("/", "/console"):
                     page = gateway._console_page
                     if page is None:

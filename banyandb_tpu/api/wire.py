@@ -413,12 +413,28 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
 def fill_trace(out, res) -> None:
     """Attach in-band query-trace spans to a QueryResponse proto
     (common/v1 Trace; the reference threads pkg/query/tracer spans back
-    the same way — dquery/measure.go:104).  Each key of the internal
-    trace dict becomes one span; the plan rendering rides the span
-    message so `trace=true` clients see the plan tree on the wire."""
+    the same way — dquery/measure.go:104).  The hierarchical span_tree
+    (obs/tracer) maps natively onto common/v1 Span.children — a merged
+    cluster tree keeps per-node subtrees nested on the wire; remaining
+    keys of the internal trace dict become flat spans (the plan
+    rendering rides the span message so `trace=true` clients see the
+    plan tree)."""
     tr = getattr(res, "trace", None)
     if not tr or not hasattr(out, "trace"):
         return
+
+    def fill_tree(sp, node: dict) -> None:
+        sp.message = str(node.get("name", ""))
+        # duration is nanoseconds on the wire (common/v1 Span.duration)
+        sp.duration = int(float(node.get("duration_ms", 0.0)) * 1e6)
+        if node.get("error"):
+            sp.error = True
+            sp.tags.add(key="error", value=str(node["error"]))
+        for k, v in (node.get("tags") or {}).items():
+            sp.tags.add(key=str(k), value=str(v))
+        for child in node.get("children", ()):
+            if isinstance(child, dict):
+                fill_tree(sp.children.add(), child)
 
     def add_span(message: str, fields: dict) -> None:
         span = out.trace.spans.add()
@@ -427,7 +443,9 @@ def fill_trace(out, res) -> None:
             span.tags.add(key=str(k), value=str(v))
 
     for key, val in tr.items():
-        if isinstance(val, list) and all(isinstance(x, dict) for x in val):
+        if key == "span_tree" and isinstance(val, dict):
+            fill_tree(out.trace.spans.add(), val)
+        elif isinstance(val, list) and all(isinstance(x, dict) for x in val):
             # per-phase span lists (measure _trace_spans): one proto span
             # each, named by the entry's own name where present
             for i, entry in enumerate(val):

@@ -16,7 +16,13 @@ import sys
 
 from banyandb_tpu.cluster.rpc import GrpcTransport
 from banyandb_tpu.cluster.bus import Topic
-from banyandb_tpu.server import TOPIC_QL, TOPIC_REGISTRY, TOPIC_SNAPSHOT
+from banyandb_tpu.server import (
+    TOPIC_METRICS,
+    TOPIC_QL,
+    TOPIC_REGISTRY,
+    TOPIC_SLOWLOG,
+    TOPIC_SNAPSHOT,
+)
 
 
 def _call(args, topic: str, envelope: dict) -> dict:
@@ -76,6 +82,18 @@ def main(argv=None) -> int:
 
     q = sub.add_parser("query")
     q.add_argument("ql", help="BydbQL text")
+
+    sl = sub.add_parser(
+        "slowlog",
+        help="slow-query flight recorder: span trees + plan text of "
+        "queries over --slow-query-ms (newest first)",
+    )
+    sl.add_argument("--limit", type=int, default=20)
+    sl.add_argument(
+        "--clear", action="store_true", help="drain the ring buffer"
+    )
+
+    sub.add_parser("metrics", help="Prometheus exposition text")
 
     tg = sub.add_parser("trace-get")
     tg.add_argument("group")
@@ -182,6 +200,13 @@ def main(argv=None) -> int:
         print(json.dumps(_call(args, Topic.MEASURE_WRITE.value, env)))
     elif args.cmd == "query":
         print(json.dumps(_call(args, TOPIC_QL, {"ql": args.ql}), indent=1))
+    elif args.cmd == "slowlog":
+        env = {"limit": args.limit}
+        if args.clear:
+            env["clear"] = True
+        print(json.dumps(_call(args, TOPIC_SLOWLOG, env), indent=1))
+    elif args.cmd == "metrics":
+        print(_call(args, TOPIC_METRICS, {})["prometheus"], end="")
     elif args.cmd == "trace-get":
         print(json.dumps(_call(args, Topic.TRACE_QUERY_BY_ID.value, {
             "group": args.group, "name": args.name, "trace_id": args.trace_id,

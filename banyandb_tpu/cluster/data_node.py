@@ -106,6 +106,17 @@ class DataNode:
             ),
         )
         self.bus.subscribe(Topic.SYNC_PART, self._on_sync_part)
+        # node-local metrics exposition ("metrics" topic, same envelope
+        # as the standalone server's TOPIC_METRICS): stage histograms
+        # and engine instruments land in the process-global meter
+        from banyandb_tpu.obs import metrics as obs_metrics
+
+        self.bus.subscribe(
+            "metrics",
+            lambda env: {
+                "prometheus": obs_metrics.global_meter().prometheus_text()
+            },
+        )
         # operator flush surface (data-node SnapshotService analog):
         # persists memtables to parts on demand — ops tooling and tests
         # use it to bound the direct-write plane's crash-loss window
@@ -159,8 +170,9 @@ class DataNode:
             self.stream.get_stream(req.groups[0], req.name)
         except KeyError:
             return {"data_points": []}
-        res = self.stream.query(req, shard_ids=shard_ids)
-        return {
+        tracer = self._node_tracer(req)
+        res = self.stream.query(req, shard_ids=shard_ids, tracer=tracer)
+        out = {
             "data_points": [
                 {
                     **dp,
@@ -170,6 +182,9 @@ class DataNode:
                 for dp in res.data_points
             ]
         }
+        if tracer is not None:
+            out["trace"] = tracer.finish()
+        return out
 
     # -- trace plane (trace svc_data analog) --------------------------------
     def _on_trace_write(self, env: dict) -> dict:
@@ -226,20 +241,39 @@ class DataNode:
         return {"written": n}
 
     # -- query plane --------------------------------------------------------
+    def _node_tracer(self, req):
+        """Per-node tracer when the request is traced: this node runs its
+        own span tree and ships the subtree back in the reply for the
+        liaison's cluster-wide merge (pkg/query/tracer propagation,
+        dquery/measure.go:104 analog)."""
+        if not req.trace:
+            return None
+        from banyandb_tpu.obs.tracer import Tracer
+
+        return Tracer(f"data:{self.name}")
+
     def _on_measure_query_partial(self, env: dict) -> dict:
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         hist_range = tuple(env["hist_range"]) if env.get("hist_range") else None
+        tracer = self._node_tracer(req)
         partials = self.measure.query_partials(
-            req, shard_ids=shard_ids, hist_range=hist_range
+            req, shard_ids=shard_ids, hist_range=hist_range, tracer=tracer
         )
-        return {"partials": serde.partials_to_json(partials)}
+        out = {"partials": serde.partials_to_json(partials)}
+        if tracer is not None:
+            out["trace"] = tracer.finish()
+        return out
 
     def _on_measure_query_raw(self, env: dict) -> dict:
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
-        res = self.measure.query(req, shard_ids=shard_ids)
-        return {"data_points": res.data_points}
+        tracer = self._node_tracer(req)
+        res = self.measure.query(req, shard_ids=shard_ids, tracer=tracer)
+        out = {"data_points": res.data_points}
+        if tracer is not None:
+            out["trace"] = tracer.finish()
+        return out
 
     # -- schema sync (schemaserver/gossip analog, push-based) ---------------
     def _on_schema_sync(self, env: dict) -> dict:

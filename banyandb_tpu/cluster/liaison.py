@@ -25,6 +25,7 @@ from banyandb_tpu.cluster import serde
 from banyandb_tpu.cluster.bus import Topic
 from banyandb_tpu.cluster.node import NodeInfo, RoundRobinSelector
 from banyandb_tpu.cluster.rpc import TransportError
+from banyandb_tpu.obs.tracer import NOOP_TRACER, Tracer
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.utils import hashing
 
@@ -481,7 +482,9 @@ class Liaison:
         req: QueryRequest,
         assignment: dict[NodeInfo, list[int]],
         hist_range: Optional[tuple[float, float]],
+        tracer=None,
     ) -> list[measure_exec.Partials]:
+        t = tracer if tracer is not None else NOOP_TRACER
         env_base = {
             "request": serde.query_request_to_json(req),
             "hist_range": list(hist_range) if hist_range else None,
@@ -489,10 +492,15 @@ class Liaison:
         out = []
         for node, shards in assignment.items():
             env = dict(env_base, shards=shards)
-            r = self.transport.call(
-                node.addr, Topic.MEASURE_QUERY_PARTIAL.value, env,
-                timeout=_RPC_QUERY_S,
-            )
+            with t.span(f"scatter:{node.name}") as sp:
+                r = self.transport.call(
+                    node.addr, Topic.MEASURE_QUERY_PARTIAL.value, env,
+                    timeout=_RPC_QUERY_S,
+                )
+                sp.tag("shards", list(shards))
+                # the node ran its own tracer; graft its subtree so the
+                # response carries ONE merged span tree
+                sp.attach(r.get("trace"))
             out.append(serde.partials_from_json(r["partials"]))
         return out
 
@@ -506,22 +514,40 @@ class Liaison:
 
         self.mesh_exec = MeshExecutor(mesh, engines_by_node)
 
-    def query_measure(self, req: QueryRequest) -> QueryResult:
+    def query_measure(self, req: QueryRequest, tracer=None) -> QueryResult:
+        """Distributed measure query.  `tracer`: span sink threaded from
+        the serving surface (LiaisonServer passes one for the slow-query
+        recorder); when None and req.trace is set the liaison owns a
+        local tracer.  Node subtrees merge under the scatter spans, so
+        `trace=true` responses carry ONE cluster-wide span tree."""
+        own_tracer = tracer is None and req.trace
+        if own_tracer:
+            tracer = Tracer("liaison:measure")
+        t = tracer if tracer is not None else NOOP_TRACER
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
-        assignment = self._shard_assignment(group, req.stages)
+        with t.span("plan") as ps:
+            assignment = self._shard_assignment(group, req.stages)
+            ps.tag("nodes", sorted(n.name for n in assignment))
+
+        def _attach_tree(res) -> QueryResult:
+            if own_tracer and req.trace:
+                res.trace = dict(res.trace or {})
+                res.trace["span_tree"] = tracer.finish()
+            return res
 
         mesh_exec = getattr(self, "mesh_exec", None)
         if mesh_exec is not None and (req.agg or req.group_by):
             from banyandb_tpu.parallel.mesh_query import MeshUnsupported
 
             try:
-                res = mesh_exec.execute(m, req, assignment)
+                with t.span("mesh_execute"):
+                    res = mesh_exec.execute(m, req, assignment)
                 self._attach_distributed_plan(
                     res, m, req, assignment,
                     combine="mesh psum/pmin/pmax collectives (fast path)",
                 )
-                return res
+                return _attach_tree(res)
             except MeshUnsupported:
                 pass  # general scatter path below
 
@@ -534,23 +560,28 @@ class Liaison:
             node_req = dataclasses.replace(req, offset=0, limit=off + limit)
             rows: list[dict] = []
             for node, shards in assignment.items():
-                r = self.transport.call(
-                    node.addr,
-                    Topic.MEASURE_QUERY_RAW.value,
-                    {
-                        "request": serde.query_request_to_json(node_req),
-                        "shards": shards,
-                    },
-                    timeout=_RPC_QUERY_S,
-                )
+                with t.span(f"scatter:{node.name}") as sp:
+                    r = self.transport.call(
+                        node.addr,
+                        Topic.MEASURE_QUERY_RAW.value,
+                        {
+                            "request": serde.query_request_to_json(node_req),
+                            "shards": shards,
+                        },
+                        timeout=_RPC_QUERY_S,
+                    )
+                    sp.tag("rows", len(r["data_points"]))
+                    sp.attach(r.get("trace"))
                 rows.extend(r["data_points"])
-            _sort_merged_rows(rows, req, default_desc=False)  # measure: ASC
+            with t.span("merge") as ms:
+                _sort_merged_rows(rows, req, default_desc=False)  # ASC
+                ms.tag("rows", len(rows))
             res = QueryResult()
             res.data_points = rows[off : off + limit]
             self._attach_distributed_plan(
                 res, m, req, assignment, combine="row merge (host ts sort)"
             )
-            return res
+            return _attach_tree(res)
 
         want_percentile = bool(req.agg and req.agg.function == "percentile")
         hist_range = None
@@ -559,7 +590,12 @@ class Liaison:
             stats_req = dataclasses.replace(
                 req, agg=Aggregation("min", req.agg.field_name), top=None
             )
-            stats = self._scatter_partials(stats_req, assignment, None)
+            with t.span("range_round"):
+                # tracer threads through: the round's per-node scatter
+                # spans (and node subtrees) nest under range_round
+                stats = self._scatter_partials(
+                    stats_req, assignment, None, tracer=tracer
+                )
             lo, hi = float("inf"), float("-inf")
             for p in stats:
                 st = p.field_stats.get(req.agg.field_name)
@@ -569,14 +605,19 @@ class Liaison:
                 lo, hi = 0.0, 1.0
             hist_range = (lo, max(hi - lo, 1e-6))
 
-        partials = self._scatter_partials(req, assignment, hist_range)
-        res = measure_exec.finalize_partials(m, req, partials)
+        partials = self._scatter_partials(
+            req, assignment, hist_range, tracer=tracer
+        )
+        res = measure_exec.finalize_partials(
+            m, req, partials,
+            span=t.current() if tracer is not None else None,
+        )
         self._attach_distributed_plan(
             res, m, req, assignment,
             combine="host combine_partials (f64 Kahan)",
             percentile="two-round range agreement" if want_percentile else "",
         )
-        return res
+        return _attach_tree(res)
 
     def _attach_distributed_plan(
         self, res, m, req, assignment, *, combine: str, percentile: str = ""
@@ -645,21 +686,30 @@ class Liaison:
         )
         return len(elements)
 
-    def query_stream(self, req: QueryRequest) -> QueryResult:
+    def query_stream(self, req: QueryRequest, tracer=None) -> QueryResult:
+        own_tracer = tracer is None and req.trace
+        if own_tracer:
+            tracer = Tracer("liaison:stream")
+        t = tracer if tracer is not None else NOOP_TRACER
         assignment = self._shard_assignment(req.groups[0], req.stages)
         off = req.offset or 0
         limit = req.limit or 100
         node_req = dataclasses.replace(req, offset=0, limit=off + limit)
         rows: list[dict] = []
         for node, shards in assignment.items():
-            r = self.transport.call(
-                node.addr,
-                Topic.STREAM_QUERY.value,
-                {"request": serde.query_request_to_json(node_req), "shards": shards},
-                timeout=_RPC_QUERY_S,
-            )
+            with t.span(f"scatter:{node.name}") as sp:
+                r = self.transport.call(
+                    node.addr,
+                    Topic.STREAM_QUERY.value,
+                    {"request": serde.query_request_to_json(node_req), "shards": shards},
+                    timeout=_RPC_QUERY_S,
+                )
+                sp.tag("rows", len(r["data_points"]))
+                sp.attach(r.get("trace"))
             rows.extend(r["data_points"])
-        _sort_merged_rows(rows, req)
+        with t.span("merge") as ms:
+            _sort_merged_rows(rows, req)
+            ms.tag("rows", len(rows))
         res = QueryResult()
         # decode back to the native engine contract (body/tags as bytes):
         # cluster and standalone callers see identical shapes
@@ -670,6 +720,9 @@ class Liaison:
             dp["body"] = base64.b64decode(dp.get("body", ""))
             dp["tags"] = serde.tags_from_json(dp["tags"])
             res.data_points.append(dp)
+        if own_tracer and req.trace:
+            res.trace = dict(res.trace or {})
+            res.trace["span_tree"] = tracer.finish()
         return res
 
     # -- trace plane (liaison trace svc analog) -----------------------------

@@ -12,12 +12,23 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
 from banyandb_tpu.cluster.bus import LocalBus
+from banyandb_tpu.obs import metrics as obs_metrics
 
 _METHOD = "/banyandb.Bus/Call"
+
+
+def _observe_rpc(side: str, topic: str, t0: float) -> None:
+    """Stage-labelled fabric latency: rpc_client_ms / rpc_server_ms per
+    topic.  Handle lookup is the meter's lock-free fast path; observe
+    happens after the call completes, never under a transport lock."""
+    obs_metrics.global_meter().histogram(
+        f"rpc_{side}_ms", {"topic": topic}
+    ).observe((time.perf_counter() - t0) * 1000)
 
 
 class TransportError(RuntimeError):
@@ -59,6 +70,7 @@ class LocalTransport:
         bus = self._buses.get(addr[6:])
         if bus is None:
             raise TransportError(f"node {addr} unreachable")
+        t0 = time.perf_counter()
         try:
             return bus.handle(topic, envelope)
         except Exception as e:
@@ -69,6 +81,8 @@ class LocalTransport:
                     f"{type(e).__name__}: {e}", kind="shed"
                 ) from e
             raise
+        finally:
+            _observe_rpc("client", topic, t0)
 
 
 def prespawn_pool(pool) -> None:
@@ -126,6 +140,7 @@ class GrpcBusServer:
 
         def call_behavior(request: bytes, context) -> bytes:
             msg = json.loads(request)
+            t0 = time.perf_counter()
             try:
                 reply = self.bus.handle(msg["topic"], msg["envelope"])
                 return json.dumps({"ok": True, "reply": reply}).encode()
@@ -140,6 +155,8 @@ class GrpcBusServer:
                         "error": f"{type(e).__name__}: {e}",
                     }
                 ).encode()
+            finally:
+                _observe_rpc("server", msg.get("topic", "?"), t0)
 
         handler = grpc.method_handlers_generic_handler(
             "banyandb.Bus",
@@ -336,12 +353,15 @@ class GrpcTransport:
 
         stub, ch = self._stub(addr)
         payload = json.dumps({"topic": topic, "envelope": envelope}).encode()
+        t0 = time.perf_counter()
         try:
             raw = stub(payload, timeout=timeout)
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.UNAVAILABLE:
                 self._evict(addr, ch)
             raise TransportError(f"rpc to {addr} failed: {e.code()}") from e
+        finally:
+            _observe_rpc("client", topic, t0)
         msg = json.loads(raw)
         if not msg.get("ok"):
             raise TransportError(

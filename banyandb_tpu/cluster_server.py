@@ -166,10 +166,21 @@ class LiaisonServer:
         wire_port: int | None = None,
         http_port: int | None = None,
         auth_file: str | None = None,
+        slow_query_ms: float | None = None,
     ):
+        from banyandb_tpu.admin.accesslog import AccessLog
+        from banyandb_tpu.obs import SlowQueryRecorder
+        from banyandb_tpu.utils.envflag import env_float
+
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
         self.transport = GrpcTransport()
+        if slow_query_ms is None:
+            slow_query_ms = env_float(
+                "BYDB_SLOW_QUERY_MS", AccessLog.DEFAULT_SLOW_QUERY_MS
+            )
+        self.slow_query_ms = slow_query_ms
+        self.slowlog = SlowQueryRecorder()
         self.liaison = Liaison(
             self.registry,
             self.transport,
@@ -217,7 +228,8 @@ class LiaisonServer:
                     else AuthReloader(auth_file)
                 )
             self.http = HttpGateway(
-                self._wire_services, port=http_port, auth=http_auth
+                self._wire_services, port=http_port, auth=http_auth,
+                slowlog=self.slowlog,
             )
         self._stop = threading.Event()
         self._probe_thread: threading.Thread | None = None
@@ -256,7 +268,13 @@ class LiaisonServer:
 
     # -- user surface -------------------------------------------------------
     def _register(self) -> None:
-        from banyandb_tpu.server import TOPIC_QL, TOPIC_REGISTRY
+        from banyandb_tpu.obs.metrics import global_meter
+        from banyandb_tpu.server import (
+            TOPIC_METRICS,
+            TOPIC_QL,
+            TOPIC_REGISTRY,
+            TOPIC_SLOWLOG,
+        )
 
         b = self.bus
         b.subscribe(
@@ -268,6 +286,11 @@ class LiaisonServer:
             },
         )
         b.subscribe(TOPIC_REGISTRY, self._registry_op)
+        b.subscribe(
+            TOPIC_METRICS,
+            lambda env: {"prometheus": global_meter().prometheus_text()},
+        )
+        b.subscribe(TOPIC_SLOWLOG, self._slowlog)
         b.subscribe(Topic.MEASURE_WRITE, self._measure_write)
         b.subscribe(Topic.STREAM_WRITE, self._stream_write)
         b.subscribe(Topic.TRACE_WRITE, self._trace_write)
@@ -347,22 +370,66 @@ class LiaisonServer:
         )
         return {"spans": serde.spans_to_json(spans)}
 
+    def _slowlog(self, env: dict):
+        from banyandb_tpu.obs.recorder import slowlog_topic_reply
+
+        return slowlog_topic_reply(self.slowlog, env, self.slow_query_ms)
+
     def _ql(self, env: dict):
+        import time as _time
+
         from banyandb_tpu import bydbql
+        from banyandb_tpu.obs import Tracer
         from banyandb_tpu.server import result_to_json
 
         catalog, req = bydbql.parse_with_catalog(
             env["ql"], env.get("params", ())
         )
+        # always-on liaison-side tracer (node subtrees only attach when
+        # req.trace rode the scatter): slow distributed queries land in
+        # the flight recorder with whatever tree exists
+        tracer = Tracer(f"liaison:{catalog}")
+        t0 = _time.perf_counter()
         if catalog == "measure":
-            res = self.liaison.query_measure(req)
+            res = self.liaison.query_measure(req, tracer=tracer)
         elif catalog == "stream":
-            res = self.liaison.query_stream(req)
+            res = self.liaison.query_stream(req, tracer=tracer)
         else:
             raise ValueError(
                 f"liaison QL serves measure/stream catalogs; {catalog} "
                 "queries use the dedicated topics"
             )
+        ms = (_time.perf_counter() - t0) * 1000
+        tree = tracer.finish()
+
+        def render_plan():
+            # untraced slow query: render the DISTRIBUTED plan post-hoc
+            # (only past the threshold, never on the hot path)
+            from banyandb_tpu.query import logical
+
+            if catalog == "measure":
+                m = self.registry.get_measure(req.groups[0], req.name)
+                return logical.analyze_measure_distributed(
+                    m, req, sorted(self.liaison.alive)
+                ).explain()
+            s = self.registry.get_stream(req.groups[0], req.name)
+            return logical.analyze_stream(s, req).explain()
+
+        from banyandb_tpu.obs.recorder import record_slow_query
+        from banyandb_tpu.obs.tracer import attach_tree
+
+        record_slow_query(
+            self.slowlog, self.slow_query_ms,
+            engine=catalog,
+            group=req.groups[0] if req.groups else "",
+            name=req.name,
+            duration_ms=ms,
+            rows=len(res.data_points) or len(res.groups),
+            span_tree=tree, ql=env["ql"],
+            plan=(res.trace or {}).get("plan"),
+            plan_fn=render_plan,
+        )
+        attach_tree(res, req, tree)
         return {"result": result_to_json(res)}
 
     # -- lifecycle ----------------------------------------------------------

@@ -69,6 +69,10 @@ CONFIG = LayerConfig(
         "": L0,  # package root __init__
         "utils": L0,
         "config": L0,
+        # self-observability primitives (tracer/metrics/recorder/prom):
+        # dependency-free by design so storage, engines, query and the
+        # fabric can all instrument themselves without upward edges
+        "obs": L0,
         # L1 — storage substrate + shared model/schema types
         "storage": L1,
         "index": L1,

@@ -25,6 +25,8 @@ from banyandb_tpu.api.schema import (
     SchemaRegistry,
     TagType,
 )
+from banyandb_tpu.obs import metrics as obs_metrics
+from banyandb_tpu.obs.tracer import NOOP_TRACER, Tracer
 from banyandb_tpu.query import filter as qfilter
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.storage.memtable import MemTable
@@ -35,6 +37,13 @@ from banyandb_tpu.utils import hashing
 
 _RAW_FIELD_TYPES = (FieldType.STRING, FieldType.DATA_BINARY)
 _RAW_FIELD_PREFIX = "@f:"
+
+# engine-level latency instrument (one per query engine; the other
+# three live in their models/ modules) + part-gather stage attribution
+_H_QUERY = obs_metrics.global_meter().histogram(
+    "query_ms", {"engine": "measure"}
+)
+_H_PART_GATHER = obs_metrics.stage_histogram("part_gather")
 
 # Server-assigned write versions are MONOTONIC per process (the
 # reference assigns nanosecond timestamps per point): two writes of the
@@ -561,9 +570,16 @@ class MeasureEngine:
         return out
 
     # -- query path (query.go:88 analog) -----------------------------------
-    def query(self, req: QueryRequest, shard_ids=None) -> QueryResult:
+    def query(
+        self, req: QueryRequest, shard_ids=None, tracer=None
+    ) -> QueryResult:
         """Execute; when req.trace is set, attach in-band trace spans
         (pkg/query/tracer.go analog: spans ride back in the response).
+
+        `tracer` (obs.tracer.Tracer): caller-owned span sink — servers
+        pass one so the tree also feeds the slow-query flight recorder;
+        when None and req.trace is set the engine owns a local one and
+        attaches its tree as res.trace["span_tree"].
 
         Routing decisions come off the logical plan tree
         (query/logical.py, measure_analyzer.go:70 analog): the analyzer
@@ -572,39 +588,65 @@ class MeasureEngine:
         executors."""
         from banyandb_tpu.query import logical
 
+        own_tracer = tracer is None and req.trace
+        if own_tracer:
+            tracer = Tracer("measure:query")
+        t = tracer if tracer is not None else NOOP_TRACER
+
         t_start = time.perf_counter()
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
-        plan = logical.analyze_measure(m, req)
-        if plan.leaf().kind == "IndexModeScan":
-            # Short-circuit: whole measure lives in the series index
-            # (SearchWithoutSeries, measure/query.go:506,559).
-            sources = self._index_sources(db, m, req, shard_ids)
-        else:
-            # A concurrent merge can GC a part dir after we snapshot the
-            # part list; that read raises FileNotFoundError and we retry
-            # against the fresh snapshot (the reference's epoch contract).
-            for attempt in range(3):
-                try:
-                    sources = self._gather_sources(db, m, req, shard_ids=shard_ids)
-                    break
-                except FileNotFoundError:
-                    if attempt == 2:
-                        raise
-        t_gather = time.perf_counter()
-        analyzers = self._tag_analyzers(group, req.name)
-        if plan.find("GroupByAggregate") is not None:
-            res = measure_exec.execute_aggregate(
-                m, req, sources,
-                dict_state=self._dict_state(group, req.name),
-                analyzers=analyzers,
+        with t.span("analyze"):
+            plan = logical.analyze_measure(m, req)
+        t_pg = time.perf_counter()  # stage metric covers ONLY part gather
+        with t.span("part_gather") as gs:
+            if plan.leaf().kind == "IndexModeScan":
+                # Short-circuit: whole measure lives in the series index
+                # (SearchWithoutSeries, measure/query.go:506,559).
+                sources = self._index_sources(db, m, req, shard_ids)
+            else:
+                # A concurrent merge can GC a part dir after we snapshot
+                # the part list; that read raises FileNotFoundError and we
+                # retry against the fresh snapshot (the reference's epoch
+                # contract).
+                for attempt in range(3):
+                    try:
+                        sources = self._gather_sources(
+                            db, m, req, shard_ids=shard_ids
+                        )
+                        break
+                    except FileNotFoundError:
+                        if attempt == 2:
+                            raise
+            gs.tag("sources", len(sources)).tag(
+                "rows", sum(int(s.ts.size) for s in sources)
             )
-        else:
-            res = _raw_rows(m, req, sources, analyzers=analyzers)
+        t_gather = time.perf_counter()
+        _H_PART_GATHER.observe((t_gather - t_pg) * 1000)
+        analyzers = self._tag_analyzers(group, req.name)
+        try:
+            if plan.find("GroupByAggregate") is not None:
+                with t.span("execute") as es:
+                    res = measure_exec.execute_aggregate(
+                        m, req, sources,
+                        dict_state=self._dict_state(group, req.name),
+                        analyzers=analyzers,
+                        span=es if tracer is not None else None,
+                    )
+            else:
+                with t.span("execute") as es:
+                    es.tag("path", "raw_rows")
+                    res = _raw_rows(m, req, sources, analyzers=analyzers)
+        finally:
+            # observed on error paths too (stream/trace/property parity:
+            # per-engine latency must not go dark when queries fail)
+            _H_QUERY.observe((time.perf_counter() - t_start) * 1000)
         if req.trace:
             res.trace = _trace_spans(t_start, t_gather, sources, m.index_mode)
             res.trace["plan"] = plan.explain()
+            if own_tracer:
+                res.trace["span_tree"] = tracer.finish()
         return res
 
     def query_partials(
@@ -612,25 +654,46 @@ class MeasureEngine:
         req: QueryRequest,
         shard_ids=None,
         hist_range=None,
+        tracer=None,
     ):
         """Data-node map phase: partial aggregates over (a subset of) local
-        shards (banyand/query processor + agg_return_partial analog)."""
+        shards (banyand/query processor + agg_return_partial analog).
+
+        `tracer`: the data node's own span sink — its finished tree rides
+        the RPC reply back to the liaison for the cluster-wide merge."""
+        t = tracer if tracer is not None else NOOP_TRACER
+        t0 = time.perf_counter()
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
-        sources = self.gather_query_sources(req, shard_ids=shard_ids)
+        t_pg = time.perf_counter()  # stage metric covers ONLY part gather
+        with t.span("part_gather") as gs:
+            sources = self.gather_query_sources(req, shard_ids=shard_ids)
+            gs.tag("sources", len(sources)).tag(
+                "rows", sum(int(s.ts.size) for s in sources)
+            ).tag("shards", sorted(shard_ids) if shard_ids else "all")
+        _H_PART_GATHER.observe((time.perf_counter() - t_pg) * 1000)
         analyzers = self._tag_analyzers(group, req.name)
-        if m.index_mode:
-            return measure_exec.compute_partials(
-                m, req, sources, hist_range=hist_range, analyzers=analyzers
-            )
-        return measure_exec.compute_partials(
-            m,
-            req,
-            sources,
-            hist_range=hist_range,
-            dict_state=self._dict_state(group, req.name),
-            analyzers=analyzers,
-        )
+        try:
+            with t.span("compute_partials") as cs:
+                span = cs if tracer is not None else None
+                if m.index_mode:
+                    out = measure_exec.compute_partials(
+                        m, req, sources, hist_range=hist_range,
+                        analyzers=analyzers, span=span,
+                    )
+                else:
+                    out = measure_exec.compute_partials(
+                        m,
+                        req,
+                        sources,
+                        hist_range=hist_range,
+                        dict_state=self._dict_state(group, req.name),
+                        analyzers=analyzers,
+                        span=span,
+                    )
+        finally:
+            _H_QUERY.observe((time.perf_counter() - t0) * 1000)
+        return out
 
     def _tag_analyzers(self, group: str, name: str) -> dict[str, str]:
         """tag -> analyzer from index rules BOUND to this measure (the

@@ -18,7 +18,12 @@ from typing import Optional
 
 from banyandb_tpu.api.schema import SchemaRegistry
 from banyandb_tpu.index.inverted import And, Doc, InvertedIndex, Query, TermQuery
+from banyandb_tpu.obs import metrics as obs_metrics
 from banyandb_tpu.utils import hashing
+
+_H_QUERY_PROPERTY = obs_metrics.global_meter().histogram(
+    "query_ms", {"engine": "property"}
+)
 
 
 @dataclass(frozen=True)
@@ -187,6 +192,23 @@ class PropertyEngine:
         limit: int = 100,
     ) -> list[Property]:
         """Scatter across shards, filter by name + tags (+ id set)."""
+        t0 = time.time()
+        try:
+            return self._query_inner(
+                group, name, tag_filters=tag_filters, ids=ids, limit=limit
+            )
+        finally:
+            _H_QUERY_PROPERTY.observe((time.time() - t0) * 1000)
+
+    def _query_inner(
+        self,
+        group: str,
+        name: str,
+        *,
+        tag_filters: Optional[dict] = None,
+        ids: Optional[list[str]] = None,
+        limit: int = 100,
+    ) -> list[Property]:
         clauses: list = [TermQuery("@name", name.encode())]
         for k, v in (tag_filters or {}).items():
             clauses.append(TermQuery(k, str(v).encode()))

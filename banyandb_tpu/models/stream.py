@@ -22,6 +22,8 @@ import numpy as np
 
 from banyandb_tpu.api.model import QueryRequest, QueryResult
 from banyandb_tpu.api.schema import SchemaRegistry, TagType
+from banyandb_tpu.obs import metrics as obs_metrics
+from banyandb_tpu.obs.tracer import NOOP_TRACER, Tracer
 from banyandb_tpu.query import filter as qfilter
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.storage.memtable import PayloadMemtable
@@ -33,6 +35,10 @@ from banyandb_tpu.utils import hashing
 # Stream schema objects live in the registry (persisted + SCHEMA_SYNC'd
 # like measures); re-exported here for engine-local convenience.
 from banyandb_tpu.api.schema import Stream  # noqa: E402
+
+_H_QUERY_STREAM = obs_metrics.global_meter().histogram(
+    "query_ms", {"engine": "stream"}
+)
 
 
 @dataclass(frozen=True)
@@ -171,7 +177,25 @@ class StreamEngine:
                 out.extend(db.flush_all())
         return out
 
-    def query(self, req: QueryRequest, shard_ids=None) -> QueryResult:
+    def query(
+        self, req: QueryRequest, shard_ids=None, tracer=None
+    ) -> QueryResult:
+        import time as _time
+
+        own_tracer = tracer is None and req.trace
+        if own_tracer:
+            tracer = Tracer("stream:query")
+        t = tracer if tracer is not None else NOOP_TRACER
+        t0 = _time.perf_counter()
+        try:
+            res = self._query_inner(req, shard_ids, t, own_tracer, tracer)
+        finally:
+            _H_QUERY_STREAM.observe((_time.perf_counter() - t0) * 1000)
+        return res
+
+    def _query_inner(
+        self, req: QueryRequest, shard_ids, t, own_tracer, tracer
+    ) -> QueryResult:
         group = req.groups[0]
         s = self.get_stream(group, req.name)
         db = self._tsdb(group)
@@ -185,13 +209,15 @@ class StreamEngine:
         conds = leaves if not expr else None
         res = QueryResult()
         rows: list[tuple] = []
-        for attempt in range(3):
-            try:
-                rows = self._scan(db, s, req, conds, shard_ids)
-                break
-            except FileNotFoundError:
-                if attempt == 2:
-                    raise
+        with t.span("scan") as ss:
+            for attempt in range(3):
+                try:
+                    rows = self._scan(db, s, req, conds, shard_ids)
+                    break
+                except FileNotFoundError:
+                    if attempt == 2:
+                        raise
+            ss.tag("rows", len(rows))
         if req.order_by_tag:
             have = [r for r in rows if r[3].get(req.order_by_tag) is not None]
             miss = [r for r in rows if r[3].get(req.order_by_tag) is None]
@@ -219,6 +245,8 @@ class StreamEngine:
                 "plan": logical.analyze_stream(s, req).explain(),
                 "rows_scanned": len(rows),
             }
+            if own_tracer:
+                res.trace["span_tree"] = tracer.finish()
         return res
 
     def _scan(

@@ -11,6 +11,7 @@ ordered secondary index (the reference's sidx, banyand/internal/sidx).
 from __future__ import annotations
 
 import hashlib
+import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
@@ -22,6 +23,7 @@ from banyandb_tpu.api.schema import SchemaRegistry, TagType
 from banyandb_tpu.index.sidx import SidxStore
 from banyandb_tpu.index.sidx import decode_ref as sidx_decode_ref
 from banyandb_tpu.index.sidx import encode_ref as sidx_encode_ref
+from banyandb_tpu.obs import metrics as obs_metrics
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.storage.memtable import PayloadMemtable
 from banyandb_tpu.storage.part import ColumnData
@@ -30,6 +32,10 @@ from banyandb_tpu.utils import hashing
 from banyandb_tpu.utils.bloom import Bloom
 
 BLOOM_FILE = "traceid.filter"
+
+_H_QUERY_TRACE = obs_metrics.global_meter().histogram(
+    "query_ms", {"engine": "trace"}
+)
 
 
 # Trace schema objects live in the registry (persisted + SCHEMA_SYNC'd
@@ -261,6 +267,15 @@ class TraceEngine:
     # -- queries -----------------------------------------------------------
     def query_by_trace_id(self, group: str, name: str, trace_id: str) -> list[dict]:
         """All spans of one trace (the trace span-store lookup)."""
+        t0 = time.perf_counter()
+        try:
+            return self._query_by_trace_id(group, name, trace_id)
+        finally:
+            _H_QUERY_TRACE.observe((time.perf_counter() - t0) * 1000)
+
+    def _query_by_trace_id(
+        self, group: str, name: str, trace_id: str
+    ) -> list[dict]:
         t = self.get_trace(group, name)
         db = self._tsdb(group)
         shard_num = self.registry.get_group(group).resource_opts.shard_num
@@ -324,6 +339,29 @@ class TraceEngine:
         rewritten by merge gating); cost is one span lookup per
         candidate, bounded by `limit`.
         """
+        t_q0 = time.perf_counter()
+        try:
+            return self._query_ordered(
+                group, name, order_tag, time_range, lo=lo, hi=hi, asc=asc,
+                limit=limit, verify_live=verify_live, with_keys=with_keys,
+            )
+        finally:
+            _H_QUERY_TRACE.observe((time.perf_counter() - t_q0) * 1000)
+
+    def _query_ordered(
+        self,
+        group: str,
+        name: str,
+        order_tag: str,
+        time_range: TimeRange,
+        *,
+        lo: Optional[int] = None,
+        hi: Optional[int] = None,
+        asc: bool = False,
+        limit: int = 20,
+        verify_live: bool = True,
+        with_keys: bool = False,
+    ) -> list:
         import heapq
 
         db = self._tsdb(group)

@@ -1,0 +1,34 @@
+"""Self-observability plane (pkg/query/tracer + pkg/meter analogs).
+
+Three units, all dependency-free so every layer can reach them:
+
+- ``tracer``:  hierarchical in-band query tracing — a ``Tracer`` owns a
+  span tree threaded liaison -> data nodes and merged back into the
+  response (``res.trace["span_tree"]``), with explicit device/host time
+  attribution around jax dispatch and cache-plane hit/miss tags.
+- ``metrics``: the instrument registry (counters, gauges, exponential-
+  bucket histograms with per-instrument handles) behind ``/metrics``
+  and the ``_monitoring`` self-measure sink.
+- ``recorder``: the slow-query flight recorder — a bounded ring buffer
+  of span trees + plan text for queries over the slow threshold,
+  retrievable via ``cli.py slowlog`` and the HTTP gateway.
+
+See docs/observability.md for the span-tree shape and instrument
+naming scheme.
+"""
+
+from banyandb_tpu.obs.metrics import Histogram, Meter, global_meter
+from banyandb_tpu.obs.recorder import SlowQueryRecorder, default_recorder
+from banyandb_tpu.obs.tracer import NOOP_TRACER, Span, Tracer, find_span
+
+__all__ = [
+    "Histogram",
+    "Meter",
+    "NOOP_TRACER",
+    "SlowQueryRecorder",
+    "Span",
+    "Tracer",
+    "default_recorder",
+    "find_span",
+    "global_meter",
+]

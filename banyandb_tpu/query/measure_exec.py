@@ -40,8 +40,16 @@ from banyandb_tpu.api.model import (
     QueryResult,
 )
 from banyandb_tpu.api.schema import Measure, TagType
+from banyandb_tpu.obs import metrics as obs_metrics
 from banyandb_tpu.storage.part import ColumnData
 from banyandb_tpu.utils import hostops
+
+# stage latency instruments (always on, spans or not): the attribution
+# plane ROADMAP item 1's bench reads back as stage_breakdown.  Handles
+# resolved once at import — observe() never touches the registry lock.
+_H_GATHER = obs_metrics.stage_histogram("gather")
+_H_DEVICE = obs_metrics.stage_histogram("device_execute")
+_H_MERGE = obs_metrics.stage_histogram("merge")
 
 CHUNK = 8192
 # Scan chunks are much larger than storage blocks (8192 rows,
@@ -490,12 +498,16 @@ def execute_aggregate(
     sources: list[ColumnData],
     dict_state: Optional[DictState] = None,
     analyzers: Optional[dict] = None,
+    span=None,
 ) -> QueryResult:
     """Run a group-by/aggregate/top-N/percentile query over decoded sources."""
     partial = compute_partials(
-        measure, request, sources, dict_state=dict_state, analyzers=analyzers
+        measure, request, sources, dict_state=dict_state, analyzers=analyzers,
+        span=span,
     )
-    return finalize_partials(measure, request, [partial], dict_state=dict_state)
+    return finalize_partials(
+        measure, request, [partial], dict_state=dict_state, span=span
+    )
 
 
 def compute_partials(
@@ -505,6 +517,7 @@ def compute_partials(
     hist_range: Optional[tuple[float, float]] = None,
     dict_state: Optional[DictState] = None,
     analyzers: Optional[dict] = None,
+    span=None,
 ) -> Partials:
     """The 'map' phase: device scan+reduce over local sources.
 
@@ -516,7 +529,12 @@ def compute_partials(
     persistent global dictionaries, cached per-part remaps, and cached
     gathered chunks keyed by part identities — repeat queries skip the
     whole host gather.
+
+    `span` (obs.tracer.Span or None): tracing sink — gather/reduce child
+    spans with cache hit/miss tags and device/host attribution.  None
+    keeps the path span-free; the stage histograms observe either way.
     """
+    import time as _time
     conds, expr = _lower_criteria(request.criteria)
     group_tags = tuple(request.group_by.tag_names) if request.group_by else ()
     agg = request.agg
@@ -611,13 +629,33 @@ def compute_partials(
             dict_state=dict_state,
         )
 
+    t_gather0 = _time.perf_counter()
+    gather_loaded: list = []  # loader ran -> serving-cache miss
     if gather_key is not None:
         from banyandb_tpu.storage.cache import global_cache
 
-        chunks_np = global_cache().get_or_load(gather_key, _do_gather)
+        def _loader():
+            gather_loaded.append(1)
+            return _do_gather()
+
+        chunks_np = global_cache().get_or_load(gather_key, _loader)
     else:
+        gather_loaded.append(1)
         chunks_np = _do_gather()
+    gather_ms = (_time.perf_counter() - t_gather0) * 1000
+    _H_GATHER.observe(gather_ms)
     n = chunks_np["ts"].shape[0]
+    if span is not None:
+        g = span.child("gather").tag("rows", int(n)).tag(
+            "sources", len(sources)
+        ).tag(
+            "serving_cache",
+            ("off" if gather_key is None else "miss")
+            if gather_loaded
+            else "hit",
+        )
+        g.t0 = t_gather0  # span covers the gather that already ran
+        g.finish()
     # epoch = global min ts keeps chunk-relative int32 offsets
     # nonnegative for the scan-order key; spans >= 2^31 ms (~24.8 days)
     # would wrap the int32 cast, so rep tracking degrades to canonical
@@ -739,18 +777,37 @@ def compute_partials(
             h.hexdigest(),
         )
 
+    rspan = span.child("reduce") if span is not None else None
+    reduce_loaded: list = []
+
     def _reduce() -> Partials:
+        reduce_loaded.append(1)
         return _reduce_partials(
             measure, chunks_np, conds, expr, pred_vals, spec, kernel,
             group_values, rep_tags, rep_desc, want_rep, gd, dict_state,
             hist_lo, hist_span, want_percentile, epoch, gather_key, agg,
+            span=rspan,
         )
 
-    if partials_key is not None:
-        from banyandb_tpu.storage.cache import global_cache
+    try:
+        if partials_key is not None:
+            from banyandb_tpu.storage.cache import global_cache
 
-        return global_cache().get_or_load(partials_key, _reduce)
-    return _reduce()
+            return global_cache().get_or_load(partials_key, _reduce)
+        return _reduce()
+    finally:
+        if rspan is not None:
+            rspan.tag(
+                "partials_cache",
+                ("off" if partials_key is None else "miss")
+                if reduce_loaded
+                else "hit",
+            )
+            if not reduce_loaded:  # replayed: no device leg ran
+                rspan.tag("device_ms", 0.0).tag(
+                    "host_ms", round(rspan.duration_ms, 3)
+                )
+            rspan.finish()
 
 
 def _reduce_partials(
@@ -773,10 +830,19 @@ def _reduce_partials(
     epoch,
     gather_key,
     agg,
+    span=None,
 ):
-    """The reduction tail of compute_partials (cacheable unit)."""
-    import contextlib
+    """The reduction tail of compute_partials (cacheable unit).
 
+    `span` gets the device/host attribution tags: device_ms is the time
+    spent at the two accelerator boundaries (kernel dispatch + the
+    batched device_get), host_ms the rest of the reduction; pad_ship_ms
+    is the prefetch thread's chunk pad+transfer work (overlapped, so it
+    is NOT a subset of the wall duration)."""
+    import contextlib
+    import time as _time
+
+    t_reduce0 = _time.perf_counter()
     n = chunks_np["ts"].shape[0]
     group_tags = spec.group_tags
     radices = spec.radices
@@ -795,10 +861,17 @@ def _reduce_partials(
         except KeyError:
             agg_is_float = False
     if agg_is_float and n:
-        return _host_float_partials(
+        out = _host_float_partials(
             measure, None, chunks_np, conds, expr, pred_vals, spec,
             group_values, rep_tags, rep_desc, want_rep, gd, dict_state,
         )
+        if span is not None:
+            # exact-f64 host reduction: no device leg by design
+            span.tag("path", "host_f64").tag("device_ms", 0.0).tag(
+                "host_ms",
+                round((_time.perf_counter() - t_reduce0) * 1000, 3),
+            )
+        return out
 
     # --- run chunks, combine partials ------------------------------------
     G = spec.num_groups
@@ -862,6 +935,20 @@ def _reduce_partials(
     #     host-sync audit that motivated bdlint).
     from banyandb_tpu.storage.chunk_stream import prefetched
 
+    # pad/ship accumulation crosses into the prefetch worker thread:
+    # plain list appends (GIL-atomic), summed by the owner below — Span
+    # objects themselves are single-owner and never touched off-thread
+    pad_ship_s: list = []
+    chunks_built: list = []
+
+    def _build_chunk(start: int, end: int):
+        t0 = _time.perf_counter()
+        chunks_built.append(1)
+        try:
+            return _device_chunk(chunks_np, start, end, spec, epoch)
+        finally:
+            pad_ship_s.append(_time.perf_counter() - t0)
+
     def _make_chunk(start: int, end: int):
         if dev_cache is not None:
             # Chunks depend only on (gathered data, shape, columns): keep
@@ -877,31 +964,54 @@ def _reduce_partials(
                 spec.fields,
             )
             return dev_cache.get_or_load(
-                ck, lambda: _device_chunk(chunks_np, start, end, spec, epoch)
+                ck, lambda: _build_chunk(start, end)
             )
-        return _device_chunk(chunks_np, start, end, spec, epoch)
+        return _build_chunk(start, end)
 
-    spans = []
+    chunk_spans = []
     for start in range(0, max(n, 1), spec.nrows):
         end = min(start + spec.nrows, n)
         if end <= start:
             break
-        spans.append((start, end))
+        chunk_spans.append((start, end))
 
+    device_s = 0.0  # time at the accelerator boundaries (dispatch + get)
     pending = None
     for chunk in prefetched(
-        [lambda s=s, e=e: _make_chunk(s, e) for s, e in spans],
+        [lambda s=s, e=e: _make_chunk(s, e) for s, e in chunk_spans],
         name="bydb-chunk-prefetch",
     ):
+        t_d = _time.perf_counter()
         out = kernel(chunk, pred_vals, hist_lo_dev, hist_span_dev)
+        device_s += _time.perf_counter() - t_d
         if pending is not None:
+            t_d = _time.perf_counter()
             # bdlint: disable=host-sync -- the result boundary: one
             # batched transfer per chunk, overlapped with dispatch above
-            _absorb(jax.device_get(pending))
+            moved = jax.device_get(pending)
+            device_s += _time.perf_counter() - t_d
+            _absorb(moved)
         pending = out
     if pending is not None:
+        t_d = _time.perf_counter()
         # bdlint: disable=host-sync -- final chunk's result boundary
-        _absorb(jax.device_get(pending))
+        moved = jax.device_get(pending)
+        device_s += _time.perf_counter() - t_d
+        _absorb(moved)
+    _H_DEVICE.observe(device_s * 1000)
+    if span is not None:
+        total_ms = (_time.perf_counter() - t_reduce0) * 1000
+        span.tag("device_ms", round(device_s * 1000, 3)).tag(
+            "host_ms", round(max(total_ms - device_s * 1000, 0.0), 3)
+        ).tag("chunks", len(chunk_spans)).tag(
+            "pad_ship_ms", round(sum(pad_ship_s) * 1000, 3)
+        )
+        if dev_cache is not None:
+            span.tag(
+                "device_cache",
+                f"{len(chunk_spans) - len(chunks_built)} hit / "
+                f"{len(chunks_built)} built",
+            )
 
     # --- dense [G] arrays -> nonempty-group records (codes stay dense
     # int32 rows; value tuples materialize lazily, Partials.groups) -------
@@ -1338,12 +1448,36 @@ def finalize_partials(
     request: QueryRequest,
     partials: list[Partials],
     dict_state: Optional[DictState] = None,
+    span=None,
 ) -> QueryResult:
     """Combine + select + decode: the liaison-side tail of the query.
 
     `dict_state` (standalone fast path only) caches the per-tag rank LUTs
     that vectorize canonical group ordering."""
+    import time as _time
+
+    t_merge0 = _time.perf_counter()
+    mspan = span.child("merge") if span is not None else None
+    try:
+        return _finalize_partials_inner(
+            measure, request, partials, dict_state, mspan
+        )
+    finally:
+        _H_MERGE.observe((_time.perf_counter() - t_merge0) * 1000)
+        if mspan is not None:
+            mspan.tag("partials", len(partials)).finish()
+
+
+def _finalize_partials_inner(
+    measure: Measure,
+    request: QueryRequest,
+    partials: list[Partials],
+    dict_state: Optional[DictState],
+    mspan,
+) -> QueryResult:
     p = combine_partials(partials) if len(partials) != 1 else partials[0]
+    if mspan is not None:
+        mspan.tag("groups", len(p.count) if p.count is not None else 0)
     agg = request.agg
     group_tags = p.group_tags
     count = p.count

@@ -22,7 +22,8 @@ from banyandb_tpu.api.schema import SchemaRegistry
 from banyandb_tpu.cluster import serde
 from banyandb_tpu.cluster.bus import LocalBus, Topic
 from banyandb_tpu.admin.accesslog import AccessLog
-from banyandb_tpu.admin.metrics import Meter, SelfMeasureSink
+from banyandb_tpu.admin.metrics import SelfMeasureSink
+from banyandb_tpu.obs.tracer import attach_tree
 from banyandb_tpu.admin.protector import MemoryProtector
 from banyandb_tpu.cluster.rpc import GrpcBusServer
 from banyandb_tpu.models.measure import MeasureEngine
@@ -36,6 +37,7 @@ TOPIC_REGISTRY = "registry"
 TOPIC_STREAM_QUERY = "stream-query-user"
 TOPIC_SNAPSHOT = "snapshot"
 TOPIC_METRICS = "metrics"
+TOPIC_SLOWLOG = "slowlog"
 from banyandb_tpu.admin.diagnostics import DIAG_TOPIC as TOPIC_DIAGNOSTICS  # noqa: E402
 TOPIC_TOPN = "topn"
 
@@ -85,20 +87,38 @@ class StandaloneServer:
         http_port: int | None = None,
         pprof_port: int | None = None,
         auth_file: str | None = None,
+        slow_query_ms: float | None = None,
     ):
+        from banyandb_tpu.obs import SlowQueryRecorder
+        from banyandb_tpu.obs.metrics import global_meter
+        from banyandb_tpu.utils.envflag import env_float
+
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
         self.measure = MeasureEngine(self.registry, self.root / "data")
         self.stream = StreamEngine(self.registry, self.root / "data")
         self.trace = TraceEngine(self.registry, self.root / "data")
         self.property = PropertyEngine(self.registry, self.root / "data")
-        self.meter = Meter("banyandb")
+        # the process-global registry: engine/executor/fabric instruments
+        # (query stages, rpc, lifecycle loops) land in the same exposition
+        # as the server's own counters
+        self.meter = global_meter()
         self.self_metrics = SelfMeasureSink(self.meter, self.measure)
         self.protector = MemoryProtector()
         from banyandb_tpu.admin.diskmonitor import DiskMonitor
 
         self.disk = DiskMonitor(self.root)
-        self.access_log = AccessLog(self.root / "logs" / "access.log")
+        # slow-query plane: one threshold governs the access log's slow
+        # mark and the flight recorder (server config / BYDB_SLOW_QUERY_MS)
+        if slow_query_ms is None:
+            slow_query_ms = env_float(
+                "BYDB_SLOW_QUERY_MS", AccessLog.DEFAULT_SLOW_QUERY_MS
+            )
+        self.slow_query_ms = slow_query_ms
+        self.slowlog = SlowQueryRecorder()
+        self.access_log = AccessLog(
+            self.root / "logs" / "access.log", slow_query_ms=slow_query_ms
+        )
         # schema docs dogfood the property engine (schemaserver analog);
         # the registry's own JSON files remain as a migration-safe mirror
         from banyandb_tpu.cluster.schema_plane import PropertySchemaStore
@@ -151,7 +171,9 @@ class StandaloneServer:
                     from banyandb_tpu.api.auth import AuthReloader
 
                     http_auth = AuthReloader(auth_file)
-            self.http = HttpGateway(svcs, port=http_port, auth=http_auth)
+            self.http = HttpGateway(
+                svcs, port=http_port, auth=http_auth, slowlog=self.slowlog
+            )
         self.pprof = None
         if pprof_port is not None:
             from banyandb_tpu.admin.profiling import ProfilingServer
@@ -203,6 +225,7 @@ class StandaloneServer:
         b.subscribe(TOPIC_STREAM_QUERY, self._stream_query)
         b.subscribe(TOPIC_SNAPSHOT, self._snapshot)
         b.subscribe(TOPIC_METRICS, self._metrics)
+        b.subscribe(TOPIC_SLOWLOG, self._slowlog)
         b.subscribe(TOPIC_DIAGNOSTICS, self._diagnostics)
         b.subscribe(TOPIC_TOPN, self._topn)
 
@@ -222,10 +245,10 @@ class StandaloneServer:
             n = self.measure.write_points_bulk(req)
         finally:
             self.protector.release(size)
+        ms = (time.perf_counter() - t0) * 1000
         self.meter.counter_add("measure_write_points", n)
-        self.access_log.log_write(
-            req.group, req.name, n, (time.perf_counter() - t0) * 1000
-        )
+        self.meter.observe("write_ms", ms, {"model": "measure"})
+        self.access_log.log_write(req.group, req.name, n, ms)
         return {"written": n}
 
     def _measure_write_columns(self, env):
@@ -277,23 +300,70 @@ class StandaloneServer:
             )
         finally:
             self.protector.release(size)
+        ms = (time.perf_counter() - t0) * 1000
         self.meter.counter_add("measure_write_points", written)
-        self.access_log.log_write(
-            group, name, written, (time.perf_counter() - t0) * 1000
-        )
+        self.meter.observe("write_ms", ms, {"model": "measure"})
+        self.access_log.log_write(group, name, written, ms)
         return {"written": written}
 
     def _measure_query(self, env):
-        req = serde.query_request_from_json(env["request"])
+        from banyandb_tpu.obs import Tracer
+
+        # the server always runs a tracer (a handful of spans per query,
+        # sub-microsecond): slow queries land in the flight recorder with
+        # their full tree whether or not the client asked for trace=true;
+        # the tree only rides the RESPONSE when req.trace is set
+        tracer = Tracer("standalone:measure")
+        with tracer.span("wire_decode"):
+            req = serde.query_request_from_json(env["request"])
         t0 = time.perf_counter()
-        res = self.measure.query(req)
+        res = self.measure.query(req, tracer=tracer)
         ms = (time.perf_counter() - t0) * 1000
+        tree = tracer.finish()
         self.meter.observe("measure_query_ms", ms)
-        self.access_log.log_query(
-            req.groups[0], req.name, ms,
+        self._observe_query(
+            "measure", req, ms,
             rows=len(res.data_points) or len(res.groups),
+            tree=tree, res=res,
         )
+        attach_tree(res, req, tree)
         return {"result": result_to_json(res)}
+
+    def _observe_query(
+        self, engine: str, req, ms: float, *, rows: int, tree: dict,
+        res=None, ql=None,
+    ) -> None:
+        """Shared query epilogue: access log + slow-query flight record
+        (span tree + plan text, bounded ring — cli.py slowlog)."""
+        from banyandb_tpu.obs.recorder import record_slow_query
+
+        group = req.groups[0] if req.groups else ""
+        self.access_log.log_query(group, req.name, ms, ql=ql, rows=rows)
+
+        def render_plan():
+            # post-hoc plan render: slow queries only, never hot
+            from banyandb_tpu.query import logical
+
+            if engine == "measure":
+                m = self.registry.get_measure(group, req.name)
+                return logical.analyze_measure(m, req).explain()
+            if engine == "stream":
+                s = self.registry.get_stream(group, req.name)
+                return logical.analyze_stream(s, req).explain()
+            return None
+
+        record_slow_query(
+            self.slowlog, self.slow_query_ms,
+            engine=engine, group=group, name=req.name,
+            duration_ms=ms, rows=rows, span_tree=tree, ql=ql,
+            plan=(res.trace or {}).get("plan") if res is not None else None,
+            plan_fn=render_plan,
+        )
+
+    def _slowlog(self, env):
+        from banyandb_tpu.obs.recorder import slowlog_topic_reply
+
+        return slowlog_topic_reply(self.slowlog, env, self.slow_query_ms)
 
     def _metrics(self, env):
         self.meter.gauge_set("rss_bytes", _rss())
@@ -355,20 +425,39 @@ class StandaloneServer:
 
     def _stream_write(self, env):
         self.disk.check_write()
+        t0 = time.perf_counter()
         n = self.stream.write(
             env["group"], env["name"], serde.elements_from_json(env["elements"])
+        )
+        self.meter.observe(
+            "write_ms", (time.perf_counter() - t0) * 1000, {"model": "stream"}
         )
         return {"written": n}
 
     def _stream_query(self, env):
+        from banyandb_tpu.obs import Tracer
+
         req = serde.query_request_from_json(env["request"])
-        return {"result": result_to_json(self.stream.query(req))}
+        tracer = Tracer("standalone:stream")
+        t0 = time.perf_counter()
+        res = self.stream.query(req, tracer=tracer)
+        ms = (time.perf_counter() - t0) * 1000
+        tree = tracer.finish()
+        self._observe_query(
+            "stream", req, ms, rows=len(res.data_points), tree=tree, res=res
+        )
+        attach_tree(res, req, tree)
+        return {"result": result_to_json(res)}
 
     def _trace_write(self, env):
         self.disk.check_write()
+        t0 = time.perf_counter()
         n = self.trace.write(
             env["group"], env["name"], serde.spans_from_json(env["spans"]),
             ordered_tags=tuple(env.get("ordered_tags", ())),
+        )
+        self.meter.observe(
+            "write_ms", (time.perf_counter() - t0) * 1000, {"model": "trace"}
         )
         return {"written": n}
 
@@ -402,24 +491,31 @@ class StandaloneServer:
         return {"properties": [{"id": p.id, "tags": p.tags} for p in props]}
 
     def _ql(self, env):
+        from banyandb_tpu.obs import Tracer
+
         catalog, req = bydbql.parse_with_catalog(
             env["ql"], env.get("params", ())
         )
+        tracer = Tracer(f"standalone:{catalog}")
         t0 = time.perf_counter()
         if catalog == "stream":
-            res = self.stream.query(req)
+            res = self.stream.query(req, tracer=tracer)
         elif catalog == "trace":
-            res = self._ql_trace(req)
+            with tracer.span("execute"):
+                res = self._ql_trace(req)
         elif catalog == "property":
-            res = self._ql_property(req)
+            with tracer.span("execute"):
+                res = self._ql_property(req)
         else:
-            res = self.measure.query(req)
-        self.access_log.log_query(
-            req.groups[0], req.name,
-            (time.perf_counter() - t0) * 1000,
-            ql=env["ql"],
+            res = self.measure.query(req, tracer=tracer)
+        ms = (time.perf_counter() - t0) * 1000
+        tree = tracer.finish()
+        self._observe_query(
+            catalog, req, ms,
             rows=len(res.data_points) or len(res.groups),
+            tree=tree, res=res, ql=env["ql"],
         )
+        attach_tree(res, req, tree)
         return {"result": result_to_json(res)}
 
     def _ql_trace(self, req: QueryRequest) -> QueryResult:
@@ -521,6 +617,9 @@ class StandaloneServer:
         )
         self.grpc.start()
         self.watchdog.start()
+        # periodic _monitoring population (the native-meter provider
+        # cadence); thread owned here, joined in stop()
+        self.self_metrics.start()
         if self.wire is not None:
             self.wire.start()
         if self.http is not None:
@@ -548,6 +647,7 @@ class StandaloneServer:
 
         default_registry().shutdown()
         self.measure.stop_lifecycle()
+        self.self_metrics.stop()
         self.watchdog.stop()
         self.grpc.stop()
         if self.wire is not None:
@@ -586,6 +686,11 @@ def build_config():
         "compile-cache-dir", "",
         "persistent XLA compile cache; empty = <root>/compile-cache, "
         "'off' disables", str,
+    )
+    cfg.register(
+        "slow-query-ms", 500.0,
+        "slow-query threshold: queries at/over it get the access-log "
+        "slow mark AND a flight-recorder entry (cli.py slowlog)", float,
     )
     # role topology (pkg/cmdsetup/root.go:89-91 standalone/data/liaison)
     cfg.register("role", "standalone", "standalone | data | liaison", str)
@@ -662,6 +767,7 @@ def main(argv=None) -> None:
             s.root, s.discovery, port=s.port, replicas=s.replicas,
             wire_port=None if s.wire_port < 0 else s.wire_port,
             http_port=None if s.http_port < 0 else s.http_port,
+            slow_query_ms=s.slow_query_ms,
         )
 
         def announce():
@@ -684,6 +790,7 @@ def main(argv=None) -> None:
             wire_port=None if s.wire_port < 0 else s.wire_port,
             http_port=None if s.http_port < 0 else s.http_port,
             pprof_port=None if s.pprof_port < 0 else s.pprof_port,
+            slow_query_ms=s.slow_query_ms,
         )
 
         def announce():

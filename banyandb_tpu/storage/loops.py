@@ -24,7 +24,18 @@ import threading
 import time
 from typing import Callable, Optional
 
+from banyandb_tpu.obs import metrics as obs_metrics
 from banyandb_tpu.storage.tsdb import TSDB
+
+# per-stage lifecycle latency (flush/merge/merge-sweep/retention/
+# rotation), observed in _guard AFTER the stage returns — no instrument
+# lock is ever taken while storage locks are held
+_H_LIFECYCLE: dict[str, obs_metrics.Histogram] = {
+    stage: obs_metrics.global_meter().histogram(
+        "lifecycle_stage_ms", {"stage": stage}
+    )
+    for stage in ("flush", "merge", "merge-sweep", "retention", "rotation")
+}
 
 
 class _RWLock:
@@ -223,12 +234,17 @@ class LifecycleLoops:
 
     # -- threads ------------------------------------------------------------
     def _guard(self, fn: Callable[[], None], name: str) -> None:
+        t0 = time.perf_counter()
         try:
             fn()
         except Exception:  # pragma: no cover - keep the loop alive
             import logging
 
             logging.getLogger(__name__).exception("%s stage failed", name)
+        finally:
+            h = _H_LIFECYCLE.get(name)
+            if h is not None:
+                h.observe((time.perf_counter() - t0) * 1000)
 
     def _flusher(self) -> None:
         while not self._stop.wait(self.flush_interval_s):

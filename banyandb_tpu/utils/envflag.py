@@ -1,9 +1,11 @@
-"""One definition of boolean env-flag parsing.
+"""One definition of BYDB_* env-flag parsing.
 
 Every BYDB_* on/off switch accepts the same spellings; keeping the
 accepted set in one place stops the copies from drifting (the fourth
 hand-rolled ``_ON`` tuple is where "y" silently works in one module and
-not the next).
+not the next).  Numeric flags parse here too, with one shared
+malformed-value policy: fall back to the default instead of crashing a
+server at boot over a typo'd tuning knob.
 """
 
 from __future__ import annotations
@@ -20,3 +22,25 @@ def env_flag(name: str, default: bool = False) -> bool:
     if raw is None:
         return default
     return raw.strip().lower() in _ON
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env flag; unset or malformed -> ``default``."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw.strip())
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer env flag; unset or malformed -> ``default``."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw.strip())
+    except ValueError:
+        return default

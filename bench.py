@@ -403,6 +403,17 @@ def e2e_main() -> None:
             counters_pooled = cache_counters()
             distinct_ms = [run(q) for q in distinct_queries(n_distinct)]
             counters_end = cache_counters()
+            # per-stage attribution scraped from the RUNNING server's
+            # bucketed histograms (obs/prom.py) — gather vs device vs
+            # merge p50/p99 lands in every bench artifact so TPU runs
+            # (ROADMAP item 1) carry the decode/compute split built in
+            from banyandb_tpu.obs import prom as obs_prom
+
+            stage_breakdown = obs_prom.stage_breakdown(
+                tr.call(srv.addr, TOPIC_METRICS, {}, timeout=60.0)[
+                    "prometheus"
+                ]
+            )
         finally:
             tr.close()
             srv.stop()
@@ -445,6 +456,7 @@ def e2e_main() -> None:
                         "after_pooled_warm": counters_pooled,
                         "after_distinct": counters_end,
                     },
+                    "stage_breakdown": stage_breakdown,
                 }
             )
         )

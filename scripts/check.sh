@@ -31,6 +31,12 @@ echo "== sanitize smoke (bdsan) =="
 # leak caught (docs/sanitizers.md)
 env JAX_PLATFORMS=cpu BYDB_SANITIZE=1 python scripts/sanitize_smoke.py || fail=1
 
+echo "== obs smoke =="
+# 2-node traced distributed query: ONE merged span tree with per-node
+# subtrees + device/host attribution, trace on/off result parity,
+# bucketed stage histograms on /metrics (docs/observability.md)
+env JAX_PLATFORMS=cpu python scripts/obs_smoke.py || fail=1
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== tier-1 tests (ROADMAP.md, BYDB_SANITIZE=1 via conftest) =="
     rm -f /tmp/_t1.log

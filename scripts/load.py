@@ -101,6 +101,10 @@ def run_load(
         probe = GrpcTransport()
         try:
             stats["serving_cache"] = _serving_cache_stats(probe, addr)
+            # per-stage attribution (gather / device_execute / merge
+            # p50/p99) from the server's bucketed histograms, same
+            # scraper the bench artifact uses (obs/prom.py)
+            stats["stage_breakdown"] = _stage_breakdown(probe, addr)
         finally:
             probe.close()
         return stats
@@ -130,6 +134,18 @@ def _serving_cache_stats(transport, addr: str) -> dict:
         round(out.get("hits", 0) / lookups, 4) if lookups else 0.0
     )
     return out
+
+
+def _stage_breakdown(transport, addr: str) -> dict:
+    """Stage latency quantiles recovered from the live exposition's
+    _bucket series (docs/observability.md instrument scheme)."""
+    from banyandb_tpu.obs import prom as obs_prom
+    from banyandb_tpu.server import TOPIC_METRICS
+
+    text = transport.call(addr, TOPIC_METRICS, {}, timeout=30.0).get(
+        "prometheus", ""
+    )
+    return obs_prom.stage_breakdown(text)
 
 
 def _drive_load(

@@ -1,0 +1,164 @@
+"""Fast CPU-only observability smoke (scripts/check.sh, both modes + CI).
+
+Proves, on a 2-node in-process cluster in seconds, the self-observability
+plane's end-to-end invariants (docs/observability.md):
+
+1. a trace=true distributed measure query returns ONE merged span tree
+   containing >= 2 per-node subtrees, each with nonzero device_ms /
+   host_ms attribution and cache hit/miss tags;
+2. tracing off returns byte-identical results (JSON form) to tracing on;
+3. /metrics exposition carries bucketed (`_bucket`) latency histograms
+   for at least the gather, device_execute and merge stages, and the
+   scraped stage_breakdown (obs/prom.py) recovers nonzero quantiles.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable as `python scripts/obs_smoke.py` from the repo root or CI
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = 1_700_000_000_000
+
+
+def main() -> int:
+    from pathlib import Path
+
+    from banyandb_tpu.api import (
+        Catalog,
+        DataPointValue,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        GroupBy,
+        Measure,
+        QueryRequest,
+        ResourceOpts,
+        SchemaRegistry,
+        TagSpec,
+        TagType,
+        TimeRange,
+        WriteRequest,
+    )
+    from banyandb_tpu.api.model import Aggregation
+    from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo
+    from banyandb_tpu.cluster.rpc import LocalTransport
+    from banyandb_tpu.obs import find_span, global_meter
+    from banyandb_tpu.obs import prom as obs_prom
+    from banyandb_tpu.obs.tracer import iter_spans
+    from banyandb_tpu.server import result_to_json
+
+    root = Path(tempfile.mkdtemp(prefix="bydb-obs-smoke-"))
+
+    def schema(reg):
+        reg.create_group(
+            Group("g", Catalog.MEASURE, ResourceOpts(shard_num=4))
+        )
+        # INT field: sum aggregates ride the DEVICE kernel path (floats
+        # take the exact-f64 host path, which has no device leg to time)
+        reg.create_measure(
+            Measure(
+                group="g", name="m",
+                tags=(TagSpec("svc", TagType.STRING),),
+                fields=(FieldSpec("v", FieldType.INT),),
+                entity=Entity(("svc",)),
+            )
+        )
+
+    transport = LocalTransport()
+    nodes, datanodes = [], []
+    for i in range(2):
+        reg = SchemaRegistry(root / f"node{i}")
+        schema(reg)
+        dn = DataNode(f"data-{i}", reg, root / f"node{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+        datanodes.append(dn)
+    liaison_reg = SchemaRegistry(root / "liaison")
+    schema(liaison_reg)
+    liaison = Liaison(liaison_reg, transport, nodes)
+
+    points = tuple(
+        DataPointValue(
+            T0 + i, {"svc": f"svc-{i % 16}"}, {"v": (i * 7) % 100}, version=1
+        )
+        for i in range(4000)
+    )
+    liaison.write_measure(WriteRequest("g", "m", points))
+    for dn in datanodes:
+        dn.measure.flush()
+
+    req = QueryRequest(
+        ("g",), "m", TimeRange(T0, T0 + 10_000),
+        group_by=GroupBy(("svc",)), agg=Aggregation("sum", "v"),
+        trace=True, limit=100,
+    )
+    res = liaison.query_measure(req)
+    tree = (res.trace or {}).get("span_tree")
+    assert tree, "trace=true must attach a merged span_tree"
+
+    # -- 1: merged tree with per-node subtrees + attribution tags ---------
+    subtrees = [
+        s for s in iter_spans(tree) if str(s.get("name", "")).startswith("data:")
+    ]
+    assert len(subtrees) >= 2, (
+        f"expected >= 2 node subtrees, got {[s['name'] for s in subtrees]}"
+    )
+    for st in subtrees:
+        reduce_span = find_span(st, "reduce")
+        assert reduce_span is not None, f"{st['name']}: no reduce span"
+        tags = reduce_span["tags"]
+        assert tags.get("device_ms", 0) > 0, f"{st['name']}: device_ms {tags}"
+        assert tags.get("host_ms", 0) > 0, f"{st['name']}: host_ms {tags}"
+        assert "partials_cache" in tags, f"{st['name']}: cache tag {tags}"
+        gather_span = find_span(st, "gather")
+        assert gather_span is not None and "serving_cache" in gather_span["tags"], (
+            f"{st['name']}: gather cache tag missing"
+        )
+    assert find_span(tree, "merge") is not None, "liaison merge span missing"
+    print(
+        f"# merged tree: {len(subtrees)} node subtrees, "
+        f"root {tree['duration_ms']}ms"
+    )
+
+    # -- 2: byte-identical results, tracing on vs off ----------------------
+    import dataclasses
+    import json
+
+    res_off = liaison.query_measure(dataclasses.replace(req, trace=False))
+    j_on = result_to_json(res)
+    j_on.pop("trace", None)
+    j_off = result_to_json(res_off)
+    j_off.pop("trace", None)
+    b_on, b_off = json.dumps(j_on, sort_keys=True), json.dumps(j_off, sort_keys=True)
+    assert b_on == b_off, "results differ with tracing on vs off"
+    print(f"# parity: {len(b_on)} result bytes identical with trace on/off")
+
+    # -- 3: bucketed stage histograms on the exposition --------------------
+    text = global_meter().prometheus_text()
+    for stage in ("gather", "device_execute", "merge"):
+        needle = f'banyandb_query_stage_ms_bucket{{stage="{stage}"'
+        assert needle in text, f"no _bucket series for stage {stage}"
+    breakdown = obs_prom.stage_breakdown(text)
+    for stage in ("gather", "device_execute", "merge"):
+        rec = breakdown.get(stage)
+        assert rec and rec["count"] > 0, f"stage_breakdown missing {stage}"
+        assert rec["p50_ms"] > 0, f"{stage} p50 is zero: {rec}"
+    print(f"# stage_breakdown: {breakdown}")
+    print("obs_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except AssertionError as e:
+        print(f"obs_smoke: FAILED: {e}", file=sys.stderr)
+        raise SystemExit(1) from e
