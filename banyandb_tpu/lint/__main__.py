@@ -5,9 +5,14 @@ with findings — the editor/exploration mode); ``--check`` is the CI
 gate (exit 1 on any finding); 2 on usage error.
 
 ``--check`` also runs the whole-program analyses (layering, call-graph
-sync/lock propagation, lock-order cycles, eval_shape plan audit) when a
-target path is — or contains — the real ``banyandb_tpu`` package;
-``--whole-program`` runs them report-only without the gate.
+sync/lock propagation, lock-order cycles, eval_shape plan audit, the
+bdjit kernel audit) when a target path is — or contains — the real
+``banyandb_tpu`` package; ``--whole-program`` runs them report-only
+without the gate.  ``--only=FAMILY,...`` restricts the run to named
+analyzer families (``rules`` = the per-file rules, plus ``kernel``,
+``layering``, ``shared-state``, ``lock-order``, ``plan-audit``,
+``sync``) so local iteration does not pay the full whole-program pass;
+``--fast`` skips the kernel lowering-audit (the XLA-compile half).
 """
 
 from __future__ import annotations
@@ -60,7 +65,21 @@ def main(argv: list[str] | None = None) -> int:
         "--whole-program",
         action="store_true",
         help="run the whole-program analyses (layering, call-graph facts, "
-        "lock-order, plan audit) report-only",
+        "lock-order, plan audit, kernel audit) report-only",
+    )
+    ap.add_argument(
+        "--only",
+        default="",
+        help="comma-separated analyzer families to run: rules, kernel, "
+        "layering, shared-state, lock-order, plan-audit, sync "
+        "(default: all; implies running the named whole-program "
+        "analyses)",
+    )
+    ap.add_argument(
+        "--fast",
+        action="store_true",
+        help="skip the kernel lowering-audit (XLA compiles; jaxpr + "
+        "dispatch budgets still run)",
     )
     ap.add_argument(
         "--format",
@@ -78,7 +97,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = ap.parse_args(argv)
 
-    from banyandb_tpu.lint.whole_program import WP_RULES
+    from banyandb_tpu.lint.whole_program import (
+        FAMILIES,
+        WP_RULES,
+        family_of_rule,
+    )
 
     rules = all_rules()
     if args.list_rules:
@@ -88,6 +111,20 @@ def main(argv: list[str] | None = None) -> int:
         for name, summary in WP_RULES:
             print(f"{name:18s} [whole-program] {summary}")
         return 0
+
+    only: Optional[set] = None
+    if args.only:
+        only = {n.strip() for n in args.only.split(",") if n.strip()}
+        known_families = set(FAMILIES) | {"rules"}
+        unknown = only - known_families
+        if unknown:
+            print(
+                f"bdlint: unknown --only famil{'y' if len(unknown) == 1 else 'ies'}:"
+                f" {sorted(unknown)} (choose from {sorted(known_families)})",
+                file=sys.stderr,
+            )
+            return 2
+
     wanted = None
     if args.rules:
         wanted = {n.strip() for n in args.rules.split(",") if n.strip()}
@@ -98,26 +135,56 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         rules = [r for r in rules if r.name in wanted]
 
-    findings, summary = lint_paths(args.paths, rules=rules)
+    run_file_rules = only is None or "rules" in only
+    if run_file_rules:
+        findings, summary = lint_paths(args.paths, rules=rules)
+    else:
+        findings, summary = [], {"files": 0, "findings": 0, "suppressed": 0}
 
     wp_root = _find_pkg_root(args.paths)
     wp_names = {n for n, _ in WP_RULES}
-    # naming a whole-program rule via --rules implies running the
-    # whole-program analyses even without --check/--whole-program — a
-    # rule the user asked for by name must never silently not run
+    # naming a whole-program rule via --rules (or a family via --only)
+    # implies running those analyses even without --check/--whole-program
+    # — an analysis the user asked for by name must never silently not run
+    wp_only: Optional[set] = None
+    if only is not None:
+        wp_only = only & set(FAMILIES)
+    if wanted is not None:
+        from_rules = {
+            fam
+            for fam in (family_of_rule(n) for n in wanted)
+            if fam is not None
+        }
+        wp_only = from_rules if wp_only is None else (wp_only & from_rules)
     run_wp = (
         args.check
         or args.whole_program
         or (wanted is not None and bool(wanted & wp_names))
+        or (only is not None and bool(only & set(FAMILIES)))
     ) and wp_root is not None
-    if wanted is not None and not (wanted & wp_names):
+    if wp_only is not None and not wp_only:
         run_wp = False
+    # a selection that excludes EVERY analyzer is a usage error, not a
+    # green gate: --check must never exit 0 having checked nothing
+    # (e.g. --only=kernel --rules=host-sync, or --only=rules
+    # --rules=layering)
+    file_rules_vacuous = not run_file_rules or (
+        wanted is not None and not rules
+    )
+    if (args.rules or args.only) and file_rules_vacuous and not run_wp:
+        print(
+            "bdlint: the --only/--rules selection excludes every analyzer "
+            "(nothing would run); drop one flag or align them",
+            file=sys.stderr,
+        )
+        return 2
     if run_wp:
         from banyandb_tpu.lint.whole_program import run_whole_program
 
         wp_findings, wp_stats = run_whole_program(
             wp_root,
-            plan_audit=(wanted is None or "plan-audit" in wanted),
+            only=wp_only,
+            fast=args.fast,
         )
         if wanted is not None:
             wp_findings = [f for f in wp_findings if f.rule in wanted]

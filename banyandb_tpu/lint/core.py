@@ -136,6 +136,98 @@ def parse_suppressions(lines: list[str]) -> tuple[dict[int, frozenset], frozense
     )
 
 
+# -- ratchet-baseline mechanics ----------------------------------------------
+# Shared by every baselined analyzer (layering, wp-shared-state, the
+# kernel budget table): a baselined violation that still exists is
+# tolerated, a new violation fails, and a baselined entry whose
+# violation disappeared fails as STALE — so the checked-in list only
+# ever shrinks/tightens, never silently rots.
+
+
+def stale_entry_finding(
+    key: str,
+    *,
+    rule: str,
+    path: str,
+    what: str = "the violation",
+    line: int = 1,
+) -> Finding:
+    """The stale half of the ratchet, one message shape for every
+    baselined analyzer (tests grep for "stale baseline")."""
+    return Finding(
+        path=path,
+        line=line,
+        col=0,
+        rule=rule,
+        message=(
+            f"stale baseline entry `{key}`: {what} no longer exists — "
+            "delete it so the ratchet only tightens"
+        ),
+    )
+
+
+def apply_ratchet(
+    violations: list[tuple[str, Finding]],
+    baseline: frozenset,
+    *,
+    rule: str,
+    baseline_path: str,
+    what: str = "the violation",
+) -> list[Finding]:
+    """Set-membership ratchet over ``(key, finding)`` live violations:
+    baselined keys are tolerated, unknown keys pass through as findings,
+    and baseline entries with no live violation fail as stale."""
+    findings = [f for key, f in violations if key not in baseline]
+    seen = {key for key, _ in violations if key in baseline}
+    for key in sorted(baseline - seen):
+        findings.append(
+            stale_entry_finding(
+                key, rule=rule, path=baseline_path, what=what
+            )
+        )
+    return findings
+
+
+def ratchet_value(
+    key: str,
+    column: str,
+    measured: float,
+    budget: float,
+    *,
+    rule: str,
+    path: str,
+    line: int = 1,
+    budget_path: str = "",
+    regression_hint: str = "",
+) -> list[Finding]:
+    """Numeric-budget ratchet: measured above budget is a regression,
+    measured below budget is a stale (too-loose) entry that must be
+    tightened, equal is clean.  The kernel budget table's contract."""
+    if measured == budget:
+        return []
+    if measured > budget:
+        msg = (
+            f"[{key}] {column} regression: measured {measured:g} exceeds "
+            f"the budgeted {budget:g}"
+        )
+        if regression_hint:
+            msg += f"; {regression_hint}"
+        return [Finding(path=path, line=line, col=0, rule=rule, message=msg)]
+    return [
+        Finding(
+            path=budget_path or path,
+            line=1 if budget_path else line,
+            col=0,
+            rule=rule,
+            message=(
+                f"[{key}] stale budget entry: {column} measured "
+                f"{measured:g} is below the budgeted {budget:g} — tighten "
+                "the entry so the ratchet keeps the improvement"
+            ),
+        )
+    ]
+
+
 def _package_rel(path: Path) -> Optional[str]:
     """Path inside the banyandb_tpu package -> package-relative posix
     path; None for files outside the package (bdlint is project-native

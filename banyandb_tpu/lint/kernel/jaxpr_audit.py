@@ -1,0 +1,208 @@
+"""kernel-jaxpr: walk each audited kernel's closed jaxpr.
+
+What the eval_shape plan audit cannot see — it only checks the *output*
+pytree — this pass checks the program text in between, with zero device
+execution (``jax.make_jaxpr`` is a pure trace):
+
+- **host callbacks**: ``pure_callback`` / ``io_callback`` /
+  ``debug_callback`` inside a device plan force a host round-trip per
+  invocation; a kernel on the query hot path must never carry one.
+- **64-bit dtypes**: any equation producing f64/i64/u64 doubles HBM
+  traffic and breaks the f32-partials / f64-host-merge precision
+  contract *internally*, even if the outputs stay 32-bit (the exact
+  failure the exact-integer-aggregation work, ROADMAP item 5c, must not
+  reintroduce by accident).
+- **narrowing conversions**: ``convert_element_type`` from f32 down to
+  f16/bf16 silently truncates an accumulator's mantissa — the
+  per-group sums would drift beyond the pinned 1e-5 bound.
+- **non-donated aliasing buffers**: an output whose aval exactly matches
+  a large input's and is not donated costs a second HBM allocation per
+  dispatch; the jit should mark the input in ``donate_argnums``.
+
+``audit_entry`` also reports the widest dtype itemsize seen anywhere in
+the jaxpr — the measurement the kernel budget table's ``widest`` column
+ratchets (kernel_budgets.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from banyandb_tpu.lint.core import Finding
+
+RULE = "kernel-jaxpr"
+
+_HOST_CALLBACK_PRIMS = {
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "debug_print",
+}
+
+# f32 -> any of these narrows an accumulator's mantissa
+_NARROW_FLOATS = {"float16", "bfloat16", "float8_e4m3fn", "float8_e5m2"}
+
+# an output aliasing an input at or above this many bytes should be
+# donated (below it the copy is noise)
+_DONATE_BYTES = 1 << 16
+
+
+def iter_eqns(jaxpr) -> Iterable[tuple[int, object]]:
+    """Depth-first (index, eqn) over a jaxpr and every sub-jaxpr carried
+    in its equation params (pjit bodies, scan/while/cond branches)."""
+    idx = 0
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            yield idx, eqn
+            idx += 1
+            for v in eqn.params.values():
+                for sub in _sub_jaxprs(v):
+                    stack.append(sub)
+
+
+def _sub_jaxprs(v):
+    if hasattr(v, "eqns"):
+        return [v]
+    if hasattr(v, "jaxpr"):  # ClosedJaxpr
+        return [v.jaxpr]
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            out.extend(_sub_jaxprs(x))
+        return out
+    return []
+
+
+def _aval_dtype(var):
+    aval = getattr(var, "aval", None)
+    return getattr(aval, "dtype", None)
+
+
+def make_entry_jaxpr(entry):
+    """Closed jaxpr of one audit-matrix entry (pure trace, no device)."""
+    import jax
+
+    return jax.make_jaxpr(entry.fn)(*entry.args, **entry.kwargs)
+
+
+def audit_entry(entry) -> tuple[list[Finding], int]:
+    """-> (findings, widest dtype itemsize seen in the jaxpr)."""
+    findings: list[Finding] = []
+
+    def hit(message: str) -> None:
+        findings.append(
+            Finding(
+                path=entry.path,
+                line=entry.line,
+                col=0,
+                rule=RULE,
+                message=f"[{entry.name}] {message}",
+            )
+        )
+
+    try:
+        closed = make_entry_jaxpr(entry)
+    except Exception as e:  # noqa: BLE001 — plan-audit reports trace errors
+        hit(f"jaxpr trace failed: {type(e).__name__}: {e}")
+        return findings, 0
+
+    widest = 1
+    wide_hits: set[str] = set()
+    for idx, eqn in iter_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        if prim in _HOST_CALLBACK_PRIMS:
+            hit(
+                f"host callback `{prim}` at jaxpr eqn #{idx}: a device "
+                "plan must not round-trip to the host per invocation; "
+                "lift the callback out of the kernel"
+            )
+        if prim == "convert_element_type":
+            src = _aval_dtype(eqn.invars[0])
+            dst = eqn.params.get("new_dtype")
+            if (
+                src is not None
+                and dst is not None
+                and str(src) == "float32"
+                and str(dst) in _NARROW_FLOATS
+            ):
+                hit(
+                    f"accumulator narrowed at jaxpr eqn #{idx}: "
+                    f"convert_element_type float32 -> {dst} truncates "
+                    "the mantissa; partial sums must stay f32 on device"
+                )
+        for ov in eqn.outvars:
+            dt = _aval_dtype(ov)
+            if dt is None:
+                continue
+            widest = max(widest, dt.itemsize)
+            if dt.itemsize >= 8 and str(dt) not in wide_hits:
+                wide_hits.add(str(dt))
+                hit(
+                    f"64-bit dtype `{dt}` produced at jaxpr eqn #{idx} "
+                    f"(`{prim}`): 64-bit values double HBM traffic and "
+                    "break the f32-partials/f64-host-merge precision "
+                    "contract; keep device math 32-bit"
+                )
+
+    findings += _donation_findings(entry, closed)
+    return findings, widest
+
+
+def _donation_findings(entry, closed) -> list[Finding]:
+    """Large output aliasing an input aval without donation.
+
+    The alias candidate test is structural (same shape+dtype, >= the
+    donate threshold); only when a candidate exists do we pay a lowering
+    to read the authoritative donated flags from ``args_info``.
+    """
+    import jax
+    import numpy as np
+
+    in_avals = [v.aval for v in closed.jaxpr.invars]
+    out_avals = [v.aval for v in closed.jaxpr.outvars]
+
+    def nbytes(aval) -> int:
+        if not hasattr(aval, "dtype"):
+            return 0
+        return int(np.prod(aval.shape or (1,))) * aval.dtype.itemsize
+
+    candidates = []
+    in_keys = {
+        (tuple(a.shape), str(a.dtype))
+        for a in in_avals
+        if hasattr(a, "dtype") and nbytes(a) >= _DONATE_BYTES
+    }
+    for a in out_avals:
+        if not hasattr(a, "dtype") or nbytes(a) < _DONATE_BYTES:
+            continue
+        if (tuple(a.shape), str(a.dtype)) in in_keys:
+            candidates.append(a)
+    if not candidates:
+        return []
+
+    fn = entry.fn if hasattr(entry.fn, "lower") else jax.jit(entry.fn)
+    try:
+        lowered = fn.lower(*entry.args, **entry.kwargs)
+        args_info = jax.tree_util.tree_leaves(lowered.args_info)
+        any_donated = any(getattr(i, "donated", False) for i in args_info)
+    except Exception:  # noqa: BLE001 — lowering trouble is not a donation bug
+        return []
+    if any_donated:
+        return []
+    return [
+        Finding(
+            path=entry.path,
+            line=entry.line,
+            col=0,
+            rule=RULE,
+            message=(
+                f"[{entry.name}] output {tuple(candidates[0].shape)}"
+                f"/{candidates[0].dtype} aliases an input buffer of "
+                f">= {_DONATE_BYTES} bytes but no argument is donated; "
+                "pass donate_argnums so XLA reuses the input allocation "
+                "instead of doubling HBM for the output"
+            ),
+        )
+    ]

@@ -28,6 +28,10 @@ Four analyzers, all surfaced through ``python -m banyandb_tpu.lint``
                       of representative plan shapes: dtype promotion,
                       shape mismatch and retrace hazards, zero device
                       execution
+- ``kernel-*``        the bdjit kernel audit family (lint/kernel):
+                      jaxpr walk, stub-device dispatch/transfer counts,
+                      CPU lowering facts, and the ratcheted
+                      per-signature budget table (kernel_budgets.py)
 
 Findings reuse bdlint's Finding/suppression machinery: a whole-program
 finding anchors at a real source line and honors the same
@@ -37,11 +41,15 @@ finding anchors at a real source line and honors the same
 from __future__ import annotations
 
 from pathlib import Path
+from typing import Optional
 
 from banyandb_tpu.lint.core import Finding, parse_suppressions
 
 # (name, summary) catalog for --list-rules; checks live in the sibling
-# modules, not in per-file rule objects.
+# modules, not in per-file rule objects.  The kernel-audit family
+# (lint/kernel, "bdjit") rides the same surface.
+from banyandb_tpu.lint.kernel import KERNEL_RULES
+
 WP_RULES = (
     ("layering", "import respects the SURVEY L0-L6 layer map"),
     ("wp-sync-in-jit", "transitive host sync/block inside a jit region"),
@@ -49,7 +57,7 @@ WP_RULES = (
     ("lock-order", "potential deadlock cycle in the lock-order graph"),
     ("wp-shared-state", "attribute written from >=2 thread roots unguarded"),
     ("plan-audit", "eval_shape plan matrix: dtype/shape/retrace hazards"),
-)
+) + KERNEL_RULES
 
 
 def apply_suppressions(
@@ -81,61 +89,119 @@ def apply_suppressions(
     return kept, suppressed
 
 
+# --only analyzer families -> the rules each family emits
+FAMILIES = {
+    "layering": ("layering",),
+    "sync": ("wp-sync-in-jit", "wp-lock-blocking"),
+    "lock-order": ("lock-order",),
+    "shared-state": ("wp-shared-state",),
+    "plan-audit": ("plan-audit",),
+    "kernel": (
+        "kernel-jaxpr",
+        "kernel-dispatch",
+        "kernel-lowering",
+        "kernel-budget",
+    ),
+}
+
+
+def family_of_rule(rule: str) -> Optional[str]:
+    for fam, rules in FAMILIES.items():
+        if rule in rules:
+            return fam
+    return None
+
+
 def run_whole_program(
-    pkg_root: Path, plan_audit: bool = True
+    pkg_root: Path,
+    plan_audit: bool = True,
+    only: Optional[set] = None,
+    fast: bool = False,
 ) -> tuple[list[Finding], dict]:
-    """Run every whole-program analyzer against the banyandb_tpu package
-    rooted at ``pkg_root`` -> (findings after suppressions, stats)."""
+    """Run the whole-program analyzers against the banyandb_tpu package
+    rooted at ``pkg_root`` -> (findings after suppressions, stats).
+
+    ``only`` (family names from FAMILIES) restricts which analyzers run
+    — the CLI's ``--only`` so local iteration pays only the pass under
+    edit; None = everything.  ``plan_audit=False`` opts out of BOTH
+    jax-backed families (plan audit and the kernel audit) — the legacy
+    "AST analyses only" switch the meta-tests use.  ``fast`` skips the
+    kernel lowering-audit (the XLA-compile half of the kernel family).
+    """
     from banyandb_tpu.lint.whole_program import layer_config
-    from banyandb_tpu.lint.whole_program.callgraph import (
-        Program,
-        analyze_lock_blocking,
-        analyze_sync_in_jit,
-    )
-    from banyandb_tpu.lint.whole_program.layers import (
-        analyze_layers,
-        parse_package,
-    )
-    from banyandb_tpu.lint.whole_program.lockorder import analyze_lock_order
-    from banyandb_tpu.lint.whole_program.shared_state import (
-        BASELINE as SHARED_STATE_BASELINE,
-    )
-    from banyandb_tpu.lint.whole_program.shared_state import (
-        analyze_shared_state,
-        discover_roots,
+    from banyandb_tpu.lint.whole_program.layers import parse_package
+
+    def want(fam: str) -> bool:
+        return only is None or fam in only
+
+    findings: list[Finding] = []
+    stats = {"wp_functions": 0, "wp_roots": 0}
+    need_program = any(want(f) for f in ("sync", "lock-order", "shared-state"))
+    trees = (
+        parse_package(pkg_root, layer_config.PACKAGE)
+        if need_program or want("layering")
+        else None
     )
 
-    trees = parse_package(pkg_root, layer_config.PACKAGE)
-    findings: list[Finding] = []
-    findings += analyze_layers(
-        pkg_root,
-        layer_config.PACKAGE,
-        layer_config.CONFIG,
-        baseline=layer_config.BASELINE,
-        trees=trees,
-    )
-    program = Program.build(pkg_root, layer_config.PACKAGE, trees=trees)
-    findings += analyze_sync_in_jit(program)
-    findings += analyze_lock_blocking(program)
-    findings += analyze_lock_order(program)
-    roots = discover_roots(program)
-    findings += analyze_shared_state(
-        program,
-        baseline=SHARED_STATE_BASELINE,
-        baseline_path=str(
-            pkg_root / "lint" / "whole_program" / "shared_state.py"
-        ),
-        roots=roots,
-    )
-    if plan_audit:
+    if want("layering"):
+        from banyandb_tpu.lint.whole_program.layers import analyze_layers
+
+        findings += analyze_layers(
+            pkg_root,
+            layer_config.PACKAGE,
+            layer_config.CONFIG,
+            baseline=layer_config.BASELINE,
+            trees=trees,
+        )
+    if need_program:
+        from banyandb_tpu.lint.whole_program.callgraph import (
+            Program,
+            analyze_lock_blocking,
+            analyze_sync_in_jit,
+        )
+
+        program = Program.build(pkg_root, layer_config.PACKAGE, trees=trees)
+        stats["wp_functions"] = len(program.functions)
+        if want("sync"):
+            findings += analyze_sync_in_jit(program)
+            findings += analyze_lock_blocking(program)
+        if want("lock-order"):
+            from banyandb_tpu.lint.whole_program.lockorder import (
+                analyze_lock_order,
+            )
+
+            findings += analyze_lock_order(program)
+        if want("shared-state"):
+            from banyandb_tpu.lint.whole_program.shared_state import (
+                BASELINE as SHARED_STATE_BASELINE,
+            )
+            from banyandb_tpu.lint.whole_program.shared_state import (
+                analyze_shared_state,
+                discover_roots,
+            )
+
+            roots = discover_roots(program)
+            stats["wp_roots"] = len(roots)
+            findings += analyze_shared_state(
+                program,
+                baseline=SHARED_STATE_BASELINE,
+                baseline_path=str(
+                    pkg_root / "lint" / "whole_program" / "shared_state.py"
+                ),
+                roots=roots,
+            )
+    if plan_audit and want("plan-audit"):
         from banyandb_tpu.lint.whole_program.plan_audit import run_plan_audit
 
         findings += run_plan_audit()
+    if plan_audit and want("kernel"):
+        from banyandb_tpu.lint.kernel import kernel_stats, run_kernel_audit
+
+        findings += run_kernel_audit(fast=fast)
+        stats.update(kernel_stats(fast=fast))
     findings, suppressed = apply_suppressions(findings)
     findings.sort()
-    return findings, {
-        "wp_findings": len(findings),
-        "wp_suppressed": suppressed,
-        "wp_functions": len(program.functions),
-        "wp_roots": len(roots),
-    }
+    stats.update(
+        {"wp_findings": len(findings), "wp_suppressed": suppressed}
+    )
+    return findings, stats

@@ -25,7 +25,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Iterable, Optional
 
-from banyandb_tpu.lint.core import Finding
+from banyandb_tpu.lint.core import Finding, apply_ratchet
 
 RULE = "layering"
 
@@ -210,7 +210,7 @@ def analyze_layers(
     edges, names = scan_import_edges(pkg_root, pkgname, trees)
     module_paths = {mod: path for mod, (path, _tree) in trees.items()}
     findings: list[Finding] = []
-    seen_baselined: set[str] = set()
+    violations: list[tuple[str, Finding]] = []
     height = {layer: i for i, layer in enumerate(config.layers)}
 
     for mod in sorted(names):
@@ -235,42 +235,35 @@ def analyze_layers(
             continue  # unknown modules already reported above
         if config.allowed(src_layer, dst_layer):
             continue
-        key = f"{e.src} -> {e.dst}"
-        if key in baseline:
-            seen_baselined.add(key)
-            continue
         kind = (
             "upward"
             if height[dst_layer] > height[src_layer]
             else "skip-layer"
         )
-        findings.append(
-            Finding(
-                path=e.path,
-                line=e.line,
-                col=e.col,
-                rule=RULE,
-                message=(
-                    f"{kind} import: `{e.src}` ({src_layer}) must not "
-                    f"import `{e.dst}` ({dst_layer}); invert the "
-                    "dependency, move the shared piece down a layer, or "
-                    "use a function-local lazy import at the boundary"
+        violations.append(
+            (
+                f"{e.src} -> {e.dst}",
+                Finding(
+                    path=e.path,
+                    line=e.line,
+                    col=e.col,
+                    rule=RULE,
+                    message=(
+                        f"{kind} import: `{e.src}` ({src_layer}) must not "
+                        f"import `{e.dst}` ({dst_layer}); invert the "
+                        "dependency, move the shared piece down a layer, or "
+                        "use a function-local lazy import at the boundary"
+                    ),
                 ),
             )
         )
 
-    for key in sorted(baseline - seen_baselined):
-        findings.append(
-            Finding(
-                path=str(pkg_root / "lint" / "whole_program" / "layer_config.py"),
-                line=1,
-                col=0,
-                rule=RULE,
-                message=(
-                    f"stale baseline entry `{key}`: the violation no "
-                    "longer exists — delete it so the ratchet only "
-                    "tightens"
-                ),
-            )
-        )
+    findings += apply_ratchet(
+        violations,
+        baseline,
+        rule=RULE,
+        baseline_path=str(
+            pkg_root / "lint" / "whole_program" / "layer_config.py"
+        ),
+    )
     return findings

@@ -43,7 +43,7 @@ import ast
 from dataclasses import dataclass
 from typing import Iterable, Optional
 
-from banyandb_tpu.lint.core import Finding
+from banyandb_tpu.lint.core import Finding, apply_ratchet
 from banyandb_tpu.lint.whole_program.callgraph import (
     FuncInfo,
     Program,
@@ -351,8 +351,7 @@ def analyze_shared_state(
                 else:
                     rec[root.qual] = (_witness(parents, qual), [guards], a)
 
-    findings: list[Finding] = []
-    seen_baselined: set[str] = set()
+    violations: list[tuple[str, Finding]] = []
     for attr in sorted(writes):
         rec = writes[attr]
         if len(rec) < 2:
@@ -363,9 +362,6 @@ def analyze_shared_state(
                 common = g if common is None else (common & g)
         if common:
             continue
-        if attr in baseline:
-            seen_baselined.add(attr)
-            continue
         anchor = min(
             (a for _w, _g, a in rec.values()), key=lambda a: (a.path, a.line)
         )
@@ -374,36 +370,31 @@ def analyze_shared_state(
             for rq, (w, _g, _a) in sorted(rec.items())[:3]
         )
         more = len(rec) - min(len(rec), 3)
-        findings.append(
-            Finding(
-                path=anchor.path,
-                line=anchor.line,
-                col=anchor.col,
-                rule=RULE,
-                message=(
-                    f"`{attr}` is written from {len(rec)} thread roots "
-                    f"with no common lock guard: {chains}"
-                    + (f" (+{more} more roots)" if more else "")
-                    + "; guard the writes with one shared lock, or "
-                    "document the invariant and suppress at the write"
+        violations.append(
+            (
+                attr,
+                Finding(
+                    path=anchor.path,
+                    line=anchor.line,
+                    col=anchor.col,
+                    rule=RULE,
+                    message=(
+                        f"`{attr}` is written from {len(rec)} thread roots "
+                        f"with no common lock guard: {chains}"
+                        + (f" (+{more} more roots)" if more else "")
+                        + "; guard the writes with one shared lock, or "
+                        "document the invariant and suppress at the write"
+                    ),
                 ),
             )
         )
-    for key in sorted(baseline - seen_baselined):
-        findings.append(
-            Finding(
-                path=baseline_path,
-                line=1,
-                col=0,
-                rule=RULE,
-                message=(
-                    f"stale baseline entry `{key}`: the shared-state race "
-                    "no longer exists — delete it so the ratchet only "
-                    "tightens"
-                ),
-            )
-        )
-    return findings
+    return apply_ratchet(
+        violations,
+        baseline,
+        rule=RULE,
+        baseline_path=baseline_path,
+        what="the shared-state race",
+    )
 
 
 def iter_root_labels(program: Program) -> Iterable[str]:

@@ -18,7 +18,19 @@ else
 fi
 
 echo "== bdlint =="
-python -m banyandb_tpu.lint --check banyandb_tpu || fail=1
+# --fast skips the kernel lowering-audit (XLA compiles); the jaxpr,
+# dispatch and budget halves of the kernel audit still run in both modes
+if [ "${1:-}" = "--fast" ]; then
+    python -m banyandb_tpu.lint --check --fast banyandb_tpu || fail=1
+else
+    python -m banyandb_tpu.lint --check banyandb_tpu || fail=1
+fi
+
+echo "== kernel smoke (bdjit) =="
+# budget-table agreement with the plan-audit matrix + obs-plane export
+# (docs/linting.md "Kernel audit").  --no-audit: the jaxpr/dispatch
+# audit itself just ran inside bdlint --check above — no double work
+env JAX_PLATFORMS=cpu python scripts/kernel_smoke.py --no-audit || fail=1
 
 echo "== cold-path smoke =="
 # tiny store: pipelined == serial byte-identical, precompile registry

@@ -1,0 +1,83 @@
+"""Fast CPU-only kernel-audit smoke (scripts/check.sh, both modes + CI).
+
+Proves, in a few seconds with zero device kernel execution, the bdjit
+invariants (docs/linting.md "Kernel audit"):
+
+1. the jaxpr + dispatch analyzers run the full builtin + mesh matrix
+   with ZERO findings — no host callbacks, no 64-bit dtypes, dispatch/
+   transfer counts equal to the checked-in budgets, and every measure/
+   stream scenario resolving EXACTLY its precompile-registry builtin
+   signature;
+2. the budget table agrees with the plan-audit matrix: every
+   plan_audit.default_entries() signature has a budget row (ONE matrix
+   feeds eval_shape contracts, precompile warming and the budgets);
+3. the static dispatch budgets export to the obs plane as
+   `kernel_dispatch_budget` gauges (the bound scripts/obs_smoke.py
+   asserts against the measured `device_execute` spans).
+
+The lowering-audit (XLA compiles) is exercised by the non-fast
+`python -m banyandb_tpu.lint --check` gate, not here — this smoke stays
+in the seconds class.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable as `python scripts/kernel_smoke.py` from the repo root or CI
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(run_audit: bool = True) -> int:
+    from banyandb_tpu.lint.kernel import kernel_budgets, run_kernel_audit
+    from banyandb_tpu.lint.whole_program.plan_audit import default_entries
+
+    # -- 1: jaxpr + dispatch analyzers clean over the full matrix ----------
+    # (--no-audit skips this half when the caller just ran the same
+    # analyzers through `python -m banyandb_tpu.lint --check`, the way
+    # scripts/check.sh does — steps 2-3 are this smoke's unique checks)
+    if run_audit:
+        findings = run_kernel_audit(fast=True)
+        assert findings == [], "kernel audit findings:\n" + "\n".join(
+            f.render() for f in findings
+        )
+        print("# kernel audit (jaxpr + dispatch + budgets): 0 findings")
+
+    # -- 2: budget table is in agreement with the plan-audit matrix --------
+    audited = {e.name for e in default_entries()}
+    rows = set(kernel_budgets.BUDGETS)
+    assert audited <= rows, f"signatures without a budget row: {audited - rows}"
+    extra = rows - audited
+    print(
+        f"# budget table: {len(rows)} rows cover {len(audited)} plan-audit "
+        f"signatures + {len(extra)} executor/mesh rows {sorted(extra)}"
+    )
+
+    # -- 3: the static budgets export to the obs plane ---------------------
+    from banyandb_tpu.obs.metrics import Meter
+
+    meter = Meter()
+    n = kernel_budgets.publish_to_meter(meter)
+    text = meter.prometheus_text()
+    assert n > 0 and "kernel_dispatch_budget{" in text, (
+        "dispatch budgets missing from the exposition"
+    )
+    print(
+        f"# obs export: {n} kernel_dispatch_budget gauges, measure budget = "
+        f"{kernel_budgets.dispatch_budget('measure')}/part-batch"
+    )
+    print("kernel_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main(run_audit="--no-audit" not in sys.argv[1:]))
+    except AssertionError as e:
+        print(f"kernel_smoke: FAILED: {e}", file=sys.stderr)
+        raise SystemExit(1) from e

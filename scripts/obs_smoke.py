@@ -9,7 +9,12 @@ plane's end-to-end invariants (docs/observability.md):
 2. tracing off returns byte-identical results (JSON form) to tracing on;
 3. /metrics exposition carries bucketed (`_bucket`) latency histograms
    for at least the gather, device_execute and merge stages, and the
-   scraped stage_breakdown (obs/prom.py) recovers nonzero quantiles.
+   scraped stage_breakdown (obs/prom.py) recovers nonzero quantiles;
+4. the kernel audit's STATIC dispatch budget (lint/kernel/
+   kernel_budgets.py, exported as `kernel_dispatch_budget` gauges)
+   bounds the OBSERVED `device_execute` span count for the traced query
+   — the measured plane and the predicted plane agree, which is the
+   ratchet the fused whole-plan executor (ROADMAP item 2) tightens.
 
 Exit 0 on success; any assertion prints a diagnostic and exits 1.
 """
@@ -100,7 +105,12 @@ def main() -> int:
         group_by=GroupBy(("svc",)), agg=Aggregation("sum", "v"),
         trace=True, limit=100,
     )
+    from banyandb_tpu.obs import metrics as obs_metrics
+
+    h_device = obs_metrics.stage_histogram("device_execute")
+    device_spans_before = h_device.snapshot()[0]
     res = liaison.query_measure(req)
+    device_spans = h_device.snapshot()[0] - device_spans_before
     tree = (res.trace or {}).get("span_tree")
     assert tree, "trace=true must attach a merged span_tree"
 
@@ -152,6 +162,33 @@ def main() -> int:
         assert rec and rec["count"] > 0, f"stage_breakdown missing {stage}"
         assert rec["p50_ms"] > 0, f"{stage} p50 is zero: {rec}"
     print(f"# stage_breakdown: {breakdown}")
+
+    # -- 4: static dispatch budget >= observed device_execute spans --------
+    # The kernel audit PREDICTS at most dispatch_budget("measure") device
+    # legs per part-batch; each node's reduce is one part-batch, so the
+    # observed span count for the traced query is bounded by
+    # budget x part-batches.  A fused executor landing with a tighter
+    # budget tightens this same assertion for free.
+    from banyandb_tpu.lint.kernel import kernel_budgets
+
+    published = kernel_budgets.publish_to_meter()
+    assert published > 0, "no dispatch budgets published to the meter"
+    text = global_meter().prometheus_text()
+    assert 'kernel_dispatch_budget{signature="measure/' in text, (
+        "kernel_dispatch_budget gauges missing from the exposition"
+    )
+    budget = kernel_budgets.dispatch_budget("measure")
+    part_batches = len(subtrees)
+    assert 0 < device_spans <= budget * part_batches, (
+        f"observed device_execute spans ({device_spans}) exceed the static "
+        f"dispatch budget ({budget}/part-batch x {part_batches} part-"
+        "batches) — the kernel audit's prediction no longer bounds the "
+        "measured plane"
+    )
+    print(
+        f"# dispatch budget: {device_spans} observed device spans <= "
+        f"{budget}/part-batch x {part_batches} part-batches (static)"
+    )
     print("obs_smoke: OK")
     return 0
 
