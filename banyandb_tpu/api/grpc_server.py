@@ -276,6 +276,11 @@ class WireServices:
                 ireq,
                 groups=(group,),
                 offset=0,  # offset applies ONCE, on the merged stream
+                # the merged page [offset, offset+limit) may come wholly
+                # from one group, so every sub-query must return
+                # offset+limit rows — the original limit alone breaks
+                # pages past the first
+                limit=(ireq.offset or 0) + (ireq.limit or 100),
                 tag_projection=tuple(
                     t for t in ireq.tag_projection if t in known_tags
                 ),
@@ -465,7 +470,13 @@ class WireServices:
             group_tags = tuple(src_m.entity.tag_names)
             conds = []
             for c in req.conditions:
-                op = wire._COND_OP.get(c.op, "eq")
+                if c.op not in wire._COND_OP:
+                    # an unknown wire op must be INVALID_ARGUMENT, never
+                    # silently filtered with eq semantics
+                    raise ValueError(
+                        f"unknown TopN condition op {c.op} on {c.name!r}"
+                    )
+                op = wire._COND_OP[c.op]
                 if op not in ("eq", "ne", "in", "not_in"):
                     raise ValueError(f"TopN condition op {op} not supported")
                 conds.append((c.name, op, wire.tag_value_to_py(c.value)))

@@ -129,3 +129,8 @@ class QueryResult:
     # fed data point's TagFamilies, measure_plan_aggregation.go:286)
     rep_tags: dict[str, list] = field(default_factory=dict)
     trace: Optional[dict] = None
+    # graceful degradation markers (docs/robustness.md): True when the
+    # liaison answered from a PARTIAL replica set — rows covered by the
+    # named unreachable nodes are missing, everything present is exact
+    degraded: bool = False
+    unavailable_nodes: list = field(default_factory=list)

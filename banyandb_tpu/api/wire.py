@@ -167,7 +167,13 @@ def criteria_to_internal(c) -> Optional[im.Criteria]:
         return None
     if which == "condition":
         cond = c.condition
-        op = _COND_OP.get(cond.op, "eq")
+        if cond.op not in _COND_OP:
+            # an unknown/unset wire op is INVALID_ARGUMENT, never a
+            # silent eq filter (same contract as measure_topn)
+            raise ValueError(
+                f"unknown condition op {cond.op} on tag {cond.name!r}"
+            )
+        op = _COND_OP[cond.op]
         val = tag_value_to_py(cond.value)
         if op in ("in", "not_in") and not isinstance(val, (list, tuple)):
             # ref rejects IN/NOT_IN with a scalar literal (the array
@@ -407,7 +413,29 @@ def measure_result_to_pb(measure: isch.Measure, req: im.QueryRequest, res):
                 )
             )
     fill_trace(out, res)
+    fill_degraded(out, res)
     return out
+
+
+def fill_degraded(out, res) -> None:
+    """Degraded-result markers on the proto wire (docs/robustness.md).
+
+    The reference QueryResponse has no dedicated field, so the marker
+    rides the in-band trace as one explicit error span named
+    ``degraded`` with an ``unavailable_nodes`` tag — emitted whether or
+    not the client asked for tracing, so a partial answer is never
+    silently complete-looking.  The JSON surface mirrors this with
+    top-level ``degraded``/``unavailable_nodes`` keys
+    (server.result_to_json)."""
+    if not getattr(res, "degraded", False) or not hasattr(out, "trace"):
+        return
+    sp = out.trace.spans.add()
+    sp.message = "degraded"
+    sp.error = True
+    sp.tags.add(
+        key="unavailable_nodes",
+        value=",".join(sorted(res.unavailable_nodes)),
+    )
 
 
 def fill_trace(out, res) -> None:
@@ -556,6 +584,7 @@ def stream_result_to_pb(res):
             tag = fam.tags.add(key=t)
             tag.value.CopyFrom(py_to_tag_value(v))
     fill_trace(out, res)
+    fill_degraded(out, res)
     return out
 
 

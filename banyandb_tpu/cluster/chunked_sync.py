@@ -37,11 +37,15 @@ def _crc(data: bytes) -> str:
     return f"{zlib.crc32(data) & 0xFFFFFFFF:08x}"
 
 
-# -- failure injection (test-only) ------------------------------------------
+# -- failure injection -------------------------------------------------------
 #
 # Deterministic fault injection for sync paths, mirroring the reference's
-# queue.ChunkedSyncFailureInjector contract (banyand/queue/queue.go:230):
-# tests register an injector; production code never does.
+# queue.ChunkedSyncFailureInjector contract (banyand/queue/queue.go:230).
+# Two sources, explicit registration winning: tests may register an
+# injector directly; otherwise the process-global fault plane
+# (cluster/faults.py, BYDB_FAULTS schedule) drives the same hooks when
+# its schedule names sync faults.  Production with no plane configured
+# injects nothing.
 
 
 class SyncFailureInjector:
@@ -230,12 +234,18 @@ def sync_part_dirs(
 
     Raises TransportError on any non-OK chunk status or stream failure.
     """
+    from banyandb_tpu.cluster import faults
     from banyandb_tpu.cluster.rpc import TransportError
 
     rpcpb = pb.cluster_rpc_pb2
     part_dirs = [Path(p) for p in part_dirs]
-    if _failure_injector is not None:
-        short, err = _failure_injector.before_sync(part_dirs)
+    injector = (
+        _failure_injector
+        if _failure_injector is not None
+        else faults.plane_sync_injector()
+    )
+    if injector is not None:
+        short, err = injector.before_sync(part_dirs)
         if short:
             raise TransportError(f"sync failure injected: {err}")
     session = uuid.uuid4().hex
@@ -287,8 +297,8 @@ def sync_part_dirs(
                 req.metadata.total_parts = len(parts_info)
                 req.metadata.sender_node = sender_node
             idx += 1
-            if _failure_injector is not None:
-                req = _failure_injector.mutate_request(req)
+            if injector is not None:
+                req = injector.mutate_request(req)
             return req
 
         buf = bytearray()
@@ -314,8 +324,8 @@ def sync_part_dirs(
         fin.completion.total_bytes_sent = total_bytes
         fin.completion.total_parts_sent = len(parts_info)
         fin.completion.total_chunks = idx + 1
-        if _failure_injector is not None:
-            fin = _failure_injector.mutate_request(fin)
+        if injector is not None:
+            fin = injector.mutate_request(fin)
         yield fin
 
     call = channel.stream_stream(

@@ -159,6 +159,7 @@ class DataNode:
     def _on_stream_query(self, env: dict) -> dict:
         import base64
 
+        self._check_deadline(env)
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         try:
@@ -241,6 +242,30 @@ class DataNode:
         return {"written": n}
 
     # -- query plane --------------------------------------------------------
+    @staticmethod
+    def _check_deadline(env: dict) -> None:
+        """Liaison->data-node deadline propagation: the scatter envelope
+        carries the query's REMAINING budget at send time; work whose
+        budget is already gone is refused up front (kind="deadline" on
+        the wire — the liaison degrades instead of evicting this node)
+        rather than scanned into a reply nobody will read."""
+        import time as _time
+
+        d = env.get("deadline_ms")
+        abs_d = env.get("deadline_unix_ms")
+        expired = (d is not None and float(d) <= 0) or (
+            # the absolute wall deadline catches budget spent while the
+            # request sat in this node's executor queue (the relative
+            # form is a send-time snapshot and cannot)
+            abs_d is not None and float(abs_d) <= _time.time() * 1000.0
+        )
+        if expired:
+            from banyandb_tpu.cluster.faults import DeadlineExceeded
+
+            raise DeadlineExceeded(
+                "query deadline exhausted before node scan"
+            )
+
     def _node_tracer(self, req):
         """Per-node tracer when the request is traced: this node runs its
         own span tree and ships the subtree back in the reply for the
@@ -253,6 +278,7 @@ class DataNode:
         return Tracer(f"data:{self.name}")
 
     def _on_measure_query_partial(self, env: dict) -> dict:
+        self._check_deadline(env)
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         hist_range = tuple(env["hist_range"]) if env.get("hist_range") else None
@@ -266,6 +292,7 @@ class DataNode:
         return out
 
     def _on_measure_query_raw(self, env: dict) -> dict:
+        self._check_deadline(env)
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         tracer = self._node_tracer(req)
@@ -326,7 +353,8 @@ class DataNode:
             # idempotence, same contract as the streaming path: a re-ship
             # after a sender crash-before-progress-write installs nothing
             files = {f: bytes(b) for f, b in state["files"].items()}
-            digest = f"{group}/{shard_idx}/{self._synced_part_digest(files)}"
+            pmeta0 = _json.loads(files.get("metadata.json", b"{}"))
+            digest = self._synced_part_key(group, shard_idx, pmeta0, files)
             with self._installed_lock:
                 if digest in self._installed:
                     return {"introduced": "", "duplicate": True}
@@ -403,6 +431,21 @@ class DataNode:
             h.update(b"\0")
         return h.hexdigest()
 
+    def _synced_part_key(
+        self, group: str, shard_idx: int, pmeta: dict, files: dict
+    ) -> str:
+        """Idempotence key for an installed synced part.  Prefers the
+        sealer's part uuid (``seal_session``, unique per wqueue seal):
+        a re-shipped part after an ack-lost sender crash dedupes without
+        hashing megabytes, and even if a metadata byte differs between
+        deliveries.  Parts from sealers that stamp no uuid (tier
+        migration meta_patch path, pre-uuid senders) fall back to the
+        full content digest."""
+        sess = pmeta.get("seal_session")
+        if sess:
+            return f"{group}/{shard_idx}/uuid:{sess}"
+        return f"{group}/{shard_idx}/{self._synced_part_digest(files)}"
+
     def _persist_installed_digests(self) -> None:
         """Flush the installed-digest record (call with new digests already
         in self._installed; one write covers a whole sync batch)."""
@@ -444,7 +487,7 @@ class DataNode:
             raise ValueError("part missing metadata.json")
         pmeta = _json.loads(files["metadata.json"])
         group = meta.group or pmeta.get("group")
-        digest = f"{group}/{int(meta.shard_id)}/{self._synced_part_digest(files)}"
+        digest = self._synced_part_key(group, int(meta.shard_id), pmeta, files)
         with self._installed_lock:
             if digest in self._installed:
                 return False

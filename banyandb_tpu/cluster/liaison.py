@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 import uuid
 from typing import Optional
 
@@ -28,6 +29,7 @@ from banyandb_tpu.cluster.rpc import TransportError
 from banyandb_tpu.obs.tracer import NOOP_TRACER, Tracer
 from banyandb_tpu.query import measure_exec
 from banyandb_tpu.utils import hashing
+from banyandb_tpu.utils.envflag import env_float
 
 # RPC deadline tiers (the rpc-timeout contract, docs/linting.md): every
 # fabric call states the stall it tolerates.  Probes stay snappy so the
@@ -39,6 +41,45 @@ _RPC_CONTROL_S = 10.0
 _RPC_WRITE_S = 15.0
 _RPC_QUERY_S = 30.0
 _RPC_SYNC_S = 120.0
+
+
+class _QueryGuard:
+    """Per-query deadline budget + degradation accumulator
+    (docs/robustness.md).
+
+    The WHOLE distributed query shares one budget: every scatter RPC's
+    timeout is clamped to the remaining budget and the envelope carries
+    ``deadline_ms`` (remaining at send) so data nodes refuse
+    already-expired work — one slow node eats its own slice of the
+    budget, never wedges the query past it.  Nodes whose data could not
+    be reached (dead, shedding, out of budget) accumulate in ``nodes``
+    and surface as the response's ``unavailable_nodes`` marker."""
+
+    __slots__ = ("budget_s", "t_end", "nodes")
+
+    def __init__(self, budget_s: float):
+        self.budget_s = budget_s
+        self.t_end = time.monotonic() + budget_s
+        self.nodes: dict[str, str] = {}  # node name -> reason
+
+    def remaining_s(self) -> float:
+        return self.t_end - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining_s() <= 0
+
+    def rpc_timeout(self) -> float:
+        return max(min(_RPC_QUERY_S, self.remaining_s()), 0.001)
+
+    def deadline_ms(self) -> float:
+        return self.remaining_s() * 1000.0
+
+    def mark(self, node_name: str, reason: str) -> None:
+        self.nodes.setdefault(node_name, reason)
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.nodes)
 
 
 def _sort_merged_rows(rows: list, req, *, default_desc: bool = True) -> None:
@@ -81,11 +122,20 @@ class Liaison:
         replicas: int = 0,
         discovery=None,
         handoff_root: Optional[str] = None,
+        query_budget_s: Optional[float] = None,
     ):
         self.registry = registry
         self.transport = transport
         self.replicas = replicas
         self.discovery = discovery
+        # one deadline budget per distributed query (every scatter leg
+        # shares it; BYDB_QUERY_DEADLINE_S overrides the _RPC_QUERY_S
+        # default)
+        self.query_budget_s = (
+            query_budget_s
+            if query_budget_s is not None
+            else env_float("BYDB_QUERY_DEADLINE_S", _RPC_QUERY_S)
+        )
         if discovery is not None:
             nodes = discovery.nodes()
         self.selector = RoundRobinSelector(list(nodes), replicas)
@@ -286,6 +336,12 @@ class Liaison:
                     _fs.atomic_write_json(record, sorted(delivered))
                 except TransportError as e:
                     self._mark_dead(node.name)
+                    # drop the stream's channel: a wedged one would
+                    # otherwise poison every retry after the node
+                    # returns (rpc.GrpcTransport.evict)
+                    evict = getattr(self.transport, "evict", None)
+                    if evict is not None:
+                        evict(node.addr)
                     errors.append(f"{node.name}: {e}")
             if errors or not delivered:
                 raise TransportError(
@@ -394,10 +450,18 @@ class Liaison:
                 f"write reached no replica (failed: {sorted(failed)})"
             )
         if self.handoff is not None:
-            for name, env in failed.items():
-                self.handoff.spool(name, topic, env)
-            for name, env in spool_env.items():
-                self.handoff.spool(name, topic, env)
+            for name, env in {**failed, **spool_env}.items():
+                try:
+                    self.handoff.spool(name, topic, env)
+                except OSError:
+                    # the spool is a bounded repair cache, never the ack
+                    # copy: a full/torn spool disk must not fail a write
+                    # that already reached a replica
+                    import logging
+
+                    logging.getLogger("banyandb.liaison").exception(
+                        "handoff spool failed for %s (entry dropped)", name
+                    )
         elif failed:
             raise TransportError(
                 f"replica write failed with no handoff: {sorted(failed)}"
@@ -405,9 +469,18 @@ class Liaison:
 
     # -- queries ------------------------------------------------------------
     def _shard_assignment(
-        self, group: str, stages: tuple[str, ...] = ()
+        self,
+        group: str,
+        stages: tuple[str, ...] = (),
+        guard: Optional[_QueryGuard] = None,
     ) -> dict[NodeInfo, list[int]]:
         """Per-shard node assignment, stage-aware (ResolveStage analog).
+
+        `guard` (query paths only): a shard whose whole replica set is
+        down DEGRADES the query — the shard is skipped and its down
+        replicas land in guard.nodes — instead of failing it outright.
+        Zero assignable shards still raise: an empty answer that looks
+        merely "degraded" would hide a total outage.
 
         Untiered groups (no stages configured or requested): each shard
         goes to its replica-chain primary — one node per shard, so
@@ -444,8 +517,14 @@ class Liaison:
                     # off-chain spread is only sound for tiered stages,
                     # where migration places shards off the write-time
                     # chain; untiered data lives on chain nodes only, so
-                    # a dead chain must error, not silently return less
+                    # a dead chain must error — or, with a degradation
+                    # guard, skip the shard and name its down replicas
                     if not fallback or not ordered:
+                        if guard is not None:
+                            for rep in self.selector.replica_set(shard):
+                                if rep.name not in eligible:
+                                    guard.mark(rep.name, "unreachable")
+                            continue
                         raise TransportError(
                             f"shard {shard} has no alive replica for {label}"
                         ) from None
@@ -475,7 +554,138 @@ class Liaison:
                 raise TransportError(
                     f"no alive node serves stages {missing}"
                 )
+        if guard is not None and guard.nodes and not assignment:
+            raise TransportError(
+                "no shard has an alive replica "
+                f"(down: {sorted(guard.nodes)})"
+            )
         return {node: shards for node, shards in assignment.values()}
+
+    # -- degraded-tolerant scatter (docs/robustness.md) ---------------------
+    def _reassign(
+        self, shards: list[int], exclude: set[str]
+    ) -> tuple[dict[NodeInfo, list[int]], list[int]]:
+        """Failover placement for shards whose assigned node failed
+        mid-query: each shard goes to its first alive replica outside
+        `exclude`; shards with none left come back uncovered."""
+        out: dict[NodeInfo, list[int]] = {}
+        uncovered: list[int] = []
+        alive = self.alive - exclude
+        for shard in shards:
+            try:
+                node = self.selector.primary(shard, alive)
+            except RuntimeError:
+                uncovered.append(shard)
+                continue
+            out.setdefault(node, []).append(shard)
+        return out, uncovered
+
+    def _scatter_one(
+        self, topic, node, shards, env_of, guard, t, on_reply, retry
+    ) -> None:
+        """One scatter leg under the query guard: deadline-clamped
+        timeout, deadline_ms stamped on the envelope, structured failure
+        handling.  `retry` (list or None) collects hard-failed legs for
+        the caller's one failover round; shed/deadline rejections mark
+        the node unavailable without eviction (it is healthy)."""
+        if guard.expired():
+            guard.mark(node.name, "deadline")
+            return
+        # remaining budget (deadline_ms) AND the absolute wall deadline:
+        # the absolute form still fires after the request sat in the
+        # receiver's executor queue (same-DC clock skew caveat applies)
+        env = dict(
+            env_of(shards),
+            deadline_ms=guard.deadline_ms(),
+            deadline_unix_ms=time.time() * 1000.0 + guard.deadline_ms(),
+        )
+        with t.span(f"scatter:{node.name}") as sp:
+            sp.tag("shards", list(shards))
+            try:
+                r = self.transport.call(
+                    node.addr, topic, env, timeout=guard.rpc_timeout()
+                )
+            except TransportError as e:
+                sp.error(str(e))
+                kind = getattr(e, "kind", "error")
+                if kind in ("shed", "deadline"):
+                    guard.mark(node.name, kind)
+                    return
+                self._mark_dead(node.name)
+                if retry is not None:
+                    retry.append((node, list(shards)))
+                else:
+                    guard.mark(node.name, "unreachable")
+                return
+            # the node ran its own tracer; graft its subtree so the
+            # response carries ONE merged span tree
+            sp.attach(r.get("trace"))
+            on_reply(node, shards, r, sp)
+
+    def _scatter(
+        self, topic, assignment, env_of, guard, tracer, on_reply,
+        *, failover: bool = True,
+    ) -> None:
+        """Scatter with ONE failover round: legs that hard-fail get
+        their shards re-placed on surviving replicas; shards with no
+        survivor degrade the response instead of failing it.
+
+        `failover=False` for TIERED groups: _reassign walks the
+        untiered replica chain, which for a failed warm-tier leg could
+        re-place shards onto a hot node that already answered —
+        double-counting rows.  Tiered legs degrade directly instead."""
+        t = tracer if tracer is not None else NOOP_TRACER
+        retry: list[tuple[NodeInfo, list[int]]] = (
+            [] if failover else None  # type: ignore[assignment]
+        )
+        for node, shards in assignment.items():
+            self._scatter_one(
+                topic, node, shards, env_of, guard, t, on_reply, retry
+            )
+        if not retry:
+            return
+        failed = {n.name for n, _s in retry}
+        for node, shards in retry:
+            placed, uncovered = self._reassign(shards, exclude=failed)
+            if uncovered:
+                guard.mark(node.name, "unreachable")
+            for alt, alt_shards in placed.items():
+                # second failure is terminal for the leg (retry=None)
+                self._scatter_one(
+                    topic, alt, alt_shards, env_of, guard, t, on_reply, None
+                )
+
+    def _failover_ok(self, group: str, stages: tuple[str, ...]) -> bool:
+        """Replica-chain failover is sound only when the query runs
+        untiered (no stages requested AND none configured)."""
+        try:
+            configured = self.registry.get_group(group).resource_opts.stages
+        except KeyError:
+            configured = ()
+        return not (tuple(stages) or tuple(configured))
+
+    def _finish_degraded(self, res, guard, tracer, engine: str) -> None:
+        """Stamp the explicit partial-result markers: wire/JSON fields,
+        span tags on the tracer's current span, and the
+        query_degraded_total counter."""
+        if guard is None or not guard.degraded:
+            return
+        res.degraded = True
+        res.unavailable_nodes = sorted(guard.nodes)
+        if tracer is not None:
+            sp = tracer.current()
+            if sp is not None:
+                sp.tag("degraded", True)
+                sp.tag("unavailable_nodes", sorted(guard.nodes))
+                sp.tag(
+                    "degraded_reasons",
+                    {n: r for n, r in sorted(guard.nodes.items())},
+                )
+        from banyandb_tpu.obs.metrics import global_meter
+
+        global_meter().counter_add(
+            "query_degraded", 1.0, {"engine": engine}
+        )
 
     def _scatter_partials(
         self,
@@ -483,25 +693,27 @@ class Liaison:
         assignment: dict[NodeInfo, list[int]],
         hist_range: Optional[tuple[float, float]],
         tracer=None,
+        guard: Optional[_QueryGuard] = None,
+        failover: bool = True,
     ) -> list[measure_exec.Partials]:
-        t = tracer if tracer is not None else NOOP_TRACER
+        if guard is None:
+            guard = _QueryGuard(self.query_budget_s)
         env_base = {
             "request": serde.query_request_to_json(req),
             "hist_range": list(hist_range) if hist_range else None,
         }
         out = []
-        for node, shards in assignment.items():
-            env = dict(env_base, shards=shards)
-            with t.span(f"scatter:{node.name}") as sp:
-                r = self.transport.call(
-                    node.addr, Topic.MEASURE_QUERY_PARTIAL.value, env,
-                    timeout=_RPC_QUERY_S,
-                )
-                sp.tag("shards", list(shards))
-                # the node ran its own tracer; graft its subtree so the
-                # response carries ONE merged span tree
-                sp.attach(r.get("trace"))
+
+        def env_of(shards):
+            return dict(env_base, shards=shards)
+
+        def on_reply(node, shards, r, sp):
             out.append(serde.partials_from_json(r["partials"]))
+
+        self._scatter(
+            Topic.MEASURE_QUERY_PARTIAL.value,
+            assignment, env_of, guard, tracer, on_reply, failover=failover,
+        )
         return out
 
     def enable_mesh_fastpath(self, mesh, engines_by_node: dict) -> None:
@@ -526,8 +738,10 @@ class Liaison:
         t = tracer if tracer is not None else NOOP_TRACER
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
+        guard = _QueryGuard(self.query_budget_s)
+        failover = self._failover_ok(group, req.stages)
         with t.span("plan") as ps:
-            assignment = self._shard_assignment(group, req.stages)
+            assignment = self._shard_assignment(group, req.stages, guard=guard)
             ps.tag("nodes", sorted(n.name for n in assignment))
 
         def _attach_tree(res) -> QueryResult:
@@ -559,20 +773,20 @@ class Liaison:
             limit = req.limit or 100
             node_req = dataclasses.replace(req, offset=0, limit=off + limit)
             rows: list[dict] = []
-            for node, shards in assignment.items():
-                with t.span(f"scatter:{node.name}") as sp:
-                    r = self.transport.call(
-                        node.addr,
-                        Topic.MEASURE_QUERY_RAW.value,
-                        {
-                            "request": serde.query_request_to_json(node_req),
-                            "shards": shards,
-                        },
-                        timeout=_RPC_QUERY_S,
-                    )
-                    sp.tag("rows", len(r["data_points"]))
-                    sp.attach(r.get("trace"))
+            req_json = serde.query_request_to_json(node_req)
+
+            def env_of(shards):
+                return {"request": req_json, "shards": shards}
+
+            def on_reply(node, shards, r, sp):
+                sp.tag("rows", len(r["data_points"]))
                 rows.extend(r["data_points"])
+
+            self._scatter(
+                Topic.MEASURE_QUERY_RAW.value,
+                assignment, env_of, guard, tracer, on_reply,
+                failover=failover,
+            )
             with t.span("merge") as ms:
                 _sort_merged_rows(rows, req, default_desc=False)  # ASC
                 ms.tag("rows", len(rows))
@@ -581,6 +795,7 @@ class Liaison:
             self._attach_distributed_plan(
                 res, m, req, assignment, combine="row merge (host ts sort)"
             )
+            self._finish_degraded(res, guard, tracer, "measure")
             return _attach_tree(res)
 
         want_percentile = bool(req.agg and req.agg.function == "percentile")
@@ -594,7 +809,8 @@ class Liaison:
                 # tracer threads through: the round's per-node scatter
                 # spans (and node subtrees) nest under range_round
                 stats = self._scatter_partials(
-                    stats_req, assignment, None, tracer=tracer
+                    stats_req, assignment, None, tracer=tracer, guard=guard,
+                    failover=failover,
                 )
             lo, hi = float("inf"), float("-inf")
             for p in stats:
@@ -606,8 +822,21 @@ class Liaison:
             hist_range = (lo, max(hi - lo, 1e-6))
 
         partials = self._scatter_partials(
-            req, assignment, hist_range, tracer=tracer
+            req, assignment, hist_range, tracer=tracer, guard=guard,
+            failover=failover,
         )
+        if not partials:
+            # EVERY leg was lost (dead/shed/deadline): an aggregate built
+            # from nothing is not a degraded answer, it is a failure —
+            # raise with the per-node reasons instead of fabricating 0s
+            raise TransportError(
+                f"no node answered the scatter: {dict(guard.nodes)}",
+                kind=(
+                    "deadline"
+                    if set(guard.nodes.values()) == {"deadline"}
+                    else "error"
+                ),
+            )
         res = measure_exec.finalize_partials(
             m, req, partials,
             span=t.current() if tracer is not None else None,
@@ -617,6 +846,7 @@ class Liaison:
             combine="host combine_partials (f64 Kahan)",
             percentile="two-round range agreement" if want_percentile else "",
         )
+        self._finish_degraded(res, guard, tracer, "measure")
         return _attach_tree(res)
 
     def _attach_distributed_plan(
@@ -691,22 +921,28 @@ class Liaison:
         if own_tracer:
             tracer = Tracer("liaison:stream")
         t = tracer if tracer is not None else NOOP_TRACER
-        assignment = self._shard_assignment(req.groups[0], req.stages)
+        guard = _QueryGuard(self.query_budget_s)
+        assignment = self._shard_assignment(
+            req.groups[0], req.stages, guard=guard
+        )
         off = req.offset or 0
         limit = req.limit or 100
         node_req = dataclasses.replace(req, offset=0, limit=off + limit)
         rows: list[dict] = []
-        for node, shards in assignment.items():
-            with t.span(f"scatter:{node.name}") as sp:
-                r = self.transport.call(
-                    node.addr,
-                    Topic.STREAM_QUERY.value,
-                    {"request": serde.query_request_to_json(node_req), "shards": shards},
-                    timeout=_RPC_QUERY_S,
-                )
-                sp.tag("rows", len(r["data_points"]))
-                sp.attach(r.get("trace"))
+        req_json = serde.query_request_to_json(node_req)
+
+        def env_of(shards):
+            return {"request": req_json, "shards": shards}
+
+        def on_reply(node, shards, r, sp):
+            sp.tag("rows", len(r["data_points"]))
             rows.extend(r["data_points"])
+
+        self._scatter(
+            Topic.STREAM_QUERY.value,
+            assignment, env_of, guard, tracer, on_reply,
+            failover=self._failover_ok(req.groups[0], req.stages),
+        )
         with t.span("merge") as ms:
             _sort_merged_rows(rows, req)
             ms.tag("rows", len(rows))
@@ -720,6 +956,7 @@ class Liaison:
             dp["body"] = base64.b64decode(dp.get("body", ""))
             dp["tags"] = serde.tags_from_json(dp["tags"])
             res.data_points.append(dp)
+        self._finish_degraded(res, guard, tracer, "stream")
         if own_tracer and req.trace:
             res.trace = dict(res.trace or {})
             res.trace["span_tree"] = tracer.finish()

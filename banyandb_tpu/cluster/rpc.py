@@ -16,6 +16,7 @@ import time
 from concurrent import futures
 from typing import Optional
 
+from banyandb_tpu.cluster import faults
 from banyandb_tpu.cluster.bus import LocalBus
 from banyandb_tpu.obs import metrics as obs_metrics
 
@@ -32,9 +33,11 @@ def _observe_rpc(side: str, topic: str, t0: float) -> None:
 
 
 class TransportError(RuntimeError):
-    """kind: "error" (default) or "shed" — the remote rejected the call
-    to shed load (DiskFull/ServerBusy); shed nodes are healthy and must
-    not be treated as dead."""
+    """kind: "error" (default), "shed" — the remote rejected the call to
+    shed load (DiskFull/ServerBusy); or "deadline" — the remote refused
+    work whose propagated deadline already expired.  Shed and
+    deadline-rejecting nodes are healthy and must not be treated as
+    dead."""
 
     def __init__(self, msg: str, kind: str = "error"):
         super().__init__(msg)
@@ -43,6 +46,18 @@ class TransportError(RuntimeError):
 
 # write-admission exception class names serialized as shed rejections
 _SHED_TYPES = ("DiskFull", "ServerBusy")
+
+
+def _error_kind(e: Exception) -> str:
+    """Classify a handler exception for the wire: shed rejections and
+    deadline refusals are structured (the caller must NOT evict the
+    node); everything else is a hard error."""
+    name = type(e).__name__
+    if name in _SHED_TYPES:
+        return "shed"
+    if name == "DeadlineExceeded":
+        return "deadline"
+    return "error"
 
 
 class LocalTransport:
@@ -67,6 +82,7 @@ class LocalTransport:
 
     def call(self, addr: str, topic: str, envelope: dict, timeout: float = 30.0) -> dict:
         assert addr.startswith("local:"), addr
+        faults.maybe_fail_rpc(addr, topic)
         bus = self._buses.get(addr[6:])
         if bus is None:
             raise TransportError(f"node {addr} unreachable")
@@ -74,11 +90,13 @@ class LocalTransport:
         try:
             return bus.handle(topic, envelope)
         except Exception as e:
-            # mirror the gRPC transport's shed classification; all other
-            # exceptions keep propagating raw (standalone-equal behavior)
-            if type(e).__name__ in _SHED_TYPES:
+            # mirror the gRPC transport's shed/deadline classification;
+            # all other exceptions keep propagating raw (standalone-equal
+            # behavior)
+            kind = _error_kind(e)
+            if kind != "error":
                 raise TransportError(
-                    f"{type(e).__name__}: {e}", kind="shed"
+                    f"{type(e).__name__}: {e}", kind=kind
                 ) from e
             raise
         finally:
@@ -145,13 +163,10 @@ class GrpcBusServer:
                 reply = self.bus.handle(msg["topic"], msg["envelope"])
                 return json.dumps({"ok": True, "reply": reply}).encode()
             except Exception as e:  # noqa: BLE001 - errors cross the wire
-                kind = (
-                    "shed" if type(e).__name__ in _SHED_TYPES else "error"
-                )
                 return json.dumps(
                     {
                         "ok": False,
-                        "kind": kind,
+                        "kind": _error_kind(e),
                         "error": f"{type(e).__name__}: {e}",
                     }
                 ).encode()
@@ -330,6 +345,16 @@ class GrpcTransport:
         """Raw grpc channel for streaming services (chunked sync)."""
         return self._stub(addr)[1]
 
+    def evict(self, addr: str) -> None:
+        """Public eviction for STREAMING users: a failed SyncPart stream
+        never passes through call(), so its wedged channel would survive
+        the UNAVAILABLE-eviction below and poison every retry against a
+        restarted peer (same gVisor-class wedge, see _evict).  Dropping
+        the cache entry makes the next dial fresh; the old channel is
+        released when its last user lets go."""
+        with self._lock:
+            self._channels.pop(addr, None)
+
     def _evict(self, addr: str, failed) -> None:
         """Drop the channel a call just failed on so the next call dials
         a fresh one.  A channel whose connect wedged can stay in
@@ -351,6 +376,7 @@ class GrpcTransport:
     def call(self, addr: str, topic: str, envelope: dict, timeout: float = 30.0) -> dict:
         import grpc
 
+        faults.maybe_fail_rpc(addr, topic)
         stub, ch = self._stub(addr)
         payload = json.dumps({"topic": topic, "envelope": envelope}).encode()
         t0 = time.perf_counter()
@@ -359,7 +385,18 @@ class GrpcTransport:
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.UNAVAILABLE:
                 self._evict(addr, ch)
-            raise TransportError(f"rpc to {addr} failed: {e.code()}") from e
+            # a client-enforced deadline says the CALL was too slow, not
+            # that the peer is dead — callers clamping timeouts to a
+            # query budget (liaison _QueryGuard) must not evict healthy
+            # nodes over their own budget running out
+            kind = (
+                "deadline"
+                if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+                else "error"
+            )
+            raise TransportError(
+                f"rpc to {addr} failed: {e.code()}", kind=kind
+            ) from e
         finally:
             _observe_rpc("client", topic, t0)
         msg = json.loads(raw)

@@ -11,15 +11,21 @@ introduce shipped parts directly — the write path and the inter-tier
 sync path are the same code.
 
 Failure contract: a sealed part that fails to ship stays spooled on
-disk and retries on the next tick (the spool is the liaison's handoff
-buffer for the part plane); seal+ship never loses acknowledged rows —
-rows are acknowledged only after landing in the spool-backed memtable
-of a seal group, and a liaison crash loses at most the unsealed buffer
-(same window as the reference's liaison wqueue).
+disk and retries with bounded exponential backoff + jitter (the spool
+is the liaison's handoff buffer for the part plane); seal+ship never
+loses acknowledged rows — rows are acknowledged only after landing in
+the spool-backed memtable of a seal group, and a liaison crash loses at
+most the unsealed buffer (same window as the reference's liaison
+wqueue).  The spool is bounded by BACKPRESSURE, not eviction: past the
+high watermark (``max_spool_bytes``) new appends raise ServerBusy — a
+retryable shed rejection on the wire (the reference's ServerBusy,
+pub.go:301-387) — instead of buffering unboundedly while data nodes
+are down.
 """
 
 from __future__ import annotations
 
+import random
 import shutil
 import threading
 import time
@@ -29,9 +35,21 @@ from typing import Callable, Optional
 
 from banyandb_tpu.api.model import WriteRequest
 from banyandb_tpu.api.schema import SchemaRegistry
+from banyandb_tpu.cluster import faults
 from banyandb_tpu.storage.memtable import MemTable
 from banyandb_tpu.storage.part import PartWriter
 from banyandb_tpu.utils import hashing
+
+
+def _dir_bytes(path: Path) -> int:
+    total = 0
+    try:
+        for f in path.rglob("*"):
+            if f.is_file():
+                total += f.stat().st_size
+    except OSError:
+        pass
+    return total
 
 
 class WriteQueue:
@@ -43,15 +61,22 @@ class WriteQueue:
         *,
         max_rows: int = 65536,
         flush_interval_s: float = 1.0,
+        max_spool_bytes: int = 256 << 20,
+        retry_base_s: float = 0.05,
+        retry_cap_s: float = 30.0,
     ):
         """shipper(group, shard_id, part_dir) ships one sealed part;
-        raises on failure (the part stays spooled and retries)."""
+        raises on failure (the part stays spooled and retries with
+        exponential backoff capped at ``retry_cap_s``)."""
         self.registry = registry
         self.spool = Path(spool_root)
         self.spool.mkdir(parents=True, exist_ok=True)
         self.shipper = shipper
         self.max_rows = max_rows
         self.flush_interval_s = flush_interval_s
+        self.max_spool_bytes = max_spool_bytes
+        self.retry_base_s = retry_base_s
+        self.retry_cap_s = retry_cap_s
         # key: (catalog, group, resource, shard)
         self._buffers: dict[tuple[str, str, str, int], MemTable] = {}
         self._lock = threading.Lock()
@@ -59,8 +84,39 @@ class WriteQueue:
         self._trace_meta: dict[tuple, tuple[str, ...]] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # per-part retry state: str(part_dir) -> (attempts, next_try
+        # monotonic); jitter decorrelates a fleet of liaisons hammering
+        # one recovering data node
+        self._retry: dict[str, tuple[int, float]] = {}
+        self._jitter = random.Random(0xBDB)
         # orphaned sealed parts from a previous process retry first
         self._pending: list[tuple[str, int, Path]] = self._recover_spool()
+        # per-part byte sizes, measured ONCE (at seal/recovery) and
+        # reused when the ship frees them
+        self._part_bytes: dict[str, int] = {
+            str(p): _dir_bytes(p.parent) for _g, _s, p in self._pending
+        }
+        self._spool_bytes = sum(self._part_bytes.values())
+
+    # -- admission (spool high-watermark backpressure) ----------------------
+    def _admit(self) -> None:
+        """Reject new rows while the ship spool is past its high
+        watermark: the caller gets a RETRYABLE shed rejection (ServerBusy
+        serializes as kind="shed" on the transport, so clients back off
+        and retry instead of treating the liaison as dead), and already-
+        acked rows keep their bounded, eventually-shipped spool."""
+        with self._lock:
+            over = self._spool_bytes > self.max_spool_bytes
+            spooled = self._spool_bytes
+        if over:
+            from banyandb_tpu.admin.protector import ServerBusy
+            from banyandb_tpu.obs.metrics import global_meter
+
+            global_meter().counter_add("wqueue_shed", 1.0)
+            raise ServerBusy(
+                f"write queue spool over high watermark "
+                f"({spooled} > {self.max_spool_bytes} bytes); retry later"
+            )
 
     # -- append path --------------------------------------------------------
     def append(self, req: WriteRequest) -> int:
@@ -69,6 +125,7 @@ class WriteQueue:
         (entity hash -> seriesID -> shard).  The queue lock is held for
         the whole batch so a concurrent seal can never orphan a buffer
         between lookup and append (acknowledged rows must reach a seal)."""
+        self._admit()
         m = self.registry.get_measure(req.group, req.name)
         shard_num = self.registry.get_group(req.group).resource_opts.shard_num
         tag_names = [t.name for t in m.tags]
@@ -107,6 +164,7 @@ class WriteQueue:
         introduces identically to its own flushes."""
         from banyandb_tpu.models.stream import encode_element_payload
 
+        self._admit()
         st = self.registry.get_stream(group, name)
         shard_num = self.registry.get_group(group).resource_opts.shard_num
         tag_names = [t.name for t in st.tags]
@@ -152,6 +210,7 @@ class WriteQueue:
         rebuild sidx entries on install."""
         from banyandb_tpu.models.trace import trace_shard_id
 
+        self._admit()
         t = self.registry.get_trace(group, name)
         shard_num = self.registry.get_group(group).resource_opts.shard_num
         tag_names = [x.name for x in t.tags]
@@ -202,6 +261,10 @@ class WriteQueue:
         tmp_parents: list[Path] = []
         sealed: list[tuple[str, int, Path]] = []
         try:
+            # disk-fault boundary (cluster/faults.py): ENOSPC raises here
+            # (rows restored below); a "short" decision tears the first
+            # staged write so the cleanup path is exercised too
+            torn = faults.check_disk("wqueue-seal")
             cols = buf.snapshot_columns()
             iv = self.registry.get_group(group).resource_opts.segment_interval.millis
             seg_starts = cols.ts - (cols.ts % iv)
@@ -234,6 +297,14 @@ class WriteQueue:
                     extra_meta["ordered_tags"] = list(
                         self._trace_meta.get(key, ())
                     )
+                if torn:
+                    import errno as _errno
+
+                    tmp_parent.mkdir(parents=True, exist_ok=True)
+                    (tmp_parent / "part-000000.torn").write_bytes(b"\0" * 8)
+                    raise OSError(
+                        _errno.EIO, "injected short write at wqueue seal"
+                    )
                 PartWriter.write(
                     tmp_parent / "part-000000",
                     ts=cols.ts[mask],
@@ -249,8 +320,13 @@ class WriteQueue:
             for tmp_parent, final_parent in staged:
                 tmp_parent.rename(final_parent)
                 sealed.append((group, shard, final_parent / "part-000000"))
+            sizes = {
+                str(p): _dir_bytes(p.parent) for _g, _s, p in sealed
+            }
             with self._lock:
                 self._pending.extend(sealed)
+                self._part_bytes.update(sizes)
+                self._spool_bytes += sum(sizes.values())
         except Exception:
             # undo everything (renamed-but-unregistered parts included):
             # the restored rows below are the single surviving copy
@@ -291,29 +367,57 @@ class WriteQueue:
         if errors:
             raise errors[0]
 
-    def ship_pending(self) -> tuple[int, int]:
-        """Try to ship every sealed part; -> (shipped, failed)."""
+    def ship_pending(self, *, force: bool = False) -> tuple[int, int]:
+        """Try to ship every sealed part that is DUE; -> (shipped,
+        failed).  A part whose last attempt failed waits out its
+        exponential backoff (base * 2^attempts, capped, +25% jitter)
+        before the next try — deferred parts count as neither shipped
+        nor failed.  ``force=True`` ignores the backoff clock (final
+        flush at stop, post-recovery drains)."""
+        from banyandb_tpu.obs.metrics import global_meter
+
+        now = time.monotonic()
         with self._lock:
             pending, self._pending = self._pending, []
         shipped = failed = 0
         still: list[tuple[str, int, Path]] = []
         for group, shard, part_dir in pending:
+            key = str(part_dir)
+            attempts, next_try = self._retry.get(key, (0, 0.0))
+            if not force and now < next_try:
+                still.append((group, shard, part_dir))  # not due yet
+                continue
             try:
                 self.shipper(group, shard, part_dir)
                 shutil.rmtree(part_dir.parent, ignore_errors=True)
                 shipped += 1
-            except Exception:  # noqa: BLE001 - retried next tick
+                with self._lock:
+                    self._retry.pop(key, None)
+                    freed = self._part_bytes.pop(key, 0)
+                    self._spool_bytes = max(0, self._spool_bytes - freed)
+                global_meter().counter_add("wqueue_shipped", 1.0)
+            except Exception:  # noqa: BLE001 - retried after backoff
+                attempts += 1
+                delay = min(
+                    self.retry_cap_s,
+                    self.retry_base_s * (2 ** (attempts - 1)),
+                )
+                delay *= 1.0 + 0.25 * self._jitter.random()
+                with self._lock:
+                    self._retry[key] = (attempts, time.monotonic() + delay)
                 still.append((group, shard, part_dir))
                 failed += 1
+                global_meter().counter_add("wqueue_ship_retry", 1.0)
         with self._lock:
             self._pending.extend(still)
+            global_meter().gauge_set("wqueue_spool_bytes", self._spool_bytes)
         return shipped, failed
 
-    def flush(self) -> tuple[int, int]:
+    def flush(self, *, force: bool = False) -> tuple[int, int]:
         """Seal everything and attempt shipping (one tick, also the test
         hook)."""
         self.seal_all()
-        return self.ship_pending()
+        return self.ship_pending(force=force)
 
     def pending_parts(self) -> int:
         with self._lock:
@@ -322,6 +426,10 @@ class WriteQueue:
     def buffered_rows(self) -> int:
         with self._lock:
             return sum(len(b) for b in self._buffers.values())
+
+    def spool_bytes(self) -> int:
+        with self._lock:
+            return self._spool_bytes
 
     # -- lifecycle ----------------------------------------------------------
     def _recover_spool(self) -> list[tuple[str, int, Path]]:
@@ -370,4 +478,5 @@ class WriteQueue:
             self._thread.join(timeout=5)
             self._thread = None
         if final_flush:
-            self.flush()
+            # the last chance to drain before shutdown ignores backoff
+            self.flush(force=True)

@@ -551,9 +551,15 @@ def compute_partials(
     # projected tags are schema errors (ref WantErr cases).
     rep_tags: tuple[str, ...] = ()
     if group_tags or agg is not None:
+        schema_fields = {f.name for f in measure.fields}
         rep_list = []
         for t in request.tag_projection:
             if t in group_tags:
+                continue
+            if t in schema_fields:
+                # bydbql puts the SELECT list into BOTH projections, so a
+                # grouped `SELECT svc, value ...` names the field here;
+                # fields are never representative tags
                 continue
             measure.tag(t)  # KeyError -> INVALID_ARGUMENT on the wire
             rep_list.append(t)
@@ -772,6 +778,12 @@ def compute_partials(
             "partials",
             gather_key,
             spec,
+            # rep_tags are NOT part of the kernel signature (the kernel
+            # only tracks the representative ROW; decode happens host-
+            # side), so they must pin the cache entry separately — a
+            # projection-free query must never serve a projecting one
+            # cached partials with rep_vals=None
+            rep_tags,
             round(hist_lo, 9),
             round(hist_span, 9),
             h.hexdigest(),

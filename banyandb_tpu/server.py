@@ -75,6 +75,11 @@ def result_to_json(res: QueryResult) -> dict:
         }
     if res.trace is not None:
         out["trace"] = res.trace
+    if getattr(res, "degraded", False):
+        # explicit partial-result markers (docs/robustness.md): callers
+        # must be able to tell "empty" from "missing replicas"
+        out["degraded"] = True
+        out["unavailable_nodes"] = sorted(res.unavailable_nodes)
     return out
 
 
@@ -718,6 +723,20 @@ def main(argv=None) -> None:
         compile_cache.enable_at(s.compile_cache_dir)
     else:
         compile_cache.enable_at(_Path(s.root) / "compile-cache")
+    # an armed fault plane must be impossible to miss in a server log
+    # (docs/robustness.md): chaos harnesses set it on purpose, a stray
+    # env var in production must not inject faults silently
+    import os as _os
+
+    if _os.environ.get("BYDB_FAULTS", "").strip():
+        import sys as _sys
+
+        print(
+            f"warning: fault injection ARMED via BYDB_FAULTS="
+            f"{_os.environ['BYDB_FAULTS']!r}",
+            file=_sys.stderr,
+            flush=True,
+        )
     # role-irrelevant flags must not silently do nothing (an operator
     # passing --http-port to a liaison would wait on a port never bound)
     _ignored = {

@@ -49,6 +49,13 @@ echo "== obs smoke =="
 # bucketed stage histograms on /metrics (docs/observability.md)
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py || fail=1
 
+echo "== chaos smoke =="
+# 3 in-process data-node kill/restart cycles under the liaison write
+# queue + a degradation scenario + a seeded fault schedule: zero
+# acked-write loss, explicit degraded markers, queries inside their
+# deadline budget (docs/robustness.md)
+env JAX_PLATFORMS=cpu python scripts/chaos.py --smoke || fail=1
+
 if [ "${1:-}" != "--fast" ]; then
     echo "== tier-1 tests (ROADMAP.md, BYDB_SANITIZE=1 via conftest) =="
     rm -f /tmp/_t1.log

@@ -1,0 +1,51 @@
+"""Chaos harness gates (scripts/chaos.py, docs/robustness.md).
+
+Tier-1 runs the in-process smoke: three data-node kill/restart cycles
+under the liaison write queue, a degradation scenario with explicit
+markers, and a seeded fault schedule — all with zero acked-write loss
+and every query inside its deadline budget.  The ``-m slow`` tier runs
+the real-subprocess soak (SIGKILL cycles under sustained load).
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+import chaos  # noqa: E402
+
+
+def test_chaos_smoke(tmp_path):
+    stats = chaos.run_smoke(tmp_path / "chaos", seed=7)
+    assert stats["kill_cycles"] >= 3
+    assert stats["degraded_seen"] >= 1
+    assert stats["faults_injected"] > 0
+    assert stats["acked_a"] > 0 and stats["acked_c"] > 0
+    # deadline invariant: asserted per-query inside the harness too
+    assert stats["max_query_wall_s"] <= 4.0
+
+
+def test_chaos_smoke_seed_changes_schedule(tmp_path):
+    """Different seeds draw different probabilistic fault sequences —
+    the smoke is not accidentally seed-blind."""
+    from banyandb_tpu.cluster.faults import FaultPlane
+
+    spec = "seed={};rpc=error:p=0.3"
+    a, b = FaultPlane(spec.format(3)), FaultPlane(spec.format(4))
+    fired_a = [i for i in range(64) if a.decide("rpc")]
+    fired_b = [i for i in range(64) if b.decide("rpc")]
+    assert fired_a != fired_b
+
+
+@pytest.mark.slow  # real subprocess cluster: boots + kill/restart cycles
+def test_chaos_soak(tmp_path):
+    import os
+
+    seconds = float(os.environ.get("BYDB_CHAOS_SECONDS", "90"))
+    stats = chaos.run_soak(tmp_path / "soak", seconds=seconds)
+    assert stats["kill_cycles"] >= 3
+    assert stats["degraded_seen"] >= 1
+    assert stats["acked"] > 0
+    assert stats["max_query_wall_s"] <= 15.0

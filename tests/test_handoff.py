@@ -1,0 +1,117 @@
+"""Direct HandoffController coverage (cluster/handoff.py): spool size
+cap, replay ordering, partially-failed replay retry, torn-tail repair.
+Previously only exercised indirectly through the liaison tests."""
+
+import json
+
+from banyandb_tpu.cluster.handoff import HandoffController
+
+
+def _env(i):
+    return {"seq": i, "payload": "x" * 64}
+
+
+def test_replay_preserves_spool_order(tmp_path):
+    h = HandoffController(tmp_path)
+    for i in range(10):
+        h.spool("n0", f"topic-{i % 2}", _env(i))
+    assert h.pending("n0") == 10
+
+    got = []
+    done = h.replay("n0", lambda topic, env: got.append((topic, env["seq"])))
+    assert done == 10
+    assert [seq for _t, seq in got] == list(range(10))
+    assert [t for t, _s in got[:4]] == [
+        "topic-0", "topic-1", "topic-0", "topic-1"
+    ]
+    assert h.pending("n0") == 0
+    # the drained spool file is gone, not an empty stub
+    assert not (tmp_path / "n0.spool").exists()
+
+
+def test_size_cap_drops_oldest_half(tmp_path):
+    line = json.dumps({"topic": "t", "envelope": _env(0)}) + "\n"
+    # cap sized to ~8 entries: the 9th append trips the cap first
+    h = HandoffController(tmp_path, max_bytes_per_node=len(line) * 8)
+    for i in range(9):
+        h.spool("n0", "t", _env(i))
+    # at the capped append, 8 entries were on disk -> oldest 4 dropped,
+    # the new entry appended: newest survive, oldest are gone
+    got = []
+    h.replay("n0", lambda topic, env: got.append(env["seq"]))
+    seqs = got
+    assert seqs == [4, 5, 6, 7, 8], seqs
+
+
+def test_partially_failed_replay_keeps_tail_and_retries(tmp_path):
+    h = HandoffController(tmp_path)
+    for i in range(6):
+        h.spool("n0", "t", _env(i))
+
+    boom_at = 3
+    delivered = []
+
+    def flaky(topic, env):
+        if env["seq"] == boom_at:
+            raise RuntimeError("still down")
+        delivered.append(env["seq"])
+
+    done = h.replay("n0", flaky)
+    # stops AT the first failure to preserve order; nothing past it ran
+    assert done == 3 and delivered == [0, 1, 2]
+    assert h.pending("n0") == 3  # the failed entry and everything after
+
+    # next probe retries from the failed entry, in order
+    done = h.replay("n0", lambda t, e: delivered.append(e["seq"]))
+    assert done == 3 and delivered == [0, 1, 2, 3, 4, 5]
+    assert h.pending("n0") == 0
+
+
+def test_per_node_spools_are_independent(tmp_path):
+    h = HandoffController(tmp_path)
+    h.spool("n0", "t", _env(0))
+    h.spool("n1", "t", _env(1))
+    got = []
+    h.replay("n0", lambda t, e: got.append(e["seq"]))
+    assert got == [0] and h.pending("n1") == 1
+
+
+def test_torn_tail_repaired_before_next_append(tmp_path):
+    """A crash mid-append leaves a half-written record; the NEXT append
+    must not merge with it, and replay drops only the torn record."""
+    h = HandoffController(tmp_path)
+    h.spool("n0", "t", _env(0))
+    path = tmp_path / "n0.spool"
+    # simulate the torn write: chop the final newline and half the line
+    raw = path.read_bytes()
+    path.write_bytes(raw + b'{"topic": "t", "enve')
+    h.spool("n0", "t", _env(2))
+
+    got = []
+    done = h.replay("n0", lambda t, e: got.append(e["seq"]))
+    assert got == [0, 2] and done == 3
+    assert h.pending("n0") == 0
+
+
+def test_concurrent_spool_during_replay_is_preserved(tmp_path):
+    """Entries spooled WHILE a replay is delivering (writes failing over
+    on another thread) must survive the replay's spool rewrite."""
+    h = HandoffController(tmp_path)
+    for i in range(3):
+        h.spool("n0", "t", _env(i))
+
+    got = []
+
+    def deliver(topic, env):
+        if env["seq"] == 1:
+            # a write-path thread spools a new miss mid-replay
+            h.spool("n0", "t", _env(99))
+        got.append(env["seq"])
+
+    done = h.replay("n0", deliver)
+    assert done == 3 and got == [0, 1, 2]
+    # the concurrently spooled entry is still pending, not clobbered
+    assert h.pending("n0") == 1
+    tail = []
+    h.replay("n0", lambda t, e: tail.append(e["seq"]))
+    assert tail == [99]

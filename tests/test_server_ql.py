@@ -56,6 +56,64 @@ def test_ql_errors():
         bydbql.parse("SELECT sum(v), count(v) FROM MEASURE m IN g")
 
 
+def test_ql_grouped_select_of_field_name(tmp_path):
+    """ADVICE r5: bydbql puts the SELECT list into BOTH projections, so
+    a grouped `SELECT svc, value ... GROUP BY svc` names a schema FIELD
+    in tag_projection — the rep-tags loop must skip it, not KeyError."""
+    from banyandb_tpu.api import (
+        Catalog,
+        DataPointValue,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        Measure,
+        ResourceOpts,
+        SchemaRegistry,
+        TagSpec,
+        TagType,
+        WriteRequest,
+    )
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(Group("g", Catalog.MEASURE, ResourceOpts(shard_num=1)))
+    reg.create_measure(
+        Measure(
+            group="g", name="m",
+            tags=(TagSpec("svc", TagType.STRING),
+                  TagSpec("region", TagType.STRING)),
+            fields=(FieldSpec("value", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+    eng = MeasureEngine(reg, tmp_path / "data")
+    eng.write(WriteRequest("g", "m", tuple(
+        DataPointValue(
+            ts_millis=T0 + i, tags={"svc": f"s{i % 3}", "region": "eu"},
+            fields={"value": float(i)}, version=1,
+        )
+        for i in range(30)
+    )))
+    eng.flush()
+
+    req = bydbql.parse(
+        "SELECT svc, value FROM MEASURE m IN g "
+        f"TIME BETWEEN {T0} AND {T0 + 1000} GROUP BY svc"
+    )
+    assert "value" in req.tag_projection  # the shape that used to crash
+    res = eng.query(req)  # must not raise KeyError('value')
+    assert {g[0] for g in res.groups} == {"s0", "s1", "s2"}
+
+    # aggregated variant with a projected field name rides through too
+    req = bydbql.parse(
+        "SELECT svc, sum(value) FROM MEASURE m IN g "
+        f"TIME BETWEEN {T0} AND {T0 + 1000} GROUP BY svc"
+    )
+    res = eng.query(req)
+    assert sum(res.values["sum(value)"]) == sum(range(30))
+
+
 T0 = 1_700_000_000_000
 
 

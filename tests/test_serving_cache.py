@@ -239,3 +239,30 @@ def test_dict_codes_stable_across_queries(engine):
     s2 = dict(zip([g[0] for g in r2.groups], r2.values["sum(lat)"]))
     for k, v in s1.items():
         assert abs(s2[k] - v) <= abs(v) * 1e-5 + 1e-3
+
+
+def test_partials_cache_keyed_by_rep_tags(engine):
+    """ADVICE r5: two queries with identical plan + predicate values but
+    different projected-not-grouped tag sets must NOT share a partials
+    cache entry — the projecting query would be served rep_vals=None
+    (its projected tag silently missing from every group row)."""
+    # warm the cache with the projection-free shape
+    r1 = engine.query(_req())
+    assert not r1.rep_tags
+
+    # same filter/group/agg, now projecting a non-grouped tag: the
+    # representative values must materialize, not come back empty from
+    # the projection-free entry
+    r2 = engine.query(_req(tag_projection=("svc", "region")))
+    assert "region" in r2.rep_tags
+    assert len(r2.rep_tags["region"]) == len(r2.groups)
+    assert all(v == "eu" for v in r2.rep_tags["region"])
+
+    # and the reverse order on a fresh filter value: projection first,
+    # then projection-free — the latter must not inherit rep state
+    crit = Condition("region", "in", ("eu", "nowhere"))
+    r3 = engine.query(_req(criteria=crit, tag_projection=("svc", "region")))
+    assert "region" in r3.rep_tags
+    r4 = engine.query(_req(criteria=crit))
+    assert not r4.rep_tags
+    assert r3.groups == r4.groups

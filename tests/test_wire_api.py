@@ -546,3 +546,140 @@ def test_bydbql_trace_custom_id_tag(server_full):
     ))
     assert resp.trace_result.traces[0].trace_id == "x1"
     assert len(resp.trace_result.traces[0].spans) == 1
+
+
+# -- ADVICE r5 regressions ---------------------------------------------------
+
+
+def _mk_measure(registry, group, n_rows, ts_start, step):
+    from banyandb_tpu.api import (
+        Catalog,
+        DataPointValue,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        Measure,
+        ResourceOpts,
+        TagSpec,
+        TagType,
+    )
+
+    registry.create_group(
+        Group(group, Catalog.MEASURE, ResourceOpts(shard_num=1))
+    )
+    registry.create_measure(
+        Measure(
+            group=group, name="m",
+            tags=(TagSpec("svc", TagType.STRING),),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+    return tuple(
+        DataPointValue(
+            ts_millis=ts_start + i * step, tags={"svc": "s"},
+            fields={"v": float(i)}, version=1,
+        )
+        for i in range(n_rows)
+    )
+
+
+def test_cross_group_merge_pagination_past_first_page(tmp_path):
+    """ADVICE r5: sub-queries ran with the ORIGINAL limit and offset=0,
+    so a merged page past the first could need offset+limit rows from
+    one group and come back short/wrong.  Pages must slice the globally
+    merged stream exactly."""
+    from banyandb_tpu.api import WriteRequest
+    from banyandb_tpu.api.model import QueryRequest, TimeRange
+
+    registry = SchemaRegistry(tmp_path)
+    measure = MeasureEngine(registry, tmp_path / "data")
+    # g1 owns even timestamps, g2 odd: the merged stream interleaves
+    pts1 = _mk_measure(registry, "g1", 20, T0, 2)
+    pts2 = _mk_measure(registry, "g2", 20, T0 + 1, 2)
+    measure.write(WriteRequest("g1", "m", pts1))
+    measure.write(WriteRequest("g2", "m", pts2))
+    measure.flush()
+    svc = WireServices(registry, measure, StreamEngine(registry, tmp_path / "data"))
+
+    def page(offset, limit):
+        ireq = QueryRequest(
+            groups=("g1", "g2"), name="m",
+            time_range=TimeRange(T0, T0 + 10_000),
+            offset=offset, limit=limit,
+        )
+        out = svc._measure_query_multi_group(ireq)
+        return [
+            dp.timestamp.seconds * 1000 + dp.timestamp.nanos // 1_000_000
+            for dp in out.data_points
+        ]
+
+    # page 3 of 5-row pages = globally merged rows 10..14
+    assert page(10, 5) == [T0 + 10, T0 + 11, T0 + 12, T0 + 13, T0 + 14]
+    # deep page wholly beyond one group's own first `limit` rows
+    assert page(30, 5) == [T0 + 30, T0 + 31, T0 + 32, T0 + 33, T0 + 34]
+    # pagination is consistent: pages tile the merged stream
+    assert page(0, 40) == page(0, 10) + page(10, 10) + page(20, 10) + page(30, 10)
+
+
+def test_topn_unknown_condition_op_rejected(tmp_path):
+    """ADVICE r5: an unknown wire condition op (e.g. a future enum value)
+    must be INVALID_ARGUMENT, not silently treated as eq."""
+    import grpc as _grpc
+
+    from banyandb_tpu.api import WriteRequest
+    from banyandb_tpu.api.schema import TopNAggregation
+
+    registry = SchemaRegistry(tmp_path)
+    measure = MeasureEngine(registry, tmp_path / "data")
+    measure.write(WriteRequest("g1", "m", _mk_measure(registry, "g1", 5, T0, 1)))
+    registry.create_topn(TopNAggregation(
+        group="g1", name="top_m", source_measure="m", field_name="v",
+        group_by_tag_names=("svc",),
+    ))
+    svc = WireServices(registry, measure, StreamEngine(registry, tmp_path / "data"))
+
+    class _Abort(Exception):
+        pass
+
+    class _Ctx:
+        code = None
+        details = None
+
+        def abort(self, code, details):
+            self.code, self.details = code, details
+            raise _Abort(details)
+
+    req = pb.measure_topn_pb2.TopNRequest(groups=["g1"], name="top_m")
+    req.time_range.begin.CopyFrom(pb.measure_query_pb2.QueryRequest().time_range.begin.__class__(seconds=T0 // 1000))
+    req.time_range.end.CopyFrom(req.time_range.begin.__class__(seconds=T0 // 1000 + 10))
+    cond = req.conditions.add()
+    cond.name = "svc"
+    cond.op = 99  # not a known BinaryOp
+    cond.value.str.value = "s"
+
+    ctx = _Ctx()
+    with pytest.raises(_Abort, match="unknown TopN condition op 99"):
+        svc.measure_topn(req, ctx)
+    assert ctx.code == _grpc.StatusCode.INVALID_ARGUMENT
+
+    # a MAPPED but unsupported op (lt) still gets the explicit message
+    cond.op = 3
+    ctx = _Ctx()
+    with pytest.raises(_Abort, match="not supported"):
+        svc.measure_topn(req, ctx)
+
+
+def test_criteria_unknown_condition_op_rejected():
+    """The shared criteria decoder (wire.criteria_to_internal) rejects
+    unknown wire ops instead of silently filtering with eq — same
+    contract as the TopN fix above."""
+    from banyandb_tpu.api import wire
+
+    crit = pb.model_query_pb2.Criteria()
+    crit.condition.name = "svc"
+    crit.condition.op = 99
+    crit.condition.value.str.value = "s"
+    with pytest.raises(ValueError, match="unknown condition op 99"):
+        wire.criteria_to_internal(crit)
