@@ -57,9 +57,9 @@ def kernel_entries():
     shard_map mesh-variant step from parallel/dist_exec."""
     from banyandb_tpu.lint.whole_program.plan_audit import default_entries
 
-    from banyandb_tpu.lint.kernel.lowering import mesh_entry
+    from banyandb_tpu.lint.kernel.lowering import fused_mesh_entry, mesh_entry
 
-    return list(default_entries()) + [mesh_entry()]
+    return list(default_entries()) + [mesh_entry(), fused_mesh_entry()]
 
 
 def stored_entries(registry=None, limit: int = 16):
@@ -78,7 +78,12 @@ def stored_entries(registry=None, limit: int = 16):
         KernelAudit,
         _rel_path,
     )
-    from banyandb_tpu.query import measure_exec, precompile, stream_exec
+    from banyandb_tpu.query import (
+        fused_exec,
+        measure_exec,
+        precompile,
+        stream_exec,
+    )
 
     if registry is None:
         registry = precompile.default_registry()
@@ -96,6 +101,16 @@ def stored_entries(registry=None, limit: int = 16):
                     S((), jnp.float32),
                 )
                 anchor = measure_exec._build_kernel
+            elif kind == "fused":
+                mod = fused_exec
+                fn = fused_exec._build_kernel(spec)
+                args = (
+                    precompile.fused_chunk_struct(spec),
+                    precompile.pred_struct(spec.plan),
+                    S((), jnp.float32),
+                    S((), jnp.float32),
+                )
+                anchor = fused_exec._build_kernel
             elif kind == "stream_mask":
                 mod = stream_exec
                 fn = stream_exec._build_kernel(spec)

@@ -119,7 +119,12 @@ def stub_device():
     import jax
     import jax.numpy as jnp
 
-    from banyandb_tpu.query import measure_exec, precompile, stream_exec
+    from banyandb_tpu.query import (
+        fused_exec,
+        measure_exec,
+        precompile,
+        stream_exec,
+    )
 
     counters = Counters()
     real_get = jax.device_get
@@ -140,6 +145,8 @@ def stub_device():
         measure_exec._build_kernel,
         stream_exec._KERNEL_CACHE,
         stream_exec._build_kernel,
+        fused_exec._KERNEL_CACHE,
+        fused_exec._build_kernel,
         precompile.default_registry,
     )
     throwaway = precompile.PrecompileRegistry()
@@ -151,6 +158,10 @@ def stub_device():
         stream_exec._KERNEL_CACHE = {}
         stream_exec._build_kernel = _stub_builder(
             saved[3], counters, "stream_mask"
+        )
+        fused_exec._KERNEL_CACHE = {}
+        fused_exec._build_kernel = _stub_builder(
+            saved[5], counters, "fused"
         )
         precompile.default_registry = lambda: throwaway
         jax.device_get = counting_get
@@ -164,6 +175,8 @@ def stub_device():
             measure_exec._build_kernel,
             stream_exec._KERNEL_CACHE,
             stream_exec._build_kernel,
+            fused_exec._KERNEL_CACHE,
+            fused_exec._build_kernel,
             precompile.default_registry,
         ) = saved
 
@@ -369,6 +382,47 @@ def _measure_scenarios():
     ]
 
 
+def _multichunk_scenario():
+    """fused/multi-chunk: a part-batch spanning SEVERAL scan chunks must
+    still cost exactly ONE dispatch and ONE batched get on the fused
+    path — the tripwire that fails CI the moment per-chunk staging
+    creeps back into the fused executor."""
+    from banyandb_tpu.api.model import QueryRequest, TimeRange
+    from banyandb_tpu.api.schema import FieldType, TagType
+
+    def run():
+        from banyandb_tpu.query import measure_exec
+        from banyandb_tpu.query.measure_exec import compute_partials
+
+        n = 8192
+        rng = np.random.default_rng(5)
+        m = _measure_schema(
+            [("svc", TagType.STRING)], [("v", FieldType.INT)]
+        )
+        src = _source(
+            n,
+            1,
+            {
+                "svc": (
+                    [b"s%04d" % i for i in range(4)],
+                    rng.integers(0, 4, n).astype(np.int32),
+                )
+            },
+            {"v": rng.integers(0, 100, n).astype(np.float64)},
+        )
+        req = QueryRequest(
+            ("g",), "m", TimeRange(T0, T0 + n), field_projection=("v",)
+        )
+        saved = measure_exec.SCAN_CHUNK
+        measure_exec.SCAN_CHUNK = 4096  # n=8192 -> a 2-chunk part-batch
+        try:
+            compute_partials(m, req, [src])
+        finally:
+            measure_exec.SCAN_CHUNK = saved
+
+    return run
+
+
 def _stream_scenario():
     from banyandb_tpu.api.model import Condition
     from banyandb_tpu.query import precompile, stream_exec
@@ -453,22 +507,57 @@ def _anchor(kind: str) -> tuple[str, int]:
     return _rel_path(inspect.getsourcefile(mod)), inspect.getsourcelines(fn)[1]
 
 
+@contextlib.contextmanager
+def _env(name: str, value: Optional[str]):
+    """Scoped os.environ override (None = leave the ambient value)."""
+    import os
+
+    if value is None:
+        yield
+        return
+    saved = os.environ.get(name)
+    os.environ[name] = value
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = saved
+
+
 def audit_dispatch() -> dict[str, DispatchTrace]:
-    """Run every scenario under the stub device -> measured traces."""
+    """Run every scenario under the stub device -> measured traces.
+
+    Each measure scenario runs TWICE: once with ``BYDB_FUSED=0`` (the
+    staged per-chunk loop, the ``measure/*`` rows) and once with the
+    fused whole-plan executor on (the ``fused/*`` rows, pinned to the
+    precompile registry's builtin FusedSpecs at dispatches=1/gets=1),
+    plus the multi-chunk staging tripwire."""
+    from banyandb_tpu.query import precompile
+
     scenarios = [
-        (name, "measure", builtin, run)
+        (name, "measure", builtin, run, "0")
         for name, builtin, run in _measure_scenarios()
     ]
+    fused_builtins = dict(precompile.builtin_fused())
+    for name, _builtin, run in _measure_scenarios():
+        fname = name.replace("measure/", "fused/")
+        scenarios.append((fname, "measure", fused_builtins[fname], run, "1"))
+    scenarios.append(
+        ("fused/multi-chunk", "measure", None, _multichunk_scenario(), "1")
+    )
     s_name, s_builtin, s_run = _stream_scenario()
-    scenarios.append((s_name, "stream_mask", s_builtin, s_run))
+    scenarios.append((s_name, "stream_mask", s_builtin, s_run, None))
     scenarios += [
-        (name, "ql", builtin, run) for name, builtin, run in _ql_scenarios()
+        (name, "ql", builtin, run, None)
+        for name, builtin, run in _ql_scenarios()
     ]
 
     out: dict[str, DispatchTrace] = {}
-    for name, kind, builtin, run in scenarios:
+    for name, kind, builtin, run, fused_env in scenarios:
         path, line = _anchor(kind)
-        with stub_device() as counters:
+        with stub_device() as counters, _env("BYDB_FUSED", fused_env):
             error = ""
             try:
                 run()

@@ -80,6 +80,22 @@ BUDGETS: dict[str, KernelBudget] = {
     "measure/percentile-hist": _b(1, 1, 6, 4, 24, 4, 0),
     "measure/or-expr":         _b(1, 1, 7, 4, 20, 3, 0),
     "measure/topn-dashboard":  _b(1, 1, 7, 4, 22, 4, 0),
+    # fused whole-plan twins (query/fused_exec): ONE dispatch + ONE
+    # batched get per part-batch regardless of chunk count — the
+    # executor's raison d'être, ratcheted so staging can never creep
+    # back; puts stay the staged column count (stacked ships).
+    "fused/flat-count":        _b(1, 1, 5, 4, 19, 4, 0),
+    "fused/group-eq-lut":      _b(1, 1, 8, 4, 22, 5, 0),
+    "fused/percentile-hist":   _b(1, 1, 6, 4, 24, 4, 0),
+    "fused/or-expr":           _b(1, 1, 7, 4, 20, 3, 0),
+    "fused/topn-dashboard":    _b(1, 1, 7, 4, 23, 5, 0),
+    # the staging tripwire: a 2-chunk part-batch, still 1 dispatch/get
+    # (dispatch columns only: the bucket is synthesized per run, so it
+    # has no standing jaxpr/lowering entry)
+    "fused/multi-chunk":       _b(1, 1, 5),
+    # fused chunked-scan mesh step: the whole distributed scan as one
+    # collective program, SAME psum(count/sums)+pmin+pmax set
+    "fused/dist-step":         _b(widest=4, bytes_class=16, fusion_class=4, collectives=4),
     # stream retrieval mask: whole bool mask in one get
     "stream/mask-eq-in":       _b(1, 1, 3, 4, 19, 1, 0),
     # shared ops reductions every plan lowers onto (no executor path of

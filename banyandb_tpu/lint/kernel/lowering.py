@@ -114,6 +114,79 @@ def mesh_entry():
     )
 
 
+def fused_mesh_entry():
+    """The fused chunked-scan collective program
+    (query/fused_exec.build_fused_dist_step) at a 2-chunk bucket,
+    lowered over a single CPU device: the whole distributed scan is ONE
+    program carrying exactly the staged mesh step's psum/pmin/pmax set —
+    a collective-count change here means the fused path altered the
+    cross-shard combine plan."""
+    import inspect
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from banyandb_tpu.lint.whole_program.plan_audit import (
+        KernelAudit,
+        _rel_path,
+    )
+    from banyandb_tpu.parallel import dist_exec
+    from banyandb_tpu.parallel import mesh as pmesh
+    from banyandb_tpu.query import fused_exec
+
+    plan = dist_exec.DistPlan(
+        tags_code=("svc",),
+        fields=("v",),
+        group_tags=("svc",),
+        radices=(16,),
+        num_groups=16,
+        topn=4,
+    )
+    num_chunks = 2
+    mesh = pmesh.make_mesh(1)
+    if hasattr(jax, "shard_map"):
+        _shard_map = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    from jax.sharding import PartitionSpec as P
+
+    data_spec = P(("shard", "seg"))
+    step = _shard_map(
+        partial(fused_exec._fused_dist_step, plan, num_chunks),
+        mesh=mesh,
+        in_specs=(
+            {
+                "valid": data_spec,
+                "tags": {"svc": data_spec},
+                "fields": {"v": data_spec},
+            },
+            {},
+            P(),
+            P(),
+        ),
+        out_specs=dist_exec._out_specs(plan),
+    )
+    S = jax.ShapeDtypeStruct
+    n = num_chunks * 1024
+    return KernelAudit(
+        name="fused/dist-step",
+        path=_rel_path(inspect.getsourcefile(fused_exec)),
+        line=inspect.getsourcelines(fused_exec._fused_dist_step)[1],
+        fn=jax.jit(step),
+        args=(
+            {
+                "valid": S((1, n), jnp.bool_),
+                "tags": {"svc": S((1, n), jnp.int32)},
+                "fields": {"v": S((1, n), jnp.float32)},
+            },
+            {},
+            S((), jnp.float32),
+            S((), jnp.float32),
+        ),
+    )
+
+
 def lower_entry(entry):
     """-> (lowered, compiled) for one audit entry, CPU backend."""
     import jax

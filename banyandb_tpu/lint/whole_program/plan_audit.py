@@ -293,6 +293,35 @@ def default_entries() -> list[KernelAudit]:
             )
         )
 
+    # the fused whole-plan twins: same contract per chunk, stacked
+    # [num_chunks, ...] outputs (one dispatch/one get per part-batch is
+    # the kernel-dispatch half; here the shape/dtype contract is pinned)
+    from banyandb_tpu.query import fused_exec
+
+    fpath = _rel_path(inspect.getsourcefile(fused_exec))
+    fline = inspect.getsourcelines(fused_exec._build_kernel)[1]
+    for name, fspec in precompile.builtin_fused():
+        fexpect = {
+            key: (dtype, (fspec.num_chunks,) + shape)
+            for key, (dtype, shape) in base_expect(fspec.plan).items()
+        }
+        entries.append(
+            KernelAudit(
+                name=name,
+                path=str(fpath),
+                line=fline,
+                fn=fused_exec._build_kernel(fspec),
+                args=(
+                    precompile.fused_chunk_struct(fspec),
+                    precompile.pred_struct(fspec.plan),
+                    S((), f32),
+                    S((), f32),
+                ),
+                expect=fexpect,
+                cache_key=fspec,
+            )
+        )
+
     # 6. the shared ops reductions every plan lowers onto, at a
     # representative grouped shape (method dispatch goes through "auto")
     opath = _rel_path(inspect.getsourcefile(ops.groupby))

@@ -106,6 +106,45 @@ def stage_breakdown(
     return out
 
 
+def stage_breakdown_delta(
+    before: str,
+    after: str,
+    metric: str = "banyandb_query_stage_ms",
+    quantiles: tuple[float, ...] = (0.5, 0.99),
+) -> dict[str, dict]:
+    """Per-stage attribution of ONLY the window between two scrapes.
+
+    Cumulative bucket counts are diffed per (stage, le) so one phase of
+    a run — e.g. each leg of the bench's fused-vs-staged A/B — gets its
+    own quantiles instead of the process-lifetime aggregate."""
+    prior = histogram_series(before, metric)
+    out: dict[str, dict] = {}
+    for key, entry in histogram_series(after, metric).items():
+        stage = dict(key).get("stage")
+        if stage is None:
+            continue
+        base = prior.get(key)
+        buckets = entry["buckets"]
+        count = entry["count"]
+        total = entry["sum"]
+        if base is not None:
+            base_map = dict(base["buckets"])
+            buckets = [
+                (bound, max(cum - base_map.get(bound, 0.0), 0.0))
+                for bound, cum in buckets
+            ]
+            count = entry["count"] - base["count"]
+            total = entry["sum"] - base["sum"]
+        if count <= 0:
+            continue
+        window = {"buckets": buckets, "count": count, "sum": total}
+        rec: dict = {"count": count}
+        for q in quantiles:
+            rec[f"p{int(q * 100)}_ms"] = round(quantile(window, q), 3)
+        out[stage] = rec
+    return out
+
+
 def gauge_value(text: str, metric: str, labels: Optional[dict] = None):
     """First sample matching metric (+ label subset), or None."""
     want = labels or {}

@@ -14,10 +14,15 @@ of dictionary sizes.  Aggregation is then a dense segment reduction:
   (docs/tpu_measurements.md) so ``auto`` never picks it.
 - ``pallas``: the hand-tiled Pallas kernel (ops.pallas_kernels) for
   count/sums; min/max still ride XLA scatter.
+- ``sort``: segment-sort grouping — stable sort by key, then the same
+  bounded-span scatter reduction over now-contiguous group runs.  The
+  high-radix regime of the hash-vs-sort crossover (arXiv 2411.13245).
 
-All produce identical results; ``method="auto"`` picks per shape and
-backend from the measured crossovers (TPU: pallas for bounded group
-counts, else scatter; off-TPU: matmul for small operands, else scatter).
+All produce identical results; ``method="auto"`` resolves through
+``select_group_method`` per shape and backend from the measured
+crossovers (sort above SORT_GROUPS_THRESHOLD groups on any backend;
+below it TPU: pallas for bounded group counts, else scatter; off-TPU:
+matmul for small operands, else scatter).
 
 Precision contract (tested by tests/test_precision.py): per-group sums
 accumulate in f32 *within* a bounded row tile (<= 65536 rows for scatter,
@@ -134,24 +139,81 @@ def _kahan_tiled_reduce(
     return count, sums
 
 
-def _pick_method(nrows: int, num_groups: int) -> str:
-    # Measured on a real v5e-1 (2026-07-29, docs/tpu_measurements.md): the
-    # Pallas kernel is best-or-equal at every (N, G) tried — 11.3 Grows/s
-    # at N=2^23 standalone vs 5.8 for one-shot matmul (which also OOMs
-    # once N*(G+1) f32 exceeds HBM) and ~15 Mrows/s for eager scatter /
-    # matmul_tiled, which drown in per-op dispatch.  Inside a fused jit
-    # XLA's scatter reaches HBM bandwidth too, but pallas never loses, so
-    # TPU always takes it (group-tiled: any G compiles).  Off-TPU, pallas
-    # only interprets; one-hot matmul wins small operands, scatter the
-    # rest (measured 35x over matmul_tiled on CPU, BENCH_r02).
+# High-radix crossover for hash- vs sort-based grouping.  The empirical
+# study arXiv 2411.13245 finds scatter-style hash grouping wins while the
+# per-group accumulator table stays cache/VMEM-resident (low-radix
+# dictionary keys) and segment-sort grouping wins once the table spills
+# (high-radix or unknown-cardinality keys): sorted runs stream memory
+# sequentially instead of scattering over a huge [G] table.
+SORT_GROUPS_THRESHOLD = 1 << 16
+
+
+def select_group_method(nrows: int, num_groups: int) -> str:
+    """Per-signature group-by strategy (the ``method="auto"`` policy).
+
+    Both the staged and the fused whole-plan executor resolve through
+    this ONE function from the same (nrows, num_groups) signature
+    fields, so an A/B flip can never pair different reduction orders —
+    and the ``sort`` path is stable-sorted, keeping per-group
+    accumulation in row order (bit-identical to ``scatter``).
+
+    Measured on a real v5e-1 (2026-07-29, docs/tpu_measurements.md): the
+    Pallas kernel is best-or-equal at every (N, G) tried — 11.3 Grows/s
+    at N=2^23 standalone vs 5.8 for one-shot matmul (which also OOMs
+    once N*(G+1) f32 exceeds HBM) and ~15 Mrows/s for eager scatter /
+    matmul_tiled, which drown in per-op dispatch.  Inside a fused jit
+    XLA's scatter reaches HBM bandwidth too, but pallas never loses, so
+    TPU takes it for bounded group counts (4 group tiles at GTILE=2048:
+    each extra tile re-streams the whole input from HBM).  Off-TPU,
+    pallas only interprets; one-hot matmul wins small operands.  Above
+    SORT_GROUPS_THRESHOLD groups (either backend) the accumulator table
+    no longer fits close storage and segment-sort grouping takes over
+    per the 2411.13245 crossover.
+    """
+    if num_groups > SORT_GROUPS_THRESHOLD:
+        return "sort"
     if jax.default_backend() == "tpu" and num_groups <= 4 * 2048:
-        # bounded at 4 group tiles (GTILE=2048): each extra tile
-        # re-streams the whole input from HBM, so huge-G workloads fall
-        # back to one-pass scatter (roofline-bound inside a fused jit)
         return "pallas"
     if num_groups <= 4096 and nrows * (num_groups + 1) <= 2**25:
         return "matmul"
     return "scatter"
+
+
+# back-compat alias (pre-fused-executor name)
+_pick_method = select_group_method
+
+
+def _scatter_reduce(
+    safe_key: jax.Array,
+    validf: jax.Array,
+    masked_fields: Mapping[str, jax.Array],
+    num_groups: int,
+):
+    """count/sums via XLA scatter, Kahan-tiled beyond the span bound.
+
+    Shared by the hash (``scatter``) and segment-sort (``sort``) paths:
+    fields arrive pre-masked (col * validf), rows beyond the span bound
+    combine with Kahan-compensated f32 (precision contract above).
+    """
+    seg = jax.ops.segment_sum
+    CHUNK = 65536
+    if safe_key.shape[-1] <= CHUNK:
+        count = seg(validf, safe_key, num_segments=num_groups + 1)[:num_groups]
+        sums = {
+            name: seg(col, safe_key, num_segments=num_groups + 1)[:num_groups]
+            for name, col in masked_fields.items()
+        }
+        return count, sums
+
+    def sc_partial(k_t, v_t, f_t):
+        return [seg(v_t, k_t, num_segments=num_groups + 1)] + [
+            seg(f_t[i], k_t, num_segments=num_groups + 1)
+            for i in range(f_t.shape[0])
+        ]
+
+    return _kahan_tiled_reduce(
+        safe_key, validf, masked_fields, num_groups, CHUNK, sc_partial
+    )
 
 
 def group_reduce(
@@ -169,7 +231,7 @@ def group_reduce(
     so padding never pollutes real groups.
     """
     if method == "auto":
-        method = _pick_method(key.shape[-1], num_groups)
+        method = select_group_method(key.shape[-1], num_groups)
 
     validf = valid.astype(jnp.float32)
     safe_key = jnp.where(valid, key, jnp.int32(num_groups))
@@ -207,33 +269,26 @@ def group_reduce(
             mm_partial,
         )
     elif method == "scatter":
-        seg = jax.ops.segment_sum
-        CHUNK = 65536
-        if safe_key.shape[-1] <= CHUNK:
-            count = seg(validf, safe_key, num_segments=num_groups + 1)[:num_groups]
-            sums = {
-                name: seg(col * validf, safe_key, num_segments=num_groups + 1)[
-                    :num_groups
-                ]
-                for name, col in fields.items()
-            }
-        else:
-            # Bound the f32 accumulation span: per-chunk scatter partials,
-            # Kahan-combined across chunks (precision contract above).
-            def sc_partial(k_t, v_t, f_t):
-                return [seg(v_t, k_t, num_segments=num_groups + 1)] + [
-                    seg(f_t[i], k_t, num_segments=num_groups + 1)
-                    for i in range(f_t.shape[0])
-                ]
-
-            count, sums = _kahan_tiled_reduce(
-                safe_key,
-                validf,
-                {nm: col * validf for nm, col in fields.items()},
-                num_groups,
-                CHUNK,
-                sc_partial,
-            )
+        count, sums = _scatter_reduce(
+            safe_key,
+            validf,
+            {nm: col * validf for nm, col in fields.items()},
+            num_groups,
+        )
+    elif method == "sort":
+        # Segment-sort grouping (the 2411.13245 high-radix regime): a
+        # STABLE sort by group key makes every group a contiguous run,
+        # so the reduction streams memory sequentially instead of
+        # scattering over a [G] table that no longer fits close storage.
+        # Stability keeps per-group accumulation in row order — within
+        # the span bound the result is bit-identical to the hash path.
+        order = jnp.argsort(safe_key, stable=True)
+        count, sums = _scatter_reduce(
+            safe_key[order],
+            validf[order],
+            {nm: (col * validf)[order] for nm, col in fields.items()},
+            num_groups,
+        )
     elif method == "pallas":
         # Hand-tiled kernel: one pass computes count + ALL field sums
         # (compiled on TPU, interpret elsewhere); min/max below still

@@ -45,13 +45,12 @@ class DistPlan:
     want_hist: str = ""  # field name for percentile histograms
 
 
-def _step(plan: DistPlan, chunk: dict, pred_codes: dict, hist_lo, hist_span):
-    """One device's slice -> partials -> collectives -> result.
-
-    shard_map hands each device a [1, R] view of the sharded [D, R] input;
-    flatten to [R] so segment reductions see a flat row axis.
-    """
-    chunk = jax.tree.map(lambda a: a.reshape(-1), chunk)
+def map_chunk(plan: DistPlan, chunk: dict, pred_codes: dict):
+    """The map half of one device chunk: mask -> group key -> segment
+    reduce.  -> (GroupReduceResult, key, mask).  Shared verbatim by the
+    legacy single-width step below and the fused chunked-scan step
+    (query/fused_exec._fused_dist_step), so the two mesh programs cannot
+    drift on predicate/key/reduction semantics."""
     valid = chunk["valid"]
     masks = [valid]
     for t in plan.eq_preds:
@@ -67,6 +66,17 @@ def _step(plan: DistPlan, chunk: dict, pred_codes: dict, hist_lo, hist_span):
     res = ops.group_reduce(
         key, mask, chunk["fields"], plan.num_groups, want_minmax=True
     )
+    return res, key, mask
+
+
+def _step(plan: DistPlan, chunk: dict, pred_codes: dict, hist_lo, hist_span):
+    """One device's slice -> partials -> collectives -> result.
+
+    shard_map hands each device a [1, R] view of the sharded [D, R] input;
+    flatten to [R] so segment reductions see a flat row axis.
+    """
+    chunk = jax.tree.map(lambda a: a.reshape(-1), chunk)
+    res, key, mask = map_chunk(plan, chunk, pred_codes)
 
     # ---- the collective reduce: ICI replaces the proto partial hop ----
     axes = ("shard", "seg")

@@ -29,6 +29,11 @@ from banyandb_tpu.query import measure_exec
 
 _MAX_MESH_GROUPS = 1 << 16
 _MIN_CHUNK_ROWS = 256
+# Fused dist path: per-device slices are chunked at this fixed width and
+# scanned inside ONE collective program (query/fused_exec), so the
+# compiled-shape set is bounded instead of one unbounded-width kernel
+# per row-count bucket.
+_FUSED_DIST_CHUNK = 1 << 16
 
 
 class MeshUnsupported(Exception):
@@ -159,7 +164,14 @@ class MeshExecutor:
             for c in conds
         }
 
-        chunks, total = self._pack(plan, per_node_cols)
+        from banyandb_tpu.query import fused_exec
+
+        # read the A/B flag ONCE per query so pack and aggregate can
+        # never disagree mid-flight on the chunk layout
+        use_fused = fused_exec.fused_enabled()
+        chunks, total, num_chunks = self._pack(
+            plan, per_node_cols, use_fused
+        )
         if total == 0:
             empty = self._to_partials(plan, gd, None, want_percentile)
             return measure_exec.finalize_partials(m, req, [empty])
@@ -169,8 +181,8 @@ class MeshExecutor:
         # bdlint: disable=host-sync -- mesh result boundary: the whole
         # replicated pytree moves in one batched transfer
         out = jax.device_get(
-            dist_exec.distributed_aggregate(
-                self.mesh, plan, chunks, pred_codes=pred_codes
+            self._aggregate(
+                plan, chunks, num_chunks, use_fused, pred_codes=pred_codes
             )
         )
         self.executions += 1
@@ -198,10 +210,11 @@ class MeshExecutor:
             )
             # bdlint: disable=host-sync -- second-pass result boundary
             out = jax.device_get(
-                dist_exec.distributed_aggregate(
-                    self.mesh,
+                self._aggregate(
                     hist_plan,
                     chunks,
+                    num_chunks,
+                    use_fused,
                     pred_codes=pred_codes,
                     hist_lo=lo,
                     hist_span=span,
@@ -214,10 +227,54 @@ class MeshExecutor:
             partial = self._to_partials(plan, gd, out, False)
         return measure_exec.finalize_partials(m, req, [partial])
 
+    # -- execution ---------------------------------------------------------
+    def _aggregate(
+        self,
+        plan,
+        chunks,
+        num_chunks,
+        use_fused,
+        pred_codes=None,
+        hist_lo: float = 0.0,
+        hist_span: float = 1.0,
+    ):
+        """One collective reduce over the mesh: the fused chunked-scan
+        step when the A/B flag is on, the legacy single-width step
+        otherwise (both carry the identical psum/pmin/pmax set)."""
+        from banyandb_tpu.parallel import dist_exec
+        from banyandb_tpu.query import fused_exec
+
+        if use_fused:
+            return fused_exec.fused_distributed_aggregate(
+                self.mesh,
+                plan,
+                num_chunks,
+                chunks,
+                pred_codes=pred_codes,
+                hist_lo=hist_lo,
+                hist_span=hist_span,
+            )
+        return dist_exec.distributed_aggregate(
+            self.mesh,
+            plan,
+            chunks,
+            pred_codes=pred_codes,
+            hist_lo=hist_lo,
+            hist_span=hist_span,
+        )
+
     # -- packing -----------------------------------------------------------
-    def _pack(self, plan, per_node_cols):
+    def _pack(self, plan, per_node_cols, use_fused: bool = False):
         """Distribute all (already per-node deduped) rows over the mesh's
-        device slots as [D, nrows] arrays."""
+        device slots as [D, num_chunks * nrows] arrays.
+
+        Legacy (staged) layout is one chunk whose width is the
+        power-of-two bucket of the per-device row count — unbounded as
+        data grows, one XLA compile per new bucket.  The fused layout
+        caps the chunk width at _FUSED_DIST_CHUNK and buckets the CHUNK
+        COUNT instead (scanned on-device inside the one collective
+        program), bounding the compile-shape set; below the cap the two
+        layouts — and their math — are identical."""
         d = int(self.mesh.devices.size)
         if per_node_cols:
             tags = {
@@ -240,6 +297,14 @@ class MeshExecutor:
 
         per = max(math.ceil(total / d) if total else 1, 1)
         nrows = max(1 << (per - 1).bit_length(), _MIN_CHUNK_ROWS)
+        num_chunks = 1
+        if use_fused and nrows > _FUSED_DIST_CHUNK:
+            from banyandb_tpu.query import fused_exec
+
+            num_chunks = fused_exec.chunk_count_bucket(
+                math.ceil(per / _FUSED_DIST_CHUNK)
+            )
+            nrows = _FUSED_DIST_CHUNK
         slots = []
         for i in range(d):
             s, e = i * per, min((i + 1) * per, total)
@@ -252,9 +317,9 @@ class MeshExecutor:
         from banyandb_tpu.parallel import dist_exec
 
         chunks = dist_exec.stack_shard_chunks(
-            self.mesh, slots, plan.tags_code, plan.fields, nrows
+            self.mesh, slots, plan.tags_code, plan.fields, num_chunks * nrows
         )
-        return chunks, total
+        return chunks, total, num_chunks
 
     # -- result shaping ----------------------------------------------------
     @staticmethod

@@ -1,0 +1,436 @@
+"""Fused whole-plan executor: one XLA program per plan signature.
+
+The staged executor (query/measure_exec) dispatches the per-chunk plan
+kernel once per scan chunk with a batched device_get trailing each
+dispatch — N accelerator round-trips per part-batch.  Tailwind (arXiv
+2604.28079) argues the accelerator win comes from compiling the *whole*
+query, not offloading operators; this module is that compiler for the
+measure plan family: filter + group-by + aggregate + the rank inputs
+(TopN metric vectors, percentile histograms) execute as ONE jitted
+program per plan signature, so a part-batch crosses the accelerator
+boundary exactly once — one dispatch in, one batched device_get out.
+
+How parity is guaranteed (the A/B contract, ``BYDB_FUSED=0`` restores
+the staged path):
+
+- the fused program ``lax.scan``s the SAME per-chunk body the staged
+  path jits (``measure_exec._kernel_body``) over a ``[C, nrows]``
+  stacked chunk batch, and returns the per-chunk f32 partials stacked
+  ``[C, ...]`` — the host then folds them into the f64 accumulators in
+  scan order exactly like the staged loop.  Same per-chunk graph, same
+  absorb order => byte-identical partials and results.
+- group-by strategy (hash/scatter vs segment-sort, per arXiv
+  2411.13245) resolves through ``ops.groupby.select_group_method`` from
+  the signature's (nrows, num_groups) in BOTH paths, so an A/B flip can
+  never pair different reduction orders.
+
+Signature lifecycle: the chunk-count bucket rides the jit key
+(``FusedSpec = PlanSpec + num_chunks``, power-of-two buckets keep the
+compiled-shape set finite), every resolution is recorded in the
+precompile registry under kind="fused" (cold starts warm the fused
+kernels), and the bdjit kernel audit pins each builtin fused signature
+to dispatches=1 / gets=1 in ``lint/kernel/kernel_budgets.py`` so
+staging can never silently creep back.
+
+The mesh half (``build_fused_dist_step``) shard_maps the same chunked
+scan over a ('shard','seg') device mesh with the dist-path collectives
+(psum count/sums/hist + pmin/pmax), so a distributed scan is one
+collective program with a BOUNDED compile-shape set instead of one
+unbounded-width kernel per row-count bucket.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from banyandb_tpu.query.measure_exec import PlanSpec, _kernel_body
+from banyandb_tpu.utils.envflag import env_flag, env_int
+
+
+def fused_enabled() -> bool:
+    """The A/B flag: default on, ``BYDB_FUSED=0`` restores the staged
+    per-chunk loop (read per query so operators can flip it live)."""
+    return env_flag("BYDB_FUSED", default=True)
+
+
+def max_fused_mb() -> int:
+    """Device-footprint ceiling for one fused part-batch (stacked input
+    columns + stacked per-chunk partials).  Plans whose one-shot
+    footprint exceeds it (e.g. a huge-G percentile over many chunks,
+    where the stacked [C, G, 512] histogram explodes) fall back to the
+    staged loop instead of OOMing the device."""
+    return env_int("BYDB_FUSED_MAX_MB", 1024)
+
+
+@dataclass(frozen=True)
+class FusedSpec:
+    """Static jit key of one fused program: the plan signature plus the
+    chunk-count bucket the part-batch is stacked into."""
+
+    plan: PlanSpec
+    num_chunks: int
+
+
+def chunk_count_bucket(n_chunks: int) -> int:
+    """Power-of-two chunk-count buckets: the compiled-shape set stays
+    O(log max_chunks); chunks beyond the real count are fully invalid
+    (valid=False everywhere) so absorbing them would be a numeric no-op
+    — the host still only absorbs the real ones."""
+    b = 1
+    while b < n_chunks:
+        b <<= 1
+    return b
+
+
+_KERNEL_CACHE: dict[FusedSpec, object] = {}
+
+
+def _build_kernel(fspec: FusedSpec):
+    """jit the whole-plan program: scan the shared per-chunk body over
+    the stacked chunk axis, emitting stacked per-chunk partials."""
+    body = _kernel_body(fspec.plan)
+
+    def fused(chunks: dict, pred_vals: dict, hist_lo, hist_span):
+        def step(carry, chunk):
+            return carry, body(chunk, pred_vals, hist_lo, hist_span)
+
+        _, stacked = jax.lax.scan(step, None, chunks)
+        return stacked
+
+    return jax.jit(fused)
+
+
+def _num_hist_buckets() -> int:
+    from banyandb_tpu.query import measure_exec
+
+    return measure_exec._NUM_HIST_BUCKETS
+
+
+def estimate_bytes(spec: PlanSpec, num_chunks: int) -> int:
+    """f32/i32 device footprint of one fused part-batch: stacked input
+    columns plus the stacked per-chunk partials pytree."""
+    g = spec.num_groups
+    nf = len(spec.fields)
+    per_chunk_out = g * (1 + nf + (2 * nf if spec.want_minmax else 0))
+    if spec.hist_field:
+        per_chunk_out += g * _num_hist_buckets()
+    if spec.want_rep:
+        per_chunk_out += 2 * g
+    cols = 4 + len(spec.tags_code) + nf  # ts/series/valid/row + tags + fields
+    return 4 * num_chunks * (cols * spec.nrows + per_chunk_out)
+
+
+def eligible(spec: PlanSpec, n_chunks: int) -> bool:
+    """Fused path taken for this part-batch?  Flag + footprint budget."""
+    if n_chunks < 1 or not fused_enabled():
+        return False
+    bucket = chunk_count_bucket(n_chunks)
+    return estimate_bytes(spec, bucket) <= max_fused_mb() * (1 << 20)
+
+
+def _stacked_chunks(
+    cols: dict,
+    spans: list[tuple[int, int]],
+    spec: PlanSpec,
+    num_chunks: int,
+    epoch: int,
+    pad_ship_s: list | None = None,
+) -> dict:
+    """Pad the gathered columns into ``[C, nrows]`` device arrays.
+
+    Chunk layout (per-row dtypes, padding, the epoch-relative int32 ts,
+    the global row index) matches measure_exec._device_chunk exactly —
+    the scan body sees per-chunk inputs identical to the staged
+    kernel's.  Per-column pad work rides the chunk_stream prefetch
+    worker (BYDB_PIPELINE honored) so padding column j+1 overlaps
+    shipping column j.
+    """
+    from banyandb_tpu.storage.chunk_stream import prefetched
+
+    C, nb = num_chunks, spec.nrows
+
+    def pad2(get, dtype):
+        out = np.zeros((C, nb), dtype=dtype)
+        for k, (s, e) in enumerate(spans):
+            out[k, : e - s] = get(s, e)
+        return out
+
+    def valid2():
+        out = np.zeros((C, nb), dtype=bool)
+        for k, (s, e) in enumerate(spans):
+            out[k, : e - s] = True
+        return out
+
+    paths: list[tuple] = [("ts",), ("series",), ("valid",), ("row",)]
+    thunks = [
+        lambda: pad2(lambda s, e: cols["ts"][s:e] - epoch, np.int32),
+        lambda: pad2(lambda s, e: cols["series"][s:e] % (2**31), np.int32),
+        valid2,
+        lambda: pad2(lambda s, e: np.arange(s, e, dtype=np.int32), np.int32),
+    ]
+    for t in spec.tags_code:
+        paths.append(("tags_code", t))
+        thunks.append(
+            lambda t=t: pad2(lambda s, e: cols["tags_code"][t][s:e], np.int32)
+        )
+    for f in spec.fields:
+        paths.append(("fields", f))
+        thunks.append(
+            lambda f=f: pad2(lambda s, e: cols["fields"][f][s:e], np.float32)
+        )
+
+    def timed(fn):
+        def pad_thunk():  # host-side work on the prefetch worker
+            t0 = time.perf_counter()
+            try:
+                return fn()
+            finally:
+                if pad_ship_s is not None:
+                    pad_ship_s.append(time.perf_counter() - t0)
+
+        return pad_thunk
+
+    out: dict = {"tags_code": {}, "fields": {}}
+    for path, arr in zip(
+        paths,
+        prefetched([timed(fn) for fn in thunks], name="bydb-fused-pad"),
+    ):
+        t0 = time.perf_counter()
+        dev = jnp.asarray(arr)
+        if pad_ship_s is not None:
+            pad_ship_s.append(time.perf_counter() - t0)
+        if len(path) == 1:
+            out[path[0]] = dev
+        else:
+            out[path[0]][path[1]] = dev
+    return out
+
+
+def run_fused(
+    chunks_np: dict,
+    chunk_spans: list[tuple[int, int]],
+    spec: PlanSpec,
+    pred_vals: dict,
+    hist_lo,
+    hist_span,
+    epoch: int,
+    *,
+    gather_key=None,
+    dev_cache=None,
+    pad_ship_s: list | None = None,
+) -> tuple[list[dict], float, str]:
+    """Execute one part-batch through the fused program.
+
+    -> (per-chunk host partials in scan order for the staged f64 absorb
+    loop, seconds spent at the two accelerator boundaries, input-cache
+    outcome tag).  Exactly one kernel dispatch and one batched
+    device_get regardless of chunk count.
+    """
+    num_chunks = chunk_count_bucket(len(chunk_spans))
+    fspec = FusedSpec(plan=spec, num_chunks=num_chunks)
+    kernel = _KERNEL_CACHE.get(fspec)
+    if kernel is None:
+        kernel = _KERNEL_CACHE[fspec] = _build_kernel(fspec)
+    # function-local import: precompile imports this module's builders
+    from banyandb_tpu.query.precompile import default_registry
+
+    default_registry().record("fused", fspec)
+
+    built: list = []
+
+    def _build():
+        built.append(1)
+        return _stacked_chunks(
+            chunks_np, chunk_spans, spec, num_chunks, epoch, pad_ship_s
+        )
+
+    if dev_cache is not None:
+        # stacked inputs depend only on (gathered data, bucket, columns):
+        # keep them device-resident so repeat queries skip pad+ship too
+        # (the fused twin of the staged per-chunk device cache)
+        ck = (
+            "fused_chunks",
+            gather_key,
+            num_chunks,
+            spec.nrows,
+            spec.tags_code,
+            spec.fields,
+        )
+        dev_chunks = dev_cache.get_or_load(ck, _build)
+    else:
+        dev_chunks = _build()
+
+    device_s = 0.0
+    t0 = time.perf_counter()
+    out = kernel(dev_chunks, pred_vals, hist_lo, hist_span)
+    device_s += time.perf_counter() - t0
+    t0 = time.perf_counter()
+    # bdlint: disable=host-sync -- THE result boundary of the fused
+    # plan: the whole part-batch's stacked partials move in one batched
+    # transfer (1 get per part-batch, ratcheted by kernel_budgets)
+    moved = jax.device_get(out)
+    device_s += time.perf_counter() - t0
+    chunks_out = [
+        jax.tree_util.tree_map(lambda a, k=k: a[k], moved)
+        for k in range(len(chunk_spans))
+    ]
+    return chunks_out, device_s, ("built" if built else "hit")
+
+
+# ---------------------------------------------------------------------------
+# Mesh-parallel fused step: the whole distributed scan as ONE collective
+# program (shard_map over ('shard','seg'), dist_exec's psum/pmin/pmax set)
+# with a bounded compile-shape set (fixed-nrows chunks scanned per device).
+# ---------------------------------------------------------------------------
+
+
+def _fused_dist_step(
+    plan, num_chunks: int, chunks: dict, pred_codes: dict, hist_lo, hist_span
+):
+    """One device's [1, C*nrows] slice -> chunked scan -> collectives.
+
+    Per-chunk f32 partials combine across chunks with Kahan-compensated
+    f32 (count/sums/hist) and exact min/max — the precision contract's
+    bounded-span rule, on device.  With num_chunks=1 the math reduces to
+    parallel/dist_exec._step exactly (Kahan from zero is the identity).
+    """
+    from banyandb_tpu import ops
+    from banyandb_tpu.ops.groupby import _kahan_add
+    from banyandb_tpu.parallel import dist_exec
+
+    nhb = dist_exec._NUM_HIST_BUCKETS
+    chunks = jax.tree.map(
+        lambda a: a.reshape((num_chunks, -1)), chunks
+    )
+    G = plan.num_groups
+    zero = jnp.zeros(G, jnp.float32)
+
+    def step(carry, chunk):
+        # the SAME map half the legacy mesh step runs (dist_exec.map_chunk)
+        part, key, mask = dist_exec.map_chunk(plan, chunk, pred_codes)
+        count, sums, mins, maxs, hist = carry
+        count = _kahan_add(count[0], count[1], part.count)
+        sums = {
+            f: _kahan_add(sums[f][0], sums[f][1], part.sums[f])
+            for f in plan.fields
+        }
+        mins = {
+            f: jnp.minimum(mins[f], part.mins[f]) for f in plan.fields
+        }
+        maxs = {
+            f: jnp.maximum(maxs[f], part.maxs[f]) for f in plan.fields
+        }
+        if plan.want_hist:
+            h = ops.group_histogram(
+                key,
+                mask,
+                chunk["fields"][plan.want_hist],
+                G,
+                hist_lo,
+                hist_span,
+                nhb,
+            )
+            hist = _kahan_add(hist[0], hist[1], h)
+        return (count, sums, mins, maxs, hist), None
+
+    init = (
+        (zero, zero),
+        {f: (zero, zero) for f in plan.fields},
+        {f: jnp.full(G, jnp.inf, jnp.float32) for f in plan.fields},
+        {f: jnp.full(G, -jnp.inf, jnp.float32) for f in plan.fields},
+        (
+            (jnp.zeros((G, nhb), jnp.float32),) * 2
+            if plan.want_hist
+            else (zero, zero)
+        ),
+    )
+    (count, sums, mins, maxs, hist), _ = jax.lax.scan(step, init, chunks)
+
+    # ---- the collective reduce: ICI replaces the proto partial hop ----
+    axes = ("shard", "seg")
+    out = {
+        "count": jax.lax.psum(count[0] - count[1], axes),
+        "sums": {
+            f: jax.lax.psum(sums[f][0] - sums[f][1], axes)
+            for f in plan.fields
+        },
+        "mins": {f: jax.lax.pmin(mins[f], axes) for f in plan.fields},
+        "maxs": {f: jax.lax.pmax(maxs[f], axes) for f in plan.fields},
+    }
+    if plan.want_hist:
+        out["hist"] = jax.lax.psum(hist[0] - hist[1], axes)
+    if plan.topn:
+        mean = out["sums"][plan.fields[0]] / jnp.maximum(out["count"], 1.0)
+        vals, idx = ops.topk_groups(mean, out["count"] > 0, plan.topn)
+        out["top_vals"], out["top_idx"] = vals, idx
+    return out
+
+
+_DIST_STEP_CACHE: dict[tuple, object] = {}
+
+
+def build_fused_dist_step(mesh, plan, num_chunks: int):
+    """-> jitted f(chunks, pred_codes, hist_lo, hist_span): the whole
+    distributed scan as one collective program.  ``chunks`` arrays carry
+    [D, num_chunks*nrows] sharded over ('shard','seg'); outputs are
+    replicated.  Memoized per (mesh devices, plan, chunk bucket)."""
+    from banyandb_tpu.parallel import dist_exec
+
+    cache_key = (
+        tuple(d.id for d in mesh.devices.flat),
+        mesh.axis_names,
+        plan,
+        num_chunks,
+    )
+    cached = _DIST_STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+
+    from jax.sharding import PartitionSpec as P
+
+    if hasattr(jax, "shard_map"):
+        _shard_map = jax.shard_map
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    data_spec = P(("shard", "seg"))
+    step = _shard_map(
+        partial(_fused_dist_step, plan, num_chunks),
+        mesh=mesh,
+        in_specs=(
+            {
+                "valid": data_spec,
+                "tags": {t: data_spec for t in plan.tags_code},
+                "fields": {f: data_spec for f in plan.fields},
+            },
+            {t: P() for t in plan.eq_preds},
+            P(),
+            P(),
+        ),
+        out_specs=dist_exec._out_specs(plan),
+    )
+    jitted = jax.jit(step)
+    _DIST_STEP_CACHE[cache_key] = jitted
+    return jitted
+
+
+def fused_distributed_aggregate(
+    mesh,
+    plan,
+    num_chunks: int,
+    chunks: dict,
+    pred_codes=None,
+    hist_lo: float = 0.0,
+    hist_span: float = 1.0,
+):
+    """Convenience wrapper mirroring dist_exec.distributed_aggregate."""
+    step = build_fused_dist_step(mesh, plan, num_chunks)
+    codes = {
+        t: jnp.int32((pred_codes or {}).get(t, -1)) for t in plan.eq_preds
+    }
+    return step(chunks, codes, jnp.float32(hist_lo), jnp.float32(hist_span))

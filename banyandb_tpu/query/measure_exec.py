@@ -115,8 +115,14 @@ class PlanSpec:
 _KERNEL_CACHE: dict[PlanSpec, object] = {}
 
 
-def _build_kernel(spec: PlanSpec):
-    """Construct + jit the per-chunk partial computation for `spec`."""
+def _kernel_body(spec: PlanSpec):
+    """The un-jitted per-chunk partial computation for `spec`.
+
+    Shared verbatim between the staged executor (jitted per chunk by
+    `_build_kernel`) and the fused whole-plan executor
+    (query/fused_exec scans it over a stacked chunk batch inside ONE
+    program) — one trace graph per chunk either way, which is what
+    makes the staged/fused A/B byte-identical."""
 
     def kernel(chunk: dict, pred_vals: dict, hist_lo, hist_span):
         valid = chunk["valid"]
@@ -212,7 +218,12 @@ def _build_kernel(spec: PlanSpec):
             out["rep_row"] = grow[: spec.num_groups]
         return out
 
-    return jax.jit(kernel)
+    return kernel
+
+
+def _build_kernel(spec: PlanSpec):
+    """Construct + jit the per-chunk partial computation for `spec`."""
+    return jax.jit(_kernel_body(spec))
 
 
 class GlobalDicts:
@@ -987,29 +998,58 @@ def _reduce_partials(
             break
         chunk_spans.append((start, end))
 
+    # Fused whole-plan path (query/fused_exec, BYDB_FUSED=0 restores
+    # the staged loop below): the SAME per-chunk body scans over a
+    # stacked [C, nrows] batch inside ONE program — one dispatch in, one
+    # batched device_get out per part-batch — and the per-chunk partials
+    # come back stacked for the identical f64 absorb loop.
+    from banyandb_tpu.query import fused_exec
+
     device_s = 0.0  # time at the accelerator boundaries (dispatch + get)
-    pending = None
-    for chunk in prefetched(
-        [lambda s=s, e=e: _make_chunk(s, e) for s, e in chunk_spans],
-        name="bydb-chunk-prefetch",
-    ):
-        t_d = _time.perf_counter()
-        out = kernel(chunk, pred_vals, hist_lo_dev, hist_span_dev)
-        device_s += _time.perf_counter() - t_d
+    dispatches = 0
+    fused_cache_tag = None
+    if fused_exec.eligible(spec, len(chunk_spans)):
+        path = "fused"
+        moved_chunks, device_s, fused_cache_tag = fused_exec.run_fused(
+            chunks_np,
+            chunk_spans,
+            spec,
+            pred_vals,
+            hist_lo_dev,
+            hist_span_dev,
+            epoch,
+            gather_key=gather_key,
+            dev_cache=dev_cache,
+            pad_ship_s=pad_ship_s,
+        )
+        dispatches = 1
+        for moved in moved_chunks:
+            _absorb(moved)
+    else:
+        path = "staged"
+        pending = None
+        for chunk in prefetched(
+            [lambda s=s, e=e: _make_chunk(s, e) for s, e in chunk_spans],
+            name="bydb-chunk-prefetch",
+        ):
+            t_d = _time.perf_counter()
+            out = kernel(chunk, pred_vals, hist_lo_dev, hist_span_dev)
+            device_s += _time.perf_counter() - t_d
+            dispatches += 1
+            if pending is not None:
+                t_d = _time.perf_counter()
+                # bdlint: disable=host-sync -- the result boundary: one
+                # batched transfer per chunk, overlapped with dispatch above
+                moved = jax.device_get(pending)
+                device_s += _time.perf_counter() - t_d
+                _absorb(moved)
+            pending = out
         if pending is not None:
             t_d = _time.perf_counter()
-            # bdlint: disable=host-sync -- the result boundary: one
-            # batched transfer per chunk, overlapped with dispatch above
+            # bdlint: disable=host-sync -- final chunk's result boundary
             moved = jax.device_get(pending)
             device_s += _time.perf_counter() - t_d
             _absorb(moved)
-        pending = out
-    if pending is not None:
-        t_d = _time.perf_counter()
-        # bdlint: disable=host-sync -- final chunk's result boundary
-        moved = jax.device_get(pending)
-        device_s += _time.perf_counter() - t_d
-        _absorb(moved)
     _H_DEVICE.observe(device_s * 1000)
     if span is not None:
         total_ms = (_time.perf_counter() - t_reduce0) * 1000
@@ -1017,13 +1057,16 @@ def _reduce_partials(
             "host_ms", round(max(total_ms - device_s * 1000, 0.0), 3)
         ).tag("chunks", len(chunk_spans)).tag(
             "pad_ship_ms", round(sum(pad_ship_s) * 1000, 3)
-        )
+        ).tag("path", path).tag("dispatches", dispatches)
         if dev_cache is not None:
-            span.tag(
-                "device_cache",
-                f"{len(chunk_spans) - len(chunks_built)} hit / "
-                f"{len(chunks_built)} built",
-            )
+            if fused_cache_tag is not None:
+                span.tag("device_cache", fused_cache_tag)
+            else:
+                span.tag(
+                    "device_cache",
+                    f"{len(chunk_spans) - len(chunks_built)} hit / "
+                    f"{len(chunks_built)} built",
+                )
 
     # --- dense [G] arrays -> nonempty-group records (codes stay dense
     # int32 rows; value tuples materialize lazily, Partials.groups) -------

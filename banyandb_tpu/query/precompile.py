@@ -18,8 +18,8 @@ Three cooperating pieces close the cold-start compile gap:
    exact production shapes/dtypes — so the first real query finds a
    warm jit cache instead of paying XLA compilation.
 
-``builtin_plans()``/``builtin_masks()`` are the checked-in dashboard
-kernel matrix.  The lint plan auditor
+``builtin_plans()``/``builtin_fused()``/``builtin_masks()`` are the
+checked-in dashboard kernel matrix.  The lint plan auditor
 (``lint/whole_program/plan_audit.py``) eval_shape-audits EXACTLY this
 list — a meta-test pins the agreement, so a signature added here is
 automatically contract-checked and a signature audited is automatically
@@ -137,6 +137,19 @@ def builtin_masks():
     )
 
 
+def builtin_fused():
+    """(name, FusedSpec) pairs: the fused whole-plan twins of the builtin
+    measure matrix (query/fused_exec).  One-chunk buckets — the shape a
+    dashboard part-batch resolves — warmed, plan-audited and budget-
+    ratcheted alongside their staged counterparts."""
+    from banyandb_tpu.query.fused_exec import FusedSpec
+
+    return tuple(
+        (name.replace("measure/", "fused/"), FusedSpec(plan=spec, num_chunks=1))
+        for name, spec in builtin_plans()
+    )
+
+
 # -- shape/dtype argument builders (shared with the lint plan auditor) -------
 
 
@@ -214,6 +227,29 @@ def mask_warm_args(mspec) -> tuple:
     return (_zeros_like_structs(cols), _zeros_like_structs(vals))
 
 
+def fused_chunk_struct(fspec) -> dict:
+    """ShapeDtypeStruct pytree matching fused_exec._stacked_chunks."""
+    import jax
+
+    base = chunk_struct(fspec.plan)
+    c = fspec.num_chunks
+    return jax.tree_util.tree_map(
+        lambda s: jax.ShapeDtypeStruct((c,) + s.shape, s.dtype), base
+    )
+
+
+def fused_warm_args(fspec) -> tuple:
+    """Zero-filled production-shaped args for one fused plan program."""
+    import jax.numpy as jnp
+
+    return (
+        _zeros_like_structs(fused_chunk_struct(fspec)),
+        _zeros_like_structs(pred_struct(fspec.plan)),
+        jnp.float32(0.0),
+        jnp.float32(1.0),
+    )
+
+
 # -- signature (de)serialization ---------------------------------------------
 
 
@@ -232,6 +268,11 @@ def _tuplify(node):
 
 def spec_from_json(d: dict):
     kind = d["kind"]
+    if kind == "fused":
+        from banyandb_tpu.query.fused_exec import FusedSpec
+
+        _, plan = spec_from_json({**d["plan"], "kind": "measure"})
+        return kind, FusedSpec(plan=plan, num_chunks=int(d["num_chunks"]))
     if kind == "measure":
         from banyandb_tpu.query.measure_exec import PlanSpec, _PredSpec
 
@@ -367,13 +408,19 @@ class PrecompileRegistry:
     def _compile_one(self, kind: str, spec) -> None:
         import jax
 
-        from banyandb_tpu.query import measure_exec, stream_exec
+        from banyandb_tpu.query import fused_exec, measure_exec, stream_exec
 
         if kind == "measure":
             cache, build, args = (
                 measure_exec._KERNEL_CACHE,
                 measure_exec._build_kernel,
                 measure_warm_args(spec),
+            )
+        elif kind == "fused":
+            cache, build, args = (
+                fused_exec._KERNEL_CACHE,
+                fused_exec._build_kernel,
+                fused_warm_args(spec),
             )
         elif kind == "stream_mask":
             cache, build, args = (
@@ -400,6 +447,7 @@ class PrecompileRegistry:
             sigs = list(self.signatures())
             if include_builtin:
                 sigs += [("measure", s) for _, s in builtin_plans()]
+                sigs += [("fused", s) for _, s in builtin_fused()]
                 sigs += [("stream_mask", s) for _, s in builtin_masks()]
         done = 0
         seen = set()

@@ -273,7 +273,13 @@ def e2e_main() -> None:
                     TagSpec("svc", TagType.STRING),
                     TagSpec("region", TagType.STRING),
                 ),
-                fields=(FieldSpec("value", FieldType.FLOAT),),
+                # FLOAT mirrors the reference workload (exact-f64 host
+                # aggregation); the INT field rides the DEVICE kernel
+                # path, which is what the fused A/B phase measures
+                fields=(
+                    FieldSpec("value", FieldType.FLOAT),
+                    FieldSpec("hits", FieldType.INT),
+                ),
                 entity=Entity(("svc",)),
             )
         )
@@ -301,7 +307,10 @@ def e2e_main() -> None:
                         region_pool, rng.integers(0, 8, b).astype(np.int32)
                     ),
                 },
-                fields={"value": rng.gamma(2.0, 40.0, b).astype(np.float64)},
+                fields={
+                    "value": rng.gamma(2.0, 40.0, b).astype(np.float64),
+                    "hits": rng.integers(0, 1000, b).astype(np.float64),
+                },
                 versions=np.ones(b, dtype=np.int64),
             )
             written += b
@@ -365,18 +374,21 @@ def e2e_main() -> None:
                         pass
             return out
 
-        def distinct_queries(count: int) -> list[str]:
+        def distinct_queries(count: int, seed: int = 17) -> list[str]:
             """>= `count` DISTINCT queries (varied time ranges, group
             predicates, N, quantiles) — the cache-honest warm phase: no
             two hit the same partials-cache entry, so the p50 reflects
-            real per-query work, not replaying one cached answer."""
-            rq = np.random.default_rng(17)
+            real per-query work, not replaying one cached answer.  The
+            INT-field kinds (sum/mean over `hits`) ride the device
+            kernel path; `seed` varies the set so the fused A/B legs
+            never replay this phase's cache entries."""
+            rq = np.random.default_rng(seed)
             span = n_rows * step
             out = []
             for i in range(count):
                 b = T0 + int(rq.integers(0, span // 3))
                 e = b + int(rq.integers(span // 4, span // 2))
-                kind = i % 3
+                kind = i % 5
                 if kind == 0:
                     out.append(
                         f"SELECT mean(value) FROM MEASURE m IN g TIME "
@@ -389,11 +401,22 @@ def e2e_main() -> None:
                         f"MEASURE m IN g TIME BETWEEN {b} AND {e} "
                         f"GROUP BY region"
                     )
-                else:
+                elif kind == 2:
                     out.append(
                         f"SELECT sum(value) FROM MEASURE m IN g TIME "
                         f"BETWEEN {b} AND {e} WHERE region = 'r{i % 8}' "
                         f"GROUP BY svc TOP 10 BY value"
+                    )
+                elif kind == 3:
+                    out.append(
+                        f"SELECT sum(hits) FROM MEASURE m IN g TIME "
+                        f"BETWEEN {b} AND {e} WHERE region != 'r{i % 8}' "
+                        f"GROUP BY svc TOP {5 + 5 * (i % 4)} BY hits"
+                    )
+                else:
+                    out.append(
+                        f"SELECT mean(hits) FROM MEASURE m IN g TIME "
+                        f"BETWEEN {b} AND {e} GROUP BY region"
                     )
             return out
 
@@ -414,11 +437,65 @@ def e2e_main() -> None:
             # (ROADMAP item 1) carry the decode/compute split built in
             from banyandb_tpu.obs import prom as obs_prom
 
-            stage_breakdown = obs_prom.stage_breakdown(
-                tr.call(srv.addr, TOPIC_METRICS, {}, timeout=60.0)[
+            def metrics_text() -> str:
+                return tr.call(srv.addr, TOPIC_METRICS, {}, timeout=60.0)[
                     "prometheus"
                 ]
-            )
+
+            stage_breakdown = obs_prom.stage_breakdown(metrics_text())
+
+            # ---- staged-vs-fused A/B over the warm-distinct set ------
+            # BYDB_FUSED flips LIVE on the in-process server; each leg
+            # runs a FRESH distinct set (new seed => no partials-cache
+            # replay from any earlier phase) and scrapes its own
+            # stage_breakdown window (bucket-count deltas), so the
+            # device-execute split is attributable per mode.
+            n_ab = int(os.environ.get("BYDB_BENCH_AB", 30))
+            # pin each leg's mode explicitly and restore the ambient
+            # value after: a run launched with BYDB_FUSED=0 must still
+            # measure a real fused-vs-staged A/B (and keep its ambient
+            # setting for everything after this phase)
+            ambient_fused = os.environ.get("BYDB_FUSED")
+            try:
+                # untimed per-leg warmup (distinct seed, same signature
+                # population): each mode's kernels compile BEFORE its
+                # timed set, so a leg whose executor never ran earlier
+                # in the process doesn't charge XLA compiles to the A/B
+                os.environ["BYDB_FUSED"] = "1"
+                for q in distinct_queries(6, seed=37):
+                    run(q)
+                text_ab0 = metrics_text()
+                fused_ms = [run(q) for q in distinct_queries(n_ab, seed=29)]
+                text_ab1 = metrics_text()
+                os.environ["BYDB_FUSED"] = "0"
+                for q in distinct_queries(6, seed=41):
+                    run(q)
+                text_ab1 = metrics_text()
+                staged_ms = [run(q) for q in distinct_queries(n_ab, seed=31)]
+                text_ab2 = metrics_text()
+            finally:
+                if ambient_fused is None:
+                    os.environ.pop("BYDB_FUSED", None)
+                else:
+                    os.environ["BYDB_FUSED"] = ambient_fused
+            fused_p50 = float(np.percentile(fused_ms, 50))
+            staged_p50 = float(np.percentile(staged_ms, 50))
+            fused_ab = {
+                "queries_per_mode": n_ab,
+                "fused_p50_ms": round(fused_p50, 1),
+                "fused_p99_ms": round(float(np.percentile(fused_ms, 99)), 1),
+                "staged_p50_ms": round(staged_p50, 1),
+                "staged_p99_ms": round(
+                    float(np.percentile(staged_ms, 99)), 1
+                ),
+                "fused_speedup": round(staged_p50 / max(fused_p50, 1e-9), 2),
+                "stage_breakdown_fused": obs_prom.stage_breakdown_delta(
+                    text_ab0, text_ab1
+                ),
+                "stage_breakdown_staged": obs_prom.stage_breakdown_delta(
+                    text_ab1, text_ab2
+                ),
+            }
         finally:
             tr.close()
             srv.stop()
@@ -462,6 +539,9 @@ def e2e_main() -> None:
                         "after_distinct": counters_end,
                     },
                     "stage_breakdown": stage_breakdown,
+                    "fused": os.environ.get("BYDB_FUSED", "1"),
+                    "fused_speedup": fused_ab["fused_speedup"],
+                    "fused_ab": fused_ab,
                 }
             )
         )
@@ -609,6 +689,9 @@ def main() -> None:
     reserve = 300.0  # always leave room for the CPU fallback
     rec = None
     e2e_rec = None
+    # per-attempt claim-probe diagnostics ride the artifact so a
+    # cpu-fallback run explains itself (which attempts hung vs resolved)
+    probes: list[dict] = []
 
     ambient_is_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     if ambient_is_cpu:
@@ -638,9 +721,22 @@ def main() -> None:
                 break
             t0 = time.monotonic()
             probe = _run_child(dict(os.environ), budget, mode="probe")
+            elapsed = round(time.monotonic() - t0, 1)
+            probes.append(
+                {
+                    "attempt": attempt + 1,
+                    "elapsed_s": elapsed,
+                    "budget_s": round(budget, 1),
+                    "outcome": (
+                        "timeout-or-crash"
+                        if probe is None
+                        else f"backend:{probe.get('backend')}"
+                    ),
+                }
+            )
             if probe is not None and probe.get("backend") not in (None, "cpu"):
                 print(f"# claim probe ok (backend={probe['backend']}, "
-                      f"{time.monotonic()-t0:.1f}s)", file=sys.stderr)
+                      f"{elapsed:.1f}s)", file=sys.stderr)
                 claimed = True
                 break
             if probe is not None:
@@ -702,7 +798,9 @@ def main() -> None:
                 if e2e_rec is not None:
                     e2e_rec["note"] = "cpu-fallback"
 
-    final = _compose(rec, e2e_rec) or _FAILED_REC
+    final = _compose(rec, e2e_rec) or dict(_FAILED_REC)
+    if probes:
+        final["claim_probes"] = probes
     print(json.dumps(final))
     _persist_artifact(final)
 

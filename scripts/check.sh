@@ -37,6 +37,12 @@ echo "== cold-path smoke =="
 # populated + persisted, compile cache active (docs/performance.md)
 env JAX_PLATFORMS=cpu python scripts/cold_smoke.py || fail=1
 
+echo "== fused-executor smoke =="
+# multi-chunk part-batch = ONE fused dispatch, BYDB_FUSED=0 staged flip
+# byte-identical, fused signature recorded + round-tripped
+# (docs/performance.md "Fused whole-plan executor")
+env JAX_PLATFORMS=cpu python scripts/fused_smoke.py || fail=1
+
 echo "== sanitize smoke (bdsan) =="
 # live-engine stress slice under BYDB_SANITIZE=1: lock-order witnesses
 # consistent with the declared graph, zero leaked threads/fds, seeded

@@ -1,0 +1,188 @@
+"""Fast CPU-only fused-executor smoke (scripts/check.sh, both modes + CI).
+
+Proves, in seconds on synthetic sources, the fused whole-plan
+executor's contract (docs/performance.md "Fused whole-plan executor"):
+
+1. a MULTI-chunk measure part-batch executes as ONE fused XLA program —
+   exactly 1 device_execute dispatch + 1 batched device_get for the
+   whole part-batch (reduce-span ``path``/``dispatches`` tags);
+2. ``BYDB_FUSED=0`` restores the staged per-chunk loop with
+   byte-identical partials (raw array bytes) AND result JSON;
+3. the resolved fused signature is recorded in the precompile registry
+   under kind="fused" and survives a JSON round-trip, so cold starts
+   warm the fused kernel.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("BYDB_PRECOMPILE", "1")
+
+# runnable as `python scripts/fused_smoke.py` from the repo root or CI
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = 1_700_000_000_000
+
+
+def main() -> int:
+    import numpy as np
+
+    from banyandb_tpu.api.model import (
+        Aggregation,
+        Condition,
+        GroupBy,
+        LogicalExpression,
+        QueryRequest,
+        TimeRange,
+    )
+    from banyandb_tpu.api.schema import (
+        Entity,
+        FieldSpec,
+        FieldType,
+        Measure,
+        TagSpec,
+        TagType,
+    )
+    from banyandb_tpu.obs.tracer import Tracer
+    from banyandb_tpu.query import measure_exec, precompile
+    from banyandb_tpu.query.measure_exec import (
+        compute_partials,
+        finalize_partials,
+    )
+    from banyandb_tpu.server import result_to_json
+    from banyandb_tpu.storage.part import ColumnData
+
+    rng = np.random.default_rng(23)
+    n = 8192
+    m = Measure(
+        group="g",
+        name="m",
+        tags=(TagSpec("svc", TagType.STRING), TagSpec("region", TagType.INT)),
+        fields=(FieldSpec("v", FieldType.INT),),
+        entity=Entity(("svc",)),
+    )
+    src = ColumnData(
+        ts=T0 + np.arange(n, dtype=np.int64),
+        series=np.arange(n, dtype=np.int64) % 64,
+        version=np.ones(n, dtype=np.int64),
+        tags={
+            "svc": rng.integers(0, 8, n).astype(np.int32),
+            "region": rng.integers(0, 4, n).astype(np.int32),
+        },
+        fields={"v": rng.integers(0, 100, n).astype(np.float64)},
+        dicts={
+            "svc": [b"s%02d" % i for i in range(8)],
+            "region": [
+                i.to_bytes(8, "little", signed=True) for i in range(4)
+            ],
+        },
+    )
+    req = QueryRequest(
+        ("g",),
+        "m",
+        TimeRange(T0, T0 + n),
+        criteria=LogicalExpression(
+            "and",
+            Condition("svc", "eq", "s03"),
+            Condition("region", "le", 2),
+        ),
+        group_by=GroupBy(("svc", "region")),
+        field_projection=("v",),
+        agg=Aggregation("sum", "v"),
+    )
+
+    def partial_bytes(p) -> bytes:
+        parts = [p.count.tobytes()]
+        for d in (p.sums, p.mins, p.maxs):
+            for k in sorted(d):
+                parts.append(d[k].tobytes())
+        if p.hist is not None:
+            parts.append(p.hist.tobytes())
+        if p.rep_key is not None:
+            parts.append(p.rep_key.tobytes())
+        return b"".join(parts)
+
+    def run(fused: bool):
+        tr = Tracer("smoke")
+        os.environ["BYDB_FUSED"] = "1" if fused else "0"
+        try:
+            with tr.span("q") as sp:
+                partial = compute_partials(m, req, [src], span=sp)
+                res = finalize_partials(m, req, [partial], span=sp)
+        finally:
+            os.environ.pop("BYDB_FUSED", None)
+        tree = tr.finish()
+        reduce_tags = _find(tree, "reduce")["tags"]
+        return partial, res, reduce_tags
+
+    # multi-chunk part-batch: SCAN_CHUNK pinned below the row count
+    saved_chunk = measure_exec.SCAN_CHUNK
+    measure_exec.SCAN_CHUNK = 2048  # 8192 rows -> a 4-chunk part-batch
+    try:
+        p_fused, r_fused, t_fused = run(fused=True)
+        p_staged, r_staged, t_staged = run(fused=False)
+    finally:
+        measure_exec.SCAN_CHUNK = saved_chunk
+
+    # -- 1: one dispatch for the whole multi-chunk part-batch --------------
+    assert t_fused.get("path") == "fused", t_fused
+    assert t_fused.get("chunks") == 4, t_fused
+    assert t_fused.get("dispatches") == 1, (
+        f"fused 4-chunk part-batch cost {t_fused.get('dispatches')} "
+        f"dispatches, want exactly 1: {t_fused}"
+    )
+    assert t_staged.get("path") == "staged", t_staged
+    assert t_staged.get("dispatches") == 4, t_staged
+    print(
+        f"# fused: {t_fused['chunks']} chunks -> 1 dispatch "
+        f"(staged: {t_staged['dispatches']})"
+    )
+
+    # -- 2: byte parity staged vs fused ------------------------------------
+    assert partial_bytes(p_fused) == partial_bytes(p_staged), (
+        "fused partials bytes differ from staged"
+    )
+    j_fused = json.dumps(result_to_json(r_fused), sort_keys=True)
+    j_staged = json.dumps(result_to_json(r_staged), sort_keys=True)
+    assert j_fused == j_staged, "fused result JSON differs from staged"
+    print(f"# parity: {len(j_fused)} result bytes identical fused/staged")
+
+    # -- 3: fused signature recorded + JSON round-trip ---------------------
+    fused_sigs = [
+        s
+        for kind, s in precompile.default_registry().signatures()
+        if kind == "fused"
+    ]
+    assert fused_sigs, "no fused signature recorded in the registry"
+    doc = precompile.spec_to_json("fused", fused_sigs[0])
+    kind, back = precompile.spec_from_json(json.loads(json.dumps(doc)))
+    assert kind == "fused" and back == fused_sigs[0], (
+        "fused signature did not survive the registry JSON round-trip"
+    )
+    print(f"# registry: {len(fused_sigs)} fused signature(s), round-trip ok")
+    print("fused_smoke: OK")
+    return 0
+
+
+def _find(tree: dict, name: str):
+    if tree.get("name") == name:
+        return tree
+    for c in tree.get("children", ()):
+        hit = _find(c, name)
+        if hit is not None:
+            return hit
+    return None
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except AssertionError as e:
+        print(f"fused_smoke: FAILED: {e}", file=sys.stderr)
+        raise SystemExit(1) from e

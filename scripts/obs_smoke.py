@@ -14,7 +14,10 @@ plane's end-to-end invariants (docs/observability.md):
    kernel_budgets.py, exported as `kernel_dispatch_budget` gauges)
    bounds the OBSERVED `device_execute` span count for the traced query
    — the measured plane and the predicted plane agree, which is the
-   ratchet the fused whole-plan executor (ROADMAP item 2) tightens.
+   ratchet the fused whole-plan executor (ROADMAP item 2) tightens;
+5. the fused whole-plan executor costs EXACTLY 1 device_execute
+   dispatch per part-batch (reduce-span `path`/`dispatches` tags), and
+   `BYDB_FUSED=0` restores the staged loop with byte-identical results.
 
 Exit 0 on success; any assertion prints a diagnostic and exits 1.
 """
@@ -188,6 +191,49 @@ def main() -> int:
     print(
         f"# dispatch budget: {device_spans} observed device spans <= "
         f"{budget}/part-batch x {part_batches} part-batches (static)"
+    )
+
+    # -- 5: fused whole-plan executor: 1 dispatch per part-batch -----------
+    # The default (fused) query must show EXACTLY one device_execute
+    # dispatch per part-batch on every node's reduce span, and flipping
+    # BYDB_FUSED=0 (the staged per-chunk loop) must return byte-identical
+    # results — the A/B contract of docs/performance.md "Fused whole-plan
+    # executor".
+    for st in subtrees:
+        tags = find_span(st, "reduce")["tags"]
+        assert tags.get("path") == "fused", f"{st['name']}: path tag {tags}"
+        assert tags.get("dispatches") == 1, (
+            f"{st['name']}: fused part-batch cost {tags.get('dispatches')} "
+            f"device_execute dispatches, want exactly 1 {tags}"
+        )
+    from banyandb_tpu.storage.cache import device_cache, global_cache
+
+    os.environ["BYDB_FUSED"] = "0"
+    try:
+        # bust the serving/device caches so the staged run recomputes
+        # instead of replaying the fused run's cached partials
+        global_cache().clear()
+        device_cache().clear()
+        res_staged = liaison.query_measure(req)
+    finally:
+        os.environ.pop("BYDB_FUSED", None)
+    j_staged = result_to_json(res_staged)
+    j_staged.pop("trace", None)
+    assert json.dumps(j_staged, sort_keys=True) == b_on, (
+        "staged (BYDB_FUSED=0) results differ from the fused path"
+    )
+    staged_tree = (res_staged.trace or {}).get("span_tree")
+    staged_reduce = [
+        find_span(s, "reduce")["tags"]
+        for s in iter_spans(staged_tree)
+        if str(s.get("name", "")).startswith("data:")
+    ]
+    assert staged_reduce and all(
+        t.get("path") == "staged" for t in staged_reduce
+    ), f"BYDB_FUSED=0 did not restore the staged path: {staged_reduce}"
+    print(
+        f"# fused A/B: 1 dispatch/part-batch on {len(subtrees)} nodes, "
+        "staged flip byte-identical"
     )
     print("obs_smoke: OK")
     return 0

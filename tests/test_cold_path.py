@@ -512,9 +512,11 @@ def test_registry_and_plan_audit_agree():
     from banyandb_tpu.query import precompile
 
     audit_names = {e.name for e in default_entries()}
-    builtin_names = {n for n, _ in precompile.builtin_plans()} | {
-        n for n, _ in precompile.builtin_masks()
-    }
+    builtin_names = (
+        {n for n, _ in precompile.builtin_plans()}
+        | {n for n, _ in precompile.builtin_fused()}
+        | {n for n, _ in precompile.builtin_masks()}
+    )
     missing = builtin_names - audit_names
     assert not missing, f"registry signatures not audited: {missing}"
     # audit may only add the shared-ops entries on top of the registry set

@@ -1,0 +1,648 @@
+"""Fused whole-plan executor (ISSUE 8): one XLA program per plan
+signature (query/fused_exec).
+
+Covers:
+- byte-parity staged vs fused (partials array bytes AND finalized
+  result JSON) across EVERY builtin plan signature, single- and
+  multi-chunk part-batches, incl. a high-radix plan that selects the
+  segment-sort group-by;
+- hash- vs sort-based group-by selection pinned per builtin signature
+  (ops.groupby.select_group_method) and the sort method's bitwise
+  equality with the hash/scatter path;
+- mid-stream decode-error propagation parity between the two paths;
+- the ``BYDB_FUSED=0`` fallback and the footprint-budget fallback;
+- fused-signature precompile-registry round-trip, store persistence and
+  warming into the fused kernel cache;
+- the mesh fused dist step (chunked collective program) agreeing with
+  the legacy single-width step.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api.model import (
+    Aggregation,
+    Condition,
+    GroupBy,
+    LogicalExpression,
+    QueryRequest,
+    TimeRange,
+    Top,
+)
+from banyandb_tpu.api.schema import (
+    Entity,
+    FieldSpec,
+    FieldType,
+    Measure,
+    TagSpec,
+    TagType,
+)
+from banyandb_tpu.query import fused_exec, measure_exec
+from banyandb_tpu.query.measure_exec import compute_partials, finalize_partials
+from banyandb_tpu.storage.part import ColumnData
+
+T0 = 1_700_000_000_000
+
+
+def _int_bytes(i: int) -> bytes:
+    return i.to_bytes(8, "little", signed=True)
+
+
+def _source(n: int, step: int, tags: dict, fields: dict) -> ColumnData:
+    return ColumnData(
+        ts=T0 + np.arange(n, dtype=np.int64) * step,
+        series=np.arange(n, dtype=np.int64) % 64,
+        version=np.ones(n, dtype=np.int64),
+        tags={t: codes for t, (_v, codes) in tags.items()},
+        fields=dict(fields),
+        dicts={t: vals for t, (vals, _c) in tags.items()},
+    )
+
+
+def _measure(tags, fields) -> Measure:
+    return Measure(
+        group="g",
+        name="m",
+        tags=tuple(TagSpec(n, t) for n, t in tags),
+        fields=tuple(FieldSpec(n, t) for n, t in fields),
+        entity=Entity((tags[0][0],)),
+    )
+
+
+def _scenarios():
+    """(name, measure, request, sources): the builtin plan population,
+    mirroring lint/kernel/dispatch.py's scenario synthesis."""
+    rng = np.random.default_rng(7)
+
+    def svc_dict(k):
+        return [b"s%04d" % i for i in range(k)]
+
+    out = []
+
+    n = 8192
+    m = _measure([("svc", TagType.STRING)], [("v", FieldType.INT)])
+    src = _source(
+        n,
+        1,
+        {"svc": (svc_dict(4), rng.integers(0, 4, n).astype(np.int32))},
+        {"v": rng.integers(0, 100, n).astype(np.float64)},
+    )
+    out.append(
+        (
+            "flat-count",
+            m,
+            QueryRequest(
+                ("g",), "m", TimeRange(T0, T0 + n), field_projection=("v",)
+            ),
+            [src],
+        )
+    )
+
+    m = _measure(
+        [("svc", TagType.STRING), ("region", TagType.INT)],
+        [("v", FieldType.INT)],
+    )
+    src = _source(
+        n,
+        1,
+        {
+            "svc": (svc_dict(8), rng.integers(0, 8, n).astype(np.int32)),
+            "region": (
+                [_int_bytes(i) for i in range(4)],
+                rng.integers(0, 4, n).astype(np.int32),
+            ),
+        },
+        {"v": rng.integers(0, 100, n).astype(np.float64)},
+    )
+    out.append(
+        (
+            "group-eq-lut",
+            m,
+            QueryRequest(
+                ("g",),
+                "m",
+                TimeRange(T0, T0 + n),
+                criteria=LogicalExpression(
+                    "and",
+                    Condition("svc", "eq", "s0003"),
+                    Condition("region", "le", 2),
+                ),
+                group_by=GroupBy(("svc", "region")),
+                field_projection=("v",),
+                agg=Aggregation("mean", "v"),
+            ),
+            [src],
+        )
+    )
+
+    n_pct, step = 65536, 32769
+    m = _measure([("svc", TagType.STRING)], [("lat", FieldType.FLOAT)])
+    src = _source(
+        n_pct,
+        step,
+        {"svc": (svc_dict(16), rng.integers(0, 16, n_pct).astype(np.int32))},
+        {"lat": rng.random(n_pct).astype(np.float64) * 100},
+    )
+    out.append(
+        (
+            "percentile-hist",
+            m,
+            QueryRequest(
+                ("g",),
+                "m",
+                TimeRange(T0, T0 + n_pct * step + 1),
+                group_by=GroupBy(("svc",)),
+                agg=Aggregation("percentile", "lat", quantiles=(0.5, 0.99)),
+            ),
+            [src],
+        )
+    )
+
+    m = _measure([("svc", TagType.STRING)], [("v", FieldType.INT)])
+    src = _source(
+        n,
+        1,
+        {"svc": (svc_dict(8), rng.integers(0, 8, n).astype(np.int32))},
+        {"v": rng.integers(0, 100, n).astype(np.float64)},
+    )
+    out.append(
+        (
+            "or-expr",
+            m,
+            QueryRequest(
+                ("g",),
+                "m",
+                TimeRange(T0, T0 + n),
+                criteria=LogicalExpression(
+                    "or",
+                    Condition(
+                        "svc", "in", ("s0000", "s0001", "s0002", "s0003")
+                    ),
+                    Condition("svc", "eq", "s0000"),
+                ),
+                agg=Aggregation("sum", "v"),
+            ),
+            [src],
+        )
+    )
+
+    n_top = 65536
+    m = _measure(
+        [("svc", TagType.STRING), ("region", TagType.STRING)],
+        [("value", FieldType.INT)],
+    )
+    src = _source(
+        n_top,
+        1,
+        {
+            "svc": (
+                svc_dict(1024),
+                rng.integers(0, 1024, n_top).astype(np.int32),
+            ),
+            "region": (
+                [b"r%d" % i for i in range(8)],
+                rng.integers(0, 8, n_top).astype(np.int32),
+            ),
+        },
+        {"value": rng.integers(0, 100, n_top).astype(np.float64)},
+    )
+    out.append(
+        (
+            "topn-dashboard",
+            m,
+            QueryRequest(
+                ("g",),
+                "m",
+                TimeRange(T0, T0 + n_top),
+                criteria=Condition("region", "ne", "r0"),
+                group_by=GroupBy(("svc",)),
+                top=Top(10, "value"),
+            ),
+            [src],
+        )
+    )
+    return out
+
+
+def _partial_bytes(p) -> bytes:
+    parts = [p.count.tobytes(), p.codes.tobytes() if p.codes is not None else b""]
+    for d in (p.sums, p.mins, p.maxs):
+        for k in sorted(d):
+            parts.append(d[k].tobytes())
+    if p.hist is not None:
+        parts.append(p.hist.tobytes())
+    if p.rep_key is not None:
+        parts.append(p.rep_key.tobytes())
+    if p.rep_vals is not None:
+        parts.append(repr(sorted(p.rep_vals.items())).encode())
+    return b"".join(parts)
+
+
+def _result_json(m, req, partial) -> str:
+    from banyandb_tpu.server import result_to_json
+
+    res = finalize_partials(m, req, [partial])
+    return json.dumps(result_to_json(res), sort_keys=True)
+
+
+def _run(m, req, srcs, fused: bool, monkeypatch):
+    from banyandb_tpu.obs.tracer import Tracer
+
+    monkeypatch.setenv("BYDB_FUSED", "1" if fused else "0")
+    tr = Tracer("t")
+    with tr.span("q") as sp:
+        p = compute_partials(m, req, srcs, span=sp)
+    tags = _reduce_tags(tr.finish())
+    return p, tags
+
+
+def _reduce_tags(tree: dict):
+    if tree.get("name") == "reduce":
+        return tree["tags"]
+    for c in tree.get("children", ()):
+        hit = _reduce_tags(c)
+        if hit is not None:
+            return hit
+    return None
+
+
+@pytest.mark.parametrize(
+    "name", [s[0] for s in _scenarios()]
+)
+def test_parity_all_builtin_signatures(name, monkeypatch):
+    """Byte-identical partials + result JSON, staged vs fused, for every
+    builtin plan signature."""
+    m, req, srcs = next(
+        (m, r, s) for n, m, r, s in _scenarios() if n == name
+    )
+    p_staged, t_staged = _run(m, req, srcs, fused=False, monkeypatch=monkeypatch)
+    p_fused, t_fused = _run(m, req, srcs, fused=True, monkeypatch=monkeypatch)
+    assert t_staged["path"] == "staged" and t_fused["path"] == "fused"
+    assert t_fused["dispatches"] == 1
+    assert _partial_bytes(p_staged) == _partial_bytes(p_fused)
+    assert _result_json(m, req, p_staged) == _result_json(m, req, p_fused)
+
+
+def test_multichunk_parity_one_dispatch(monkeypatch):
+    """A part-batch spanning several scan chunks fuses into ONE dispatch
+    with byte-identical results."""
+    monkeypatch.setattr(measure_exec, "SCAN_CHUNK", 2048)
+    name, m, req, srcs = _scenarios()[1]  # grouped eq+lut, n=8192
+    p_staged, t_staged = _run(m, req, srcs, fused=False, monkeypatch=monkeypatch)
+    p_fused, t_fused = _run(m, req, srcs, fused=True, monkeypatch=monkeypatch)
+    assert t_staged["chunks"] == 4 and t_staged["dispatches"] == 4
+    assert t_fused["chunks"] == 4 and t_fused["dispatches"] == 1
+    assert _partial_bytes(p_staged) == _partial_bytes(p_fused)
+    assert _result_json(m, req, p_staged) == _result_json(m, req, p_fused)
+
+
+def test_nonbucket_chunk_count_parity(monkeypatch):
+    """3 real chunks ride a 4-chunk bucket: the padded all-invalid chunk
+    must not perturb results."""
+    monkeypatch.setattr(measure_exec, "SCAN_CHUNK", 2048)
+    rng = np.random.default_rng(3)
+    n = 3 * 2048
+    m = _measure([("svc", TagType.STRING)], [("v", FieldType.INT)])
+    src = _source(
+        n,
+        1,
+        {"svc": ([b"a", b"b"], rng.integers(0, 2, n).astype(np.int32))},
+        {"v": rng.integers(0, 100, n).astype(np.float64)},
+    )
+    req = QueryRequest(
+        ("g",),
+        "m",
+        TimeRange(T0, T0 + n),
+        group_by=GroupBy(("svc",)),
+        agg=Aggregation("sum", "v"),
+    )
+    p_staged, _ = _run(m, req, [src], fused=False, monkeypatch=monkeypatch)
+    p_fused, t_fused = _run(m, req, [src], fused=True, monkeypatch=monkeypatch)
+    assert t_fused["chunks"] == 3 and t_fused["dispatches"] == 1
+    assert _partial_bytes(p_staged) == _partial_bytes(p_fused)
+
+
+# -- group-by strategy selection ---------------------------------------------
+
+
+def test_group_method_selection_pinned_per_signature():
+    """The hash-vs-sort crossover is a deterministic function of the
+    plan signature: pinned per builtin (CPU backend) + the high-radix
+    sort regime."""
+    from banyandb_tpu.ops.groupby import (
+        SORT_GROUPS_THRESHOLD,
+        select_group_method,
+    )
+    from banyandb_tpu.query import precompile
+
+    want = {
+        "measure/flat-count": "matmul",
+        "measure/group-eq-lut": "matmul",
+        "measure/percentile-hist": "matmul",
+        "measure/or-expr": "matmul",
+        "measure/topn-dashboard": "scatter",
+    }
+    got = {
+        name: select_group_method(spec.nrows, max(spec.num_groups, 1))
+        for name, spec in precompile.builtin_plans()
+    }
+    assert got == want, got
+    # high-radix / unknown-cardinality keys: segment-sort grouping
+    assert select_group_method(65536, SORT_GROUPS_THRESHOLD + 1) == "sort"
+    assert select_group_method(65536, SORT_GROUPS_THRESHOLD) != "sort"
+
+
+def test_sort_method_bitwise_matches_scatter():
+    from banyandb_tpu import ops
+
+    rng = np.random.default_rng(11)
+    n, g = 8192, 300
+    key = rng.integers(0, g, n).astype(np.int32)
+    valid = rng.random(n) < 0.9
+    fields = {"v": (rng.random(n) * 1e3).astype(np.float32)}
+    import jax.numpy as jnp
+
+    a = ops.group_reduce(
+        jnp.asarray(key), jnp.asarray(valid), {"v": jnp.asarray(fields["v"])},
+        g, method="scatter",
+    )
+    b = ops.group_reduce(
+        jnp.asarray(key), jnp.asarray(valid), {"v": jnp.asarray(fields["v"])},
+        g, method="sort",
+    )
+    for x, y in (
+        (a.count, b.count),
+        (a.sums["v"], b.sums["v"]),
+        (a.mins["v"], b.mins["v"]),
+        (a.maxs["v"], b.maxs["v"]),
+    ):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_high_radix_sort_plan_parity(monkeypatch):
+    """A plan whose group cardinality crosses SORT_GROUPS_THRESHOLD
+    resolves the sort strategy in BOTH paths and stays byte-identical."""
+    from banyandb_tpu.ops.groupby import SORT_GROUPS_THRESHOLD
+
+    rng = np.random.default_rng(13)
+    n = 4096
+    k = SORT_GROUPS_THRESHOLD + 8
+    m = _measure([("svc", TagType.STRING)], [("v", FieldType.INT)])
+    src = _source(
+        n,
+        1,
+        {
+            "svc": (
+                [b"s%06d" % i for i in range(k)],
+                rng.integers(0, k, n).astype(np.int32),
+            )
+        },
+        {"v": rng.integers(0, 100, n).astype(np.float64)},
+    )
+    req = QueryRequest(
+        ("g",),
+        "m",
+        TimeRange(T0, T0 + n),
+        group_by=GroupBy(("svc",)),
+        agg=Aggregation("sum", "v"),
+        limit=32,
+    )
+    p_staged, _ = _run(m, req, [src], fused=False, monkeypatch=monkeypatch)
+    p_fused, _ = _run(m, req, [src], fused=True, monkeypatch=monkeypatch)
+    assert _partial_bytes(p_staged) == _partial_bytes(p_fused)
+    assert _result_json(m, req, p_staged) == _result_json(m, req, p_fused)
+
+
+# -- fallbacks ---------------------------------------------------------------
+
+
+def test_flag_off_falls_back_to_staged(monkeypatch):
+    name, m, req, srcs = _scenarios()[0]
+    monkeypatch.setattr(fused_exec, "_KERNEL_CACHE", {})
+    p, tags = _run(m, req, srcs, fused=False, monkeypatch=monkeypatch)
+    assert tags["path"] == "staged"
+    assert fused_exec._KERNEL_CACHE == {}  # fused program never built
+
+
+def test_footprint_budget_falls_back_to_staged(monkeypatch):
+    name, m, req, srcs = _scenarios()[0]
+    monkeypatch.setenv("BYDB_FUSED_MAX_MB", "0")
+    p, tags = _run(m, req, srcs, fused=True, monkeypatch=monkeypatch)
+    assert tags["path"] == "staged"
+
+
+def test_eligibility_is_flag_and_budget():
+    spec = measure_exec.PlanSpec(
+        tags_code=(),
+        fields=("v",),
+        preds=(),
+        group_tags=(),
+        radices=(),
+        num_groups=1,
+        want_minmax=True,
+        nrows=8192,
+    )
+    os.environ["BYDB_FUSED"] = "1"
+    try:
+        assert fused_exec.eligible(spec, 1)
+        assert not fused_exec.eligible(spec, 0)
+        os.environ["BYDB_FUSED"] = "0"
+        assert not fused_exec.eligible(spec, 1)
+    finally:
+        os.environ.pop("BYDB_FUSED", None)
+    # footprint estimate grows with the chunk bucket
+    assert fused_exec.estimate_bytes(spec, 8) > fused_exec.estimate_bytes(
+        spec, 1
+    )
+
+
+def test_chunk_count_bucket_powers_of_two():
+    assert [fused_exec.chunk_count_bucket(c) for c in (1, 2, 3, 5, 8, 9)] == [
+        1,
+        2,
+        4,
+        8,
+        8,
+        16,
+    ]
+
+
+# -- mid-stream decode-error propagation -------------------------------------
+
+
+class _ExplodingCol(np.ndarray):
+    """Raises once a chunk past the first is sliced — the mid-stream
+    decode failure shape (a later part's block failing to decode)."""
+
+    def __getitem__(self, item):
+        if isinstance(item, slice) and (item.start or 0) >= 2048:
+            raise ValueError("decode failed mid-stream")
+        return super().__getitem__(item)
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_midstream_decode_error_propagates_identically(fused, monkeypatch):
+    monkeypatch.setattr(measure_exec, "SCAN_CHUNK", 2048)
+    rng = np.random.default_rng(5)
+    n = 8192
+    m = _measure([("svc", TagType.STRING)], [("v", FieldType.INT)])
+    src = _source(
+        n,
+        1,
+        {"svc": ([b"a", b"b"], rng.integers(0, 2, n).astype(np.int32))},
+        {"v": rng.integers(0, 100, n).astype(np.float64)},
+    )
+    req = QueryRequest(
+        ("g",), "m", TimeRange(T0, T0 + n), field_projection=("v",)
+    )
+
+    real_gather = measure_exec._gather_rows
+
+    def exploding_gather(*args, **kwargs):
+        cols = real_gather(*args, **kwargs)
+        cols["fields"] = {
+            f: a.view(_ExplodingCol) for f, a in cols["fields"].items()
+        }
+        return cols
+
+    monkeypatch.setattr(measure_exec, "_gather_rows", exploding_gather)
+    monkeypatch.setenv("BYDB_FUSED", "1" if fused else "0")
+    with pytest.raises(ValueError, match="decode failed mid-stream"):
+        compute_partials(m, req, [src])
+
+
+# -- precompile registry -----------------------------------------------------
+
+
+def test_fused_signature_recorded_and_persisted(monkeypatch, tmp_path):
+    from banyandb_tpu.query import precompile
+
+    monkeypatch.setenv("BYDB_PRECOMPILE", "1")
+    r = precompile.PrecompileRegistry()
+    monkeypatch.setattr(precompile, "_registry", r)
+    name, m, req, srcs = _scenarios()[0]
+    _run(m, req, srcs, fused=True, monkeypatch=monkeypatch)
+    fused_sigs = [s for kind, s in r.signatures() if kind == "fused"]
+    assert len(fused_sigs) == 1
+    assert isinstance(fused_sigs[0], fused_exec.FusedSpec)
+    assert fused_sigs[0].num_chunks == 1
+
+    # JSON round-trip (incl. the nested PlanSpec) + store persistence
+    doc = precompile.spec_to_json("fused", fused_sigs[0])
+    kind2, spec2 = precompile.spec_from_json(json.loads(json.dumps(doc)))
+    assert kind2 == "fused" and spec2 == fused_sigs[0]
+    assert hash(spec2) == hash(fused_sigs[0])
+    store_path = tmp_path / "plan-registry.json"
+    r.attach_store(store_path)
+    r2 = precompile.PrecompileRegistry()
+    r2.attach_store(store_path)
+    assert ("fused", fused_sigs[0]) in set(r2.signatures())
+
+
+def test_registry_warm_compiles_fused_kernel(monkeypatch):
+    from banyandb_tpu.query import precompile
+
+    monkeypatch.setenv("BYDB_PRECOMPILE", "1")
+    monkeypatch.setattr(fused_exec, "_KERNEL_CACHE", {})
+    r = precompile.PrecompileRegistry()
+    fspec = precompile.builtin_fused()[0][1]
+    assert r.warm(sigs=[("fused", fspec)]) == 1 and r.errors == 0
+    assert fspec in fused_exec._KERNEL_CACHE
+
+
+def test_builtin_fused_mirror_builtin_plans():
+    from banyandb_tpu.query import precompile
+
+    plans = dict(precompile.builtin_plans())
+    fused = dict(precompile.builtin_fused())
+    assert {n.replace("fused/", "measure/") for n in fused} == set(plans)
+    for name, fspec in fused.items():
+        assert fspec.num_chunks == 1
+        assert fspec.plan == plans[name.replace("fused/", "measure/")]
+
+
+# -- mesh fused dist step ----------------------------------------------------
+
+
+def test_fused_dist_step_matches_legacy_step():
+    """The chunked collective program agrees with the legacy
+    single-width mesh step on the same packed rows (count/min/max exact,
+    sums within f32 reassociation tolerance)."""
+    import jax
+
+    from banyandb_tpu.parallel import dist_exec
+    from banyandb_tpu.parallel import mesh as pmesh
+
+    rng = np.random.default_rng(17)
+    plan = dist_exec.DistPlan(
+        tags_code=("svc",),
+        fields=("v",),
+        group_tags=("svc",),
+        radices=(16,),
+        num_groups=16,
+        topn=4,
+    )
+    mesh = pmesh.make_mesh(1)
+    n = 4096
+    rows = [
+        {
+            "tags": {"svc": rng.integers(0, 16, n).astype(np.int32)},
+            "fields": {"v": rng.random(n).astype(np.float32) * 100},
+        }
+    ]
+    chunks = dist_exec.stack_shard_chunks(mesh, rows, ("svc",), ("v",), n)
+    legacy = jax.device_get(
+        dist_exec.distributed_aggregate(mesh, plan, chunks)
+    )
+    fused = jax.device_get(
+        fused_exec.fused_distributed_aggregate(mesh, plan, 4, chunks)
+    )
+    assert np.array_equal(legacy["count"], fused["count"])
+    assert np.array_equal(legacy["mins"]["v"], fused["mins"]["v"])
+    assert np.array_equal(legacy["maxs"]["v"], fused["maxs"]["v"])
+    np.testing.assert_allclose(
+        legacy["sums"]["v"], fused["sums"]["v"], rtol=1e-6
+    )
+    assert set(np.asarray(legacy["top_idx"]).tolist()) == set(
+        np.asarray(fused["top_idx"]).tolist()
+    )
+
+
+def test_fused_dist_single_chunk_bitwise():
+    """num_chunks=1 reduces to the legacy step exactly (Kahan from zero
+    is the identity)."""
+    import jax
+
+    from banyandb_tpu.parallel import dist_exec
+    from banyandb_tpu.parallel import mesh as pmesh
+
+    rng = np.random.default_rng(19)
+    plan = dist_exec.DistPlan(
+        tags_code=("svc",),
+        fields=("v",),
+        group_tags=("svc",),
+        radices=(8,),
+        num_groups=8,
+    )
+    mesh = pmesh.make_mesh(1)
+    n = 2048
+    rows = [
+        {
+            "tags": {"svc": rng.integers(0, 8, n).astype(np.int32)},
+            "fields": {"v": rng.random(n).astype(np.float32)},
+        }
+    ]
+    chunks = dist_exec.stack_shard_chunks(mesh, rows, ("svc",), ("v",), n)
+    legacy = jax.device_get(
+        dist_exec.distributed_aggregate(mesh, plan, chunks)
+    )
+    fused = jax.device_get(
+        fused_exec.fused_distributed_aggregate(mesh, plan, 1, chunks)
+    )
+    for k in ("count",):
+        assert np.array_equal(legacy[k], fused[k])
+    for k in ("sums", "mins", "maxs"):
+        assert np.array_equal(legacy[k]["v"], fused[k]["v"])

@@ -297,13 +297,20 @@ def test_budget_table_row_count_pinned():
     """The reviewed budget-table shape: one row per audited signature.
     Adding a kernel forces a row (the table is total); dropping one
     forces deleting the row AND this pin."""
-    assert len(kernel_budgets.BUDGETS) == 11
+    assert len(kernel_budgets.BUDGETS) == 18
     assert set(kernel_budgets.BUDGETS) == {
         "measure/flat-count",
         "measure/group-eq-lut",
         "measure/percentile-hist",
         "measure/or-expr",
         "measure/topn-dashboard",
+        "fused/flat-count",
+        "fused/group-eq-lut",
+        "fused/percentile-hist",
+        "fused/or-expr",
+        "fused/topn-dashboard",
+        "fused/multi-chunk",
+        "fused/dist-step",
         "stream/mask-eq-in",
         "ops/group_reduce",
         "ops/group_histogram",
