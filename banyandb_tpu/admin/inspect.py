@@ -59,7 +59,12 @@ def inspect_root(root: str | Path) -> dict:
 
 
 def inspect_part(part_dir: str | Path) -> dict:
-    """Column-level stats for one part (cmd/dump measure analog)."""
+    """Column-level stats for one part (cmd/dump measure analog).
+
+    ``zone_maps`` reports whether every block carries the per-column
+    zone maps the planner skips on (parts written before the zone-map
+    format upgrade load and scan fine, they just never skip — this is
+    how an operator tells the two apart)."""
     p = Part(part_dir)
     part_dir = Path(part_dir)
     cols = {}
@@ -68,11 +73,17 @@ def inspect_part(part_dir: str | Path) -> dict:
     return {
         "meta": p.meta,
         "files": cols,
+        "zone_maps": p.has_zone_maps(),
         "blocks": [
             {
                 "count": b["count"],
                 "ts": [b["min_ts"], b["max_ts"]],
                 "series": [b["min_series"], b["max_series"]],
+                **(
+                    {"zones": sorted(b["zones"])}
+                    if "zones" in b
+                    else {}
+                ),
             }
             for b in p.blocks
         ],

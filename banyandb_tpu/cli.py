@@ -111,6 +111,17 @@ def main(argv=None) -> int:
     ins.add_argument("--root", help="server root (offline mode)")
     ins.add_argument("--part", help="one part dir for column detail")
 
+    dp = sub.add_parser(
+        "dump",
+        help="offline part dump (cmd/dump analog): column extents, "
+        "block stats, zone-map presence",
+    )
+    dp.add_argument(
+        "kind", choices=["measure", "stream", "trace"],
+        help="expected resource kind (validated against part metadata)",
+    )
+    dp.add_argument("part_dir", help="one part-<id> directory")
+
     lc = sub.add_parser(
         "lifecycle",
         help="tier migration agent (banyand-lifecycle CLI analog)",
@@ -238,6 +249,18 @@ def main(argv=None) -> int:
         else:
             print("inspect needs --root or --part", file=sys.stderr)
             return 2
+    elif args.cmd == "dump":
+        from banyandb_tpu.admin.inspect import inspect_part
+
+        doc = inspect_part(args.part_dir)
+        if doc["meta"].get(args.kind) is None:
+            print(
+                f"dump: {args.part_dir} is not a {args.kind} part "
+                f"(meta: {sorted(doc['meta'])})",
+                file=sys.stderr,
+            )
+            return 2
+        print(json.dumps(doc, indent=1))
     elif args.cmd == "lifecycle":
         # offline agent form, like the reference's standalone lifecycle
         # CLI: open the node's storage directly (the node process must

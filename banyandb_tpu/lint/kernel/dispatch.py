@@ -423,11 +423,16 @@ def _multichunk_scenario():
     return run
 
 
-def _stream_scenario():
+def _stream_scenario(narrow: bool = False):
+    """The stream retrieval-mask scenario; ``narrow=True`` feeds the
+    source at stored i8 code width (the device-decode read path,
+    models/stream narrow_codes) so the widen-on-device ship form is
+    budget-audited alongside the dense one."""
     from banyandb_tpu.api.model import Condition
     from banyandb_tpu.query import precompile, stream_exec
 
     builtin = dict(precompile.builtin_masks())["stream/mask-eq-in"]
+    code_dtype = np.int8 if narrow else np.int32
 
     def run():
         n = 32768
@@ -438,11 +443,11 @@ def _stream_scenario():
             {
                 "svc": (
                     [b"a", b"b"],
-                    rng.integers(0, 2, n).astype(np.int32),
+                    rng.integers(0, 2, n).astype(code_dtype),
                 ),
                 "region": (
                     [b"r0", b"r1", b"r2", b"r3"],
-                    rng.integers(0, 4, n).astype(np.int32),
+                    rng.integers(0, 4, n).astype(code_dtype),
                 ),
             },
             {},
@@ -454,7 +459,8 @@ def _stream_scenario():
         mask = stream_exec.device_tag_mask(src, conds)
         assert mask is not None and mask.shape == (n,)
 
-    return ("stream/mask-eq-in", builtin, run)
+    name = "stream+decode/mask-eq-in" if narrow else "stream/mask-eq-in"
+    return (name, builtin, run)
 
 
 def _ql_scenarios():
@@ -508,56 +514,82 @@ def _anchor(kind: str) -> tuple[str, int]:
 
 
 @contextlib.contextmanager
-def _env(name: str, value: Optional[str]):
-    """Scoped os.environ override (None = leave the ambient value)."""
+def _env(overrides: Optional[dict]):
+    """Scoped os.environ overrides ({} / None = ambient values)."""
     import os
 
-    if value is None:
-        yield
-        return
-    saved = os.environ.get(name)
-    os.environ[name] = value
+    overrides = overrides or {}
+    saved = {name: os.environ.get(name) for name in overrides}
+    os.environ.update(overrides)
     try:
         yield
     finally:
-        if saved is None:
-            os.environ.pop(name, None)
-        else:
-            os.environ[name] = saved
+        for name, old in saved.items():
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
 
 
 def audit_dispatch() -> dict[str, DispatchTrace]:
     """Run every scenario under the stub device -> measured traces.
 
-    Each measure scenario runs TWICE: once with ``BYDB_FUSED=0`` (the
-    staged per-chunk loop, the ``measure/*`` rows) and once with the
-    fused whole-plan executor on (the ``fused/*`` rows, pinned to the
+    Each measure scenario runs THREE times: with ``BYDB_FUSED=0`` (the
+    staged per-chunk loop, the ``measure/*`` rows), with the fused
+    whole-plan executor on (the ``fused/*`` rows, pinned to the
     precompile registry's builtin FusedSpecs at dispatches=1/gets=1),
-    plus the multi-chunk staging tripwire."""
+    and with fused + ``BYDB_DEVICE_DECODE=1`` (the ``fused+decode/*``
+    rows: the compressed ship form must STILL cost exactly one dispatch
+    and one batched get — the decode stage fuses into the plan program
+    or the whole point is lost), plus the multi-chunk staging tripwire.
+    The measure/fused rows pin ``BYDB_DEVICE_DECODE=0`` explicitly so
+    their put counts stay the dense-ship baseline regardless of the
+    ambient default."""
     from banyandb_tpu.query import precompile
 
+    staged_env = {"BYDB_FUSED": "0", "BYDB_DEVICE_DECODE": "0"}
+    fused_env = {"BYDB_FUSED": "1", "BYDB_DEVICE_DECODE": "0"}
+    decode_env = {"BYDB_FUSED": "1", "BYDB_DEVICE_DECODE": "1"}
     scenarios = [
-        (name, "measure", builtin, run, "0")
+        (name, "measure", builtin, run, staged_env)
         for name, builtin, run in _measure_scenarios()
     ]
     fused_builtins = dict(precompile.builtin_fused())
     for name, _builtin, run in _measure_scenarios():
         fname = name.replace("measure/", "fused/")
-        scenarios.append((fname, "measure", fused_builtins[fname], run, "1"))
+        scenarios.append((fname, "measure", fused_builtins[fname], run, fused_env))
+    for name, _builtin, run in _measure_scenarios():
+        dname = name.replace("measure/", "fused+decode/")
+        # same builtin FusedSpec: the ship form changes the chunk
+        # pytree, never the plan signature
+        scenarios.append(
+            (dname, "measure", fused_builtins[name.replace("measure/", "fused/")], run, decode_env)
+        )
     scenarios.append(
-        ("fused/multi-chunk", "measure", None, _multichunk_scenario(), "1")
+        ("fused/multi-chunk", "measure", None, _multichunk_scenario(), fused_env)
+    )
+    scenarios.append(
+        (
+            "fused+decode/multi-chunk",
+            "measure",
+            None,
+            _multichunk_scenario(),
+            decode_env,
+        )
     )
     s_name, s_builtin, s_run = _stream_scenario()
-    scenarios.append((s_name, "stream_mask", s_builtin, s_run, None))
+    scenarios.append((s_name, "stream_mask", s_builtin, s_run, {"BYDB_DEVICE_DECODE": "0"}))
+    d_name, d_builtin, d_run = _stream_scenario(narrow=True)
+    scenarios.append((d_name, "stream_mask", d_builtin, d_run, {"BYDB_DEVICE_DECODE": "1"}))
     scenarios += [
         (name, "ql", builtin, run, None)
         for name, builtin, run in _ql_scenarios()
     ]
 
     out: dict[str, DispatchTrace] = {}
-    for name, kind, builtin, run, fused_env in scenarios:
+    for name, kind, builtin, run, env in scenarios:
         path, line = _anchor(kind)
-        with stub_device() as counters, _env("BYDB_FUSED", fused_env):
+        with stub_device() as counters, _env(env):
             error = ""
             try:
                 run()

@@ -93,11 +93,31 @@ BUDGETS: dict[str, KernelBudget] = {
     # (dispatch columns only: the bucket is synthesized per run, so it
     # has no standing jaxpr/lowering entry)
     "fused/multi-chunk":       _b(1, 1, 5),
+    # device-side decode twins (BYDB_DEVICE_DECODE=1, ROADMAP item 3):
+    # the compressed ship form — narrow local codes + [S, L] remap LUTs
+    # + src-ordinals + narrow int fields — STILL costs exactly one
+    # dispatch and one batched get (decode fuses into the plan program);
+    # puts grow by the LUT/ordinal ships, bytes_class is pinned so the
+    # in-program decode can never double the traffic class, and
+    # widest=4 proves the i8->i32 widen never leaks 64-bit
+    "fused+decode/flat-count":      _b(1, 1, 5, 4, 19, 4, 0),
+    "fused+decode/group-eq-lut":    _b(1, 1, 11, 4, 22, 5, 0),
+    "fused+decode/percentile-hist": _b(1, 1, 8, 4, 24, 4, 0),
+    "fused+decode/or-expr":         _b(1, 1, 9, 4, 20, 3, 0),
+    "fused+decode/topn-dashboard":  _b(1, 1, 10, 4, 23, 5, 0),
+    # compressed multi-chunk tripwire: staging AND decode-stage
+    # de-fusion both show up here first
+    "fused+decode/multi-chunk":     _b(1, 1, 5),
     # fused chunked-scan mesh step: the whole distributed scan as one
     # collective program, SAME psum(count/sums)+pmin+pmax set
     "fused/dist-step":         _b(widest=4, bytes_class=16, fusion_class=4, collectives=4),
     # stream retrieval mask: whole bool mask in one get
     "stream/mask-eq-in":       _b(1, 1, 3, 4, 19, 1, 0),
+    # the narrow-ship twin (i8 source codes widened on device): same
+    # dispatch/transfer shape — an extra put here means the stream
+    # decode stage de-fused (dispatch columns only: the narrow form has
+    # no standing jaxpr/lowering entry)
+    "stream+decode/mask-eq-in": _b(1, 1, 3),
     # shared ops reductions every plan lowers onto (no executor path of
     # their own: jaxpr + lowering columns only)
     "ops/group_reduce":        _b(widest=4, bytes_class=24, fusion_class=3, collectives=0),

@@ -767,10 +767,20 @@ class MeasureEngine:
         is byte-identical to the strict-serial path (BYDB_PIPELINE=0)."""
         from banyandb_tpu.storage.chunk_stream import prefetched
 
+        from banyandb_tpu.storage import encoded as enc_mod
+
         read_ops = []
         tag_names = _tag_col_names(m)  # incl. '@f:' raw-field columns
         field_names = [f.name for f in _numeric_fields(m)]
         entity_conds = _entity_eq_conditions(m, req)
+        narrow = enc_mod.device_decode_enabled()
+        # Zone-map skipping (ROADMAP item 3 / arXiv 2104.12815):
+        # conjunctive eq/in tag predicates prune at BLOCK granularity
+        # against the per-block code zone maps written at flush/merge —
+        # a skipped block is never read, let alone decoded.
+        zone_conds = (
+            _conjunctive_eq_conditions(req) if enc_mod.zone_skip_enabled() else []
+        )
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
         ):
@@ -836,6 +846,7 @@ class MeasureEngine:
                     blocks,
                     tags=[t for t in tag_names if t in part.meta["tags"]],
                     fields=[f for f in field_names if f in part.meta["fields"]],
+                    narrow_codes=narrow,
                 )
                 return filt(src, src.cache_key)
 
@@ -849,13 +860,54 @@ class MeasureEngine:
                             mc, mc.cache_key
                         )
                     )
-                for part in shard.parts:
-                    if part.meta.get("measure") != m.name:
-                        continue
-                    blocks = part.select_blocks(
+                shard_parts = [
+                    p for p in shard.parts if p.meta.get("measure") == m.name
+                ]
+                # Zone skipping is dedup-safety-gated: a block whose
+                # zones exclude every predicate value may still hold the
+                # NEWEST version of a (series, ts) row whose older,
+                # matching copy lives in a kept source — dropping it
+                # would resurrect the stale row.  So first collect every
+                # kept source's key interval across the whole shard
+                # (version dedup is scoped to a shard: series hash to
+                # exactly one, segments partition time), then let
+                # select_blocks drop only overlap-free marked blocks.
+                plans: list = []  # (part, candidate blocks, marked set)
+                kept_intervals: list = []
+                if zone_conds and shard_parts:
+                    from banyandb_tpu.storage.part import KeyInterval
+
+                    if mem_cols is not None and mem_cols.ts.size:
+                        kept_intervals.append(
+                            KeyInterval.conservative(
+                                int(mem_cols.series.min()),
+                                int(mem_cols.series.max()),
+                                int(mem_cols.ts.min()),
+                                int(mem_cols.ts.max()),
+                            )
+                        )
+                for part in shard_parts:
+                    cands = part.select_blocks(
                         req.time_range.begin_millis,
                         req.time_range.end_millis,
                         series_ids=series_ids,
+                    )
+                    marked: set = set()
+                    if zone_conds:
+                        marked = part.zone_marked(
+                            cands, _part_zone_preds(part, zone_conds)
+                        )
+                        kept_intervals.extend(
+                            part.block_interval(i)
+                            for i in cands
+                            if i not in marked
+                        )
+                    plans.append((part, cands, marked))
+                for part, cands, marked in plans:
+                    blocks = (
+                        part.finalize_zone_skip(cands, marked, kept_intervals)
+                        if marked
+                        else cands
                     )
                     if blocks:
                         read_ops.append(
@@ -1068,6 +1120,60 @@ def _entity_eq_conditions(m: Measure, req: QueryRequest):
                 (c.name, [measure_exec._tag_value_bytes(v) for v in c.value])
             )
     return out
+
+
+def _conjunctive_eq_conditions(req: QueryRequest):
+    """[(tag, [byte values])] from eq/in conditions that are REQUIRED
+    (pure-AND criteria tree).  Any OR anywhere disables zone pruning —
+    a disjunct must never skip blocks its sibling could match."""
+    try:
+        conds = measure_exec._collect_conditions(req.criteria)
+    except NotImplementedError:
+        return []
+    out = []
+    for c in conds:
+        try:
+            if c.op == "eq":
+                out.append((c.name, [measure_exec._tag_value_bytes(c.value)]))
+            elif c.op == "in":
+                out.append(
+                    (c.name, [measure_exec._tag_value_bytes(v) for v in c.value])
+                )
+        except TypeError:
+            continue  # unsupported literal type: no pruning on this cond
+    return out
+
+
+def _part_zone_preds(part, zone_conds) -> list:
+    """Lower conjunctive eq/in tag conditions onto ONE part's local
+    dictionary -> zone_preds for select_blocks.
+
+    The zone maps store per-block LOCAL code ranges, so each predicate
+    value resolves to this part's local code first.  A part whose
+    dictionary holds NONE of a required predicate's values cannot match
+    at all — expressed as an EMPTY allowed set, which marks every block
+    (select_blocks still applies the dedup-safety overlap check before
+    any block actually skips).  A tag column absent from the part
+    entirely means every row carries the implicit empty value, so only
+    an explicit empty-value predicate can match.
+    """
+    if not zone_conds:
+        return []
+    none_match = [("*", np.zeros(0, dtype=np.int64))]
+    preds: list = []
+    part_tags = set(part.meta.get("tags", ()))
+    for name, values in zone_conds:
+        if name not in part_tags:
+            # schema evolution: rows carry the empty value for this tag
+            if b"" not in values:
+                return none_match
+            continue
+        lut = part.dict_index(name)  # cached reverse map
+        codes = sorted({lut[v] for v in values if v in lut})
+        if not codes:
+            return none_match
+        preds.append((f"tag_{name}", np.asarray(codes, dtype=np.int64)))
+    return preds
 
 
 # -- index-mode measures (doc-per-point in the series index) ---------------

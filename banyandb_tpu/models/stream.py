@@ -26,6 +26,7 @@ from banyandb_tpu.obs import metrics as obs_metrics
 from banyandb_tpu.obs.tracer import NOOP_TRACER, Tracer
 from banyandb_tpu.query import filter as qfilter
 from banyandb_tpu.query import measure_exec
+from banyandb_tpu.storage import encoded as _encoded
 from banyandb_tpu.storage.memtable import PayloadMemtable
 from banyandb_tpu.storage.part import ColumnData
 from banyandb_tpu.storage.tsdb import TSDB
@@ -291,6 +292,10 @@ class StreamEngine:
                             blocks = [b for b in blocks if b in allowed]
                     stats["blocks_read"] += len(blocks)
                     if blocks:
+                        # narrow_codes: tag columns keep their stored
+                        # i8/i16 width so the device mask kernel
+                        # (stream_exec.device_tag_mask) ships them
+                        # compressed and widens on device
                         read_ops.append(
                             lambda p=part, b=blocks: p.read(
                                 b,
@@ -300,6 +305,7 @@ class StreamEngine:
                                     if t in p.meta["tags"]
                                 ],
                                 want_payload=True,
+                                narrow_codes=_encoded.device_decode_enabled(),
                             )
                         )
         for src in prefetched(read_ops, name="bydb-stream-prefetch"):

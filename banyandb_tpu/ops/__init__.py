@@ -6,7 +6,15 @@ shaped JAX computations that XLA fuses onto the TPU's VPU/MXU.
 """
 
 from banyandb_tpu.ops.blocks import ColumnBatch, pad_rows_bucket
-from banyandb_tpu.ops.decode import delta_decode, dod_decode, dict_gather
+from banyandb_tpu.ops.decode import (
+    decode_chunk,
+    delta_decode,
+    dict_gather,
+    dict_remap,
+    dod_decode,
+    ints_to_f32,
+    widen_codes,
+)
 from banyandb_tpu.ops.filter import (
     mask_and,
     mask_or,

@@ -181,6 +181,81 @@ def fused_group_multi(
     )
 
 
+# -- device-side decode kernels (ROADMAP item 3) -----------------------------
+# The compressed-ship path (storage/encoded.py + ops/decode.py) lands
+# narrow i8/i16 columns in HBM; these kernels widen them at VMEM tile
+# granularity.  bench r03 measured the Pallas decode shape class at
+# ~89 Gpoints/s — the jnp fallback (ops.decode.widen_codes / a plain
+# jnp.cumsum) is what runs on CPU and is what the parity tests pin.
+
+
+def _widen_kernel(x_ref, out_ref):
+    out_ref[:] = x_ref[:].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def widen_narrow(x: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """Narrow i8/i16 column [N] -> i32, tiled through VMEM.
+
+    N must be a TILE multiple (the pad/ship stage's power-of-two row
+    buckets guarantee this above TILE); callers with other shapes use
+    the jnp fallback."""
+    n = x.shape[0]
+    assert n % TILE == 0, f"N={n} must be a multiple of {TILE}"
+    x2 = x.reshape(1, n)
+    out = pl.pallas_call(
+        _widen_kernel,
+        grid=(n // TILE,),
+        in_specs=[pl.BlockSpec((1, TILE), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((1, TILE), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        interpret=interpret,
+    )(x2)
+    return out[0]
+
+
+def _prefix_sum_kernel(x_ref, out_ref, carry_ref):
+    # Sequential TPU grid: tile i adds the running total of tiles < i
+    # (carried in a [1, 1] output block every step revisits) to its own
+    # in-tile cumsum — an exact integer prefix sum across the column.
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry_ref[:] = jnp.zeros_like(carry_ref)
+
+    c = jnp.cumsum(x_ref[:].astype(jnp.int32), axis=-1) + carry_ref[0, 0]
+    out_ref[:] = c
+    carry_ref[0, 0] = c[0, -1]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def prefix_sum_narrow(x: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """Inclusive i32 prefix sum of a narrow delta column [N] (N a TILE
+    multiple) — the delta-decode hot loop: with x[0] = first and
+    x[1:] = deltas, out IS the decoded series (ops.decode.delta_decode's
+    fixed-width contract).  Exact integer math, so the jnp.cumsum
+    fallback is bit-identical."""
+    n = x.shape[0]
+    assert n % TILE == 0, f"N={n} must be a multiple of {TILE}"
+    x2 = x.reshape(1, n)
+    out, _carry = pl.pallas_call(
+        _prefix_sum_kernel,
+        grid=(n // TILE,),
+        in_specs=[pl.BlockSpec((1, TILE), lambda i: (0, i))],
+        out_specs=(
+            pl.BlockSpec((1, TILE), lambda i: (0, i)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, n), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(x2)
+    return out[0]
+
+
 @functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
 def fused_group_sum(
     codes: jax.Array,

@@ -93,10 +93,23 @@ _KERNEL_CACHE: dict[FusedSpec, object] = {}
 
 def _build_kernel(fspec: FusedSpec):
     """jit the whole-plan program: scan the shared per-chunk body over
-    the stacked chunk axis, emitting stacked per-chunk partials."""
+    the stacked chunk axis, emitting stacked per-chunk partials.
+
+    Compressed part-batches (``BYDB_DEVICE_DECODE``) decode FIRST,
+    inside this same program: ops.decode.decode_chunk widens/remaps the
+    whole stacked ``[C, nrows]`` batch (the remap LUTs are per-batch,
+    not per-chunk, so decoding before the scan avoids broadcasting them
+    down the scanned axis), then the scan body sees exactly the
+    canonical chunks the staged kernel decodes per chunk — elementwise
+    integer decode, so fused-vs-staged stays byte-identical in either
+    ship form."""
+    from banyandb_tpu.ops import decode as ops_decode
+
     body = _kernel_body(fspec.plan)
 
     def fused(chunks: dict, pred_vals: dict, hist_lo, hist_span):
+        chunks = ops_decode.decode_chunk(chunks)
+
         def step(carry, chunk):
             return carry, body(chunk, pred_vals, hist_lo, hist_span)
 
@@ -113,8 +126,18 @@ def _num_hist_buckets() -> int:
 
 
 def estimate_bytes(spec: PlanSpec, num_chunks: int) -> int:
-    """f32/i32 device footprint of one fused part-batch: stacked input
-    columns plus the stacked per-chunk partials pytree."""
+    """Device footprint of one fused part-batch: stacked input columns
+    plus the stacked per-chunk partials pytree.
+
+    Under ``BYDB_DEVICE_DECODE`` the compressed inputs (narrow tag/field
+    buffers, the i16 src-ordinal column) are resident ALONGSIDE the
+    decoded i32/f32 copies the in-program decode stage materializes
+    before the scan, so the ceiling accounts both — else a batch sized
+    at ``BYDB_FUSED_MAX_MB`` would OOM instead of taking the intended
+    staged fallback.  (The [S, L] remap LUTs are a rounding error next
+    to the per-row columns and ride the same conservative margin.)"""
+    from banyandb_tpu.storage import encoded as enc_mod
+
     g = spec.num_groups
     nf = len(spec.fields)
     per_chunk_out = g * (1 + nf + (2 * nf if spec.want_minmax else 0))
@@ -123,7 +146,11 @@ def estimate_bytes(spec: PlanSpec, num_chunks: int) -> int:
     if spec.want_rep:
         per_chunk_out += 2 * g
     cols = 4 + len(spec.tags_code) + nf  # ts/series/valid/row + tags + fields
-    return 4 * num_chunks * (cols * spec.nrows + per_chunk_out)
+    per_row = 4 * cols
+    if enc_mod.device_decode_enabled():
+        # narrow inputs (<=2 B/row per tag/field) + src_ord (2 B/row)
+        per_row += 2 + 2 * (len(spec.tags_code) + nf)
+    return num_chunks * (per_row * spec.nrows + 4 * per_chunk_out)
 
 
 def eligible(spec: PlanSpec, n_chunks: int) -> bool:
@@ -141,19 +168,26 @@ def _stacked_chunks(
     num_chunks: int,
     epoch: int,
     pad_ship_s: list | None = None,
+    ship_stats: list | None = None,
 ) -> dict:
     """Pad the gathered columns into ``[C, nrows]`` device arrays.
 
     Chunk layout (per-row dtypes, padding, the epoch-relative int32 ts,
     the global row index) matches measure_exec._device_chunk exactly —
     the scan body sees per-chunk inputs identical to the staged
-    kernel's.  Per-column pad work rides the chunk_stream prefetch
-    worker (BYDB_PIPELINE honored) so padding column j+1 overlaps
-    shipping column j.
+    kernel's, in EITHER ship form: compressed snapshots
+    (``BYDB_DEVICE_DECODE``) stack the narrow local tag codes, the
+    per-row source ordinals and exact-int fields, plus the per-batch
+    [S, L] remap LUTs the in-program decode stage consumes.  Per-column
+    pad work rides the chunk_stream prefetch worker (BYDB_PIPELINE
+    honored) so padding column j+1 overlaps shipping column j.
+    ``ship_stats`` collects one (shipped, dense) byte pair for the
+    whole part-batch (decode-span attribution).
     """
     from banyandb_tpu.storage.chunk_stream import prefetched
 
     C, nb = num_chunks, spec.nrows
+    compressed = "src_ord" in cols
 
     def pad2(get, dtype):
         out = np.zeros((C, nb), dtype=dtype)
@@ -174,16 +208,63 @@ def _stacked_chunks(
         valid2,
         lambda: pad2(lambda s, e: np.arange(s, e, dtype=np.int32), np.int32),
     ]
-    for t in spec.tags_code:
-        paths.append(("tags_code", t))
-        thunks.append(
-            lambda t=t: pad2(lambda s, e: cols["tags_code"][t][s:e], np.int32)
-        )
-    for f in spec.fields:
-        paths.append(("fields", f))
-        thunks.append(
-            lambda f=f: pad2(lambda s, e: cols["fields"][f][s:e], np.float32)
-        )
+    counted: set = set()
+    if compressed:
+        from banyandb_tpu.storage import encoded as enc_mod
+
+        if spec.tags_code:
+            for t in spec.tags_code:
+                paths.append(("tags_enc", t))
+                counted.add(("tags_enc", t))
+                thunks.append(
+                    lambda t=t: pad2(
+                        lambda s, e: cols["tags_enc"][t][s:e],
+                        cols["tags_enc"][t].dtype,
+                    )
+                )
+                paths.append(("tags_lut", t))
+                counted.add(("tags_lut", t))
+                thunks.append(
+                    lambda t=t: enc_mod.pack_luts(cols["tags_lut"][t])
+                )
+            paths.append(("src_ord",))
+            counted.add(("src_ord",))
+            thunks.append(
+                lambda: pad2(
+                    lambda s, e: cols["src_ord"][s:e], enc_mod.SRC_ORD_DTYPE
+                )
+            )
+        for f in spec.fields:
+            ndt = cols["fields_narrow"].get(f)
+            if ndt is not None:
+                paths.append(("fields_enc", f))
+                counted.add(("fields_enc", f))
+                thunks.append(
+                    lambda f=f, ndt=ndt: pad2(
+                        lambda s, e: cols["fields"][f][s:e], ndt
+                    )
+                )
+            else:
+                paths.append(("fields", f))
+                counted.add(("fields", f))
+                thunks.append(
+                    lambda f=f: pad2(
+                        lambda s, e: cols["fields"][f][s:e], np.float32
+                    )
+                )
+    else:
+        for t in spec.tags_code:
+            paths.append(("tags_code", t))
+            counted.add(("tags_code", t))
+            thunks.append(
+                lambda t=t: pad2(lambda s, e: cols["tags_code"][t][s:e], np.int32)
+            )
+        for f in spec.fields:
+            paths.append(("fields", f))
+            counted.add(("fields", f))
+            thunks.append(
+                lambda f=f: pad2(lambda s, e: cols["fields"][f][s:e], np.float32)
+            )
 
     def timed(fn):
         def pad_thunk():  # host-side work on the prefetch worker
@@ -196,7 +277,14 @@ def _stacked_chunks(
 
         return pad_thunk
 
-    out: dict = {"tags_code": {}, "fields": {}}
+    out: dict = {
+        "tags_code": {},
+        "tags_enc": {},
+        "tags_lut": {},
+        "fields": {},
+        "fields_enc": {},
+    }
+    shipped = 0
     for path, arr in zip(
         paths,
         prefetched([timed(fn) for fn in thunks], name="bydb-fused-pad"),
@@ -205,10 +293,21 @@ def _stacked_chunks(
         dev = jnp.asarray(arr)
         if pad_ship_s is not None:
             pad_ship_s.append(time.perf_counter() - t0)
+        if path in counted:
+            shipped += dev.nbytes
         if len(path) == 1:
             out[path[0]] = dev
         else:
             out[path[0]][path[1]] = dev
+    # canonical keys (tags_code/fields) stay present even when empty —
+    # the pre-decode chunk structure the staged path and the precompile
+    # warm args share; the compressed-only keys appear only when used
+    for key in ("tags_enc", "tags_lut", "fields_enc"):
+        if not out[key]:
+            del out[key]
+    if ship_stats is not None:
+        dense = (len(spec.tags_code) + len(spec.fields)) * C * nb * 4
+        ship_stats.append((shipped, dense))
     return out
 
 
@@ -224,6 +323,7 @@ def run_fused(
     gather_key=None,
     dev_cache=None,
     pad_ship_s: list | None = None,
+    ship_stats: list | None = None,
 ) -> tuple[list[dict], float, str]:
     """Execute one part-batch through the fused program.
 
@@ -247,7 +347,8 @@ def run_fused(
     def _build():
         built.append(1)
         return _stacked_chunks(
-            chunks_np, chunk_spans, spec, num_chunks, epoch, pad_ship_s
+            chunks_np, chunk_spans, spec, num_chunks, epoch, pad_ship_s,
+            ship_stats=ship_stats,
         )
 
     if dev_cache is not None:

@@ -50,6 +50,11 @@ from banyandb_tpu.utils import hostops
 _H_GATHER = obs_metrics.stage_histogram("gather")
 _H_DEVICE = obs_metrics.stage_histogram("device_execute")
 _H_MERGE = obs_metrics.stage_histogram("merge")
+# the pad/pack/ship half of the decode stage (ROADMAP item 3): host-side
+# narrow packing + H2D transfer time; the device half (widen/remap/f32
+# convert) is fused INSIDE the plan kernel and shows up in
+# device_execute, which is exactly the point
+_H_DECODE = obs_metrics.stage_histogram("decode")
 
 CHUNK = 8192
 # Scan chunks are much larger than storage blocks (8192 rows,
@@ -222,8 +227,21 @@ def _kernel_body(spec: PlanSpec):
 
 
 def _build_kernel(spec: PlanSpec):
-    """Construct + jit the per-chunk partial computation for `spec`."""
-    return jax.jit(_kernel_body(spec))
+    """Construct + jit the per-chunk partial computation for `spec`.
+
+    The device-side decode stage (ops.decode.decode_chunk) runs FIRST
+    inside the jitted program: chunks arriving in the compressed ship
+    form (narrow dict codes + [S, L] remap LUTs + narrow int fields,
+    ``BYDB_DEVICE_DECODE``) widen/remap on device, fused into the same
+    dispatch; canonical (pre-decoded) chunks pass through untouched, so
+    one jitted kernel serves both ship forms (jit re-specializes per
+    chunk pytree structure)."""
+    body = _kernel_body(spec)
+
+    def kernel(chunk: dict, pred_vals: dict, hist_lo, hist_span):
+        return body(ops.decode_chunk(chunk), pred_vals, hist_lo, hist_span)
+
+    return jax.jit(kernel)
 
 
 class GlobalDicts:
@@ -502,6 +520,29 @@ class Partials:
             for j, t in enumerate(self.group_tags)
         )
 
+    def content_bytes(self) -> bytes:
+        """Canonical byte serialization of every numeric/representative
+        component — THE byte-parity oracle the A/B contracts
+        (``BYDB_FUSED``, ``BYDB_DEVICE_DECODE``, ``BYDB_PIPELINE``)
+        are asserted against (tests/test_fused_exec.py,
+        tests/test_decode.py, scripts/decode_smoke.py all compare this
+        one serialization, so a new Partials field added here is
+        parity-pinned everywhere at once)."""
+        parts = [
+            self.count.tobytes(),
+            self.codes.tobytes() if self.codes is not None else b"",
+        ]
+        for d in (self.sums, self.mins, self.maxs):
+            for k in sorted(d):
+                parts.append(d[k].tobytes())
+        if self.hist is not None:
+            parts.append(self.hist.tobytes())
+        if self.rep_key is not None:
+            parts.append(self.rep_key.tobytes())
+        if self.rep_vals is not None:
+            parts.append(repr(sorted(self.rep_vals.items())).encode())
+        return b"".join(parts)
+
 
 def execute_aggregate(
     measure: Measure,
@@ -621,6 +662,13 @@ def compute_partials(
             for t in tags_code:
                 gd.ensure(t)
 
+    # the compressed-ship flag is read ONCE per query and pinned into the
+    # gather cache key: the two ship forms produce differently-shaped
+    # gathered snapshots, and a live flag flip must never serve one
+    # mode's cache entry to the other
+    from banyandb_tpu.storage import encoded as enc_mod
+
+    device_decode = enc_mod.device_decode_enabled()
     gather_key = None
     if dict_state is not None and sources and all(
         s.cache_key is not None for s in sources
@@ -633,6 +681,7 @@ def compute_partials(
             request.time_range.end_millis,
             tuple(sorted(tags_code)),
             tuple(sorted(fields)),
+            device_decode,
         )
 
     def _do_gather():
@@ -644,6 +693,7 @@ def compute_partials(
             request.time_range.begin_millis,
             request.time_range.end_millis,
             dict_state=dict_state,
+            device_decode=device_decode,
         )
 
     t_gather0 = _time.perf_counter()
@@ -885,7 +935,8 @@ def _reduce_partials(
             agg_is_float = False
     if agg_is_float and n:
         out = _host_float_partials(
-            measure, None, chunks_np, conds, expr, pred_vals, spec,
+            measure, None, _materialize_tag_codes(chunks_np, spec.tags_code),
+            conds, expr, pred_vals, spec,
             group_values, rep_tags, rep_desc, want_rep, gd, dict_state,
         )
         if span is not None:
@@ -963,12 +1014,21 @@ def _reduce_partials(
     # objects themselves are single-owner and never touched off-thread
     pad_ship_s: list = []
     chunks_built: list = []
+    # (shipped, dense) bytes per built chunk: the decode span's
+    # compression evidence (dense = what the decoded i32/f32 ship form
+    # would have moved for the same columns)
+    ship_stats: list = []
+
+    lut_cache: dict = {}  # remap LUTs ship once per reduction
 
     def _build_chunk(start: int, end: int):
         t0 = _time.perf_counter()
         chunks_built.append(1)
         try:
-            return _device_chunk(chunks_np, start, end, spec, epoch)
+            return _device_chunk(
+                chunks_np, start, end, spec, epoch, ship_stats=ship_stats,
+                lut_cache=lut_cache,
+            )
         finally:
             pad_ship_s.append(_time.perf_counter() - t0)
 
@@ -1021,6 +1081,7 @@ def _reduce_partials(
             gather_key=gather_key,
             dev_cache=dev_cache,
             pad_ship_s=pad_ship_s,
+            ship_stats=ship_stats,
         )
         dispatches = 1
         for moved in moved_chunks:
@@ -1051,6 +1112,36 @@ def _reduce_partials(
             device_s += _time.perf_counter() - t_d
             _absorb(moved)
     _H_DEVICE.observe(device_s * 1000)
+    # -- decode stage attribution (ROADMAP item 3) ------------------------
+    # host half = narrow pack + pad + H2D ship (pad_ship_s, overlapped
+    # with device execution under BYDB_PIPELINE); the device half
+    # (widen/remap/f32 convert) is fused into the plan dispatch and is
+    # deliberately part of device_execute.  Byte counters make the
+    # compression win attributable even on a cpu-fallback bench run.
+    decode_ms = sum(pad_ship_s) * 1000
+    shipped_bytes = sum(s for s, _ in ship_stats)
+    dense_bytes = sum(d for _, d in ship_stats)
+    decode_mode = "device" if "src_ord" in chunks_np else "host"
+    _H_DECODE.observe(decode_ms)
+    if ship_stats:
+        meter = obs_metrics.global_meter()
+        meter.counter_add(
+            "decode_ship_bytes", float(shipped_bytes), labels={"form": "shipped"}
+        )
+        meter.counter_add(
+            "decode_ship_bytes", float(dense_bytes), labels={"form": "dense"}
+        )
+    if span is not None:
+        dspan = span.child("decode")
+        dspan.tag("mode", decode_mode).tag(
+            "host_ms", round(decode_ms, 3)
+        ).tag("shipped_bytes", shipped_bytes).tag(
+            "dense_bytes", dense_bytes
+        ).tag(
+            "ratio",
+            round(dense_bytes / shipped_bytes, 2) if shipped_bytes else 1.0,
+        )
+        dspan.finish()
     if span is not None:
         total_ms = (_time.perf_counter() - t_reduce0) * 1000
         span.tag("device_ms", round(device_s * 1000, 3)).tag(
@@ -1096,7 +1187,7 @@ def _reduce_partials(
             for t in rep_tags:
                 vals_list = gd.values(t)
                 varr = np.asarray(vals_list, dtype=object)
-                rep_codes_t = chunks_np["tags_code"][t][rows]
+                rep_codes_t = _host_tag_codes(chunks_np, t, rows)
                 rep_vals[t] = varr[rep_codes_t].tolist()
     elif rep_tags:
         rep_vals = {t: [] for t in rep_tags}
@@ -1302,12 +1393,28 @@ def _gather_rows(
     begin_millis: int,
     end_millis: int,
     dict_state: Optional[DictState] = None,
+    device_decode: bool = False,
 ) -> dict:
     """Concatenate sources with row-exact time filtering, global-code remap
-    and version dedup (block pruning upstream is only block-granular)."""
+    and version dedup (block pruning upstream is only block-granular).
+
+    ``device_decode`` (ROADMAP item 3, ``BYDB_DEVICE_DECODE``): the
+    gathered snapshot keeps tag columns in the COMPRESSED ship form —
+    per-row narrow LOCAL codes (``tags_enc``), the per-source
+    local->global LUTs (``tags_lut``) and a per-row source ordinal
+    (``src_ord``) — instead of materializing the remapped i32 columns;
+    the widen + remap run on device inside the plan kernel
+    (ops.decode.decode_chunk).  Fields stay host-f64 (the exact host
+    paths need them) but carry a ``fields_narrow`` dtype decision so the
+    pad/ship stage can ship exact-int columns at i8/i16."""
+    from banyandb_tpu.storage import encoded as enc_mod
+
     ts_l, series_l, ver_l = [], [], []
     tc_l: dict[str, list] = {t: [] for t in tags_code}
+    lut_l: dict[str, list] = {t: [] for t in tags_code}
+    ord_l: list = []
     f_l: dict[str, list] = {f: [] for f in fields}
+    n_src = 0
     for src in sources:
         if src.ts.size == 0:
             continue
@@ -1318,6 +1425,9 @@ def _gather_rows(
         ts_l.append(src.ts[rng])
         series_l.append(src.series[rng])
         ver_l.append(src.version[rng])
+        if device_decode:
+            ord_l.append(np.full(nsel, n_src, dtype=enc_mod.SRC_ORD_DTYPE))
+        n_src += 1
         for t in tags_code:
             col = src.tags.get(t)
             if col is None:
@@ -1328,13 +1438,29 @@ def _gather_rows(
                         absent = gd.absent_code(t)
                 else:
                     absent = gd.absent_code(t)
-                tc_l[t].append(np.full(nsel, absent, dtype=np.int32))
+                if device_decode:
+                    # compressed form: a one-entry LUT row and local
+                    # code 0 everywhere — the device remap lands the
+                    # same global absent code the dense path bakes in
+                    tc_l[t].append(np.zeros(nsel, dtype=np.int8))
+                    lut_l[t].append(np.asarray([absent], dtype=np.int32))
+                else:
+                    tc_l[t].append(np.full(nsel, absent, dtype=np.int32))
             else:
                 lut = _source_lut(src, t, gd, dict_state)
                 codes = col[rng]
-                tc_l[t].append(
-                    lut[codes] if lut.size else np.zeros(nsel, np.int32)
-                )
+                if device_decode:
+                    if lut.size:
+                        w = enc_mod.code_dtype(lut.size)
+                        tc_l[t].append(codes.astype(w, copy=False))
+                        lut_l[t].append(lut)
+                    else:
+                        tc_l[t].append(np.zeros(nsel, dtype=np.int8))
+                        lut_l[t].append(np.zeros(1, dtype=np.int32))
+                else:
+                    tc_l[t].append(
+                        lut[codes] if lut.size else np.zeros(nsel, np.int32)
+                    )
         for f in fields:
             col = src.fields.get(f)
             if col is None:
@@ -1346,9 +1472,17 @@ def _gather_rows(
         empty = dict(
             ts=np.zeros(0, np.int64),
             series=np.zeros(0, np.int64),
-            tags_code={t: np.zeros(0, np.int32) for t in tags_code},
             fields={f: np.zeros(0, np.float64) for f in fields},
         )
+        if device_decode:
+            empty["tags_enc"] = {t: np.zeros(0, np.int8) for t in tags_code}
+            empty["tags_lut"] = {t: () for t in tags_code}
+            empty["src_ord"] = np.zeros(0, enc_mod.SRC_ORD_DTYPE)
+            empty["fields_narrow"] = {f: np.dtype(np.int8) for f in fields}
+        else:
+            empty["tags_code"] = {
+                t: np.zeros(0, np.int32) for t in tags_code
+            }
         return empty
 
     ts = np.concatenate(ts_l)
@@ -1357,18 +1491,87 @@ def _gather_rows(
     # Global version dedup: keep the max-version row per (series, ts).
     keep = hostops.dedup_max_version(series, ts, version)
 
-    return dict(
+    out = dict(
         ts=ts[keep],
         series=series[keep],
-        tags_code={t: np.concatenate(tc_l[t])[keep] for t in tags_code},
         fields={f: np.concatenate(f_l[f])[keep] for f in fields},
     )
+    if device_decode:
+        # narrow gather: mixed per-source widths promote to the widest
+        # (np.concatenate's int promotion), values untouched
+        out["tags_enc"] = {
+            t: np.concatenate(tc_l[t])[keep] for t in tags_code
+        }
+        out["tags_lut"] = {t: tuple(lut_l[t]) for t in tags_code}
+        out["src_ord"] = np.concatenate(ord_l)[keep]
+        out["fields_narrow"] = {
+            f: enc_mod.narrow_int_dtype(out["fields"][f]) for f in fields
+        }
+    else:
+        out["tags_code"] = {
+            t: np.concatenate(tc_l[t])[keep] for t in tags_code
+        }
+    return out
 
 
-def _device_chunk(cols: dict, start: int, end: int, spec: PlanSpec, epoch: int) -> dict:
-    """Pad one row range into the fixed chunk shape, ship to device."""
+def _host_tag_codes(
+    cols: dict, tag: str, rows: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """Global i32 codes for `tag` from a gathered snapshot, either ship
+    form.  The compressed form (device_decode) materializes host-side
+    only where the host genuinely needs values — the exact-f64 float
+    path and per-group representative rows — via the same
+    local->global LUT composition the device kernel applies."""
+    if "tags_code" in cols:
+        col = cols["tags_code"][tag]
+        return col if rows is None else col[rows]
+    codes = cols["tags_enc"][tag]
+    src_ord = cols["src_ord"]
+    if rows is not None:
+        codes = codes[rows]
+        src_ord = src_ord[rows]
+    luts = cols["tags_lut"][tag]
+    if not luts:
+        return np.zeros(codes.shape[0], dtype=np.int32)
+    offs = np.zeros(len(luts), dtype=np.int64)
+    np.cumsum([len(lu) for lu in luts[:-1]], out=offs[1:])
+    flat = np.concatenate([np.asarray(lu, np.int32) for lu in luts])
+    return flat[offs[src_ord] + codes].astype(np.int32)
+
+
+def _materialize_tag_codes(cols: dict, tags: Sequence[str]) -> dict:
+    """Snapshot with dense i32 ``tags_code`` present (host-path input)."""
+    if "tags_code" in cols:
+        return cols
+    out = dict(cols)
+    out["tags_code"] = {t: _host_tag_codes(cols, t) for t in tags}
+    return out
+
+
+def _device_chunk(
+    cols: dict,
+    start: int,
+    end: int,
+    spec: PlanSpec,
+    epoch: int,
+    ship_stats: Optional[list] = None,
+    lut_cache: Optional[dict] = None,
+) -> dict:
+    """Pad one row range into the fixed chunk shape, ship to device.
+
+    Compressed-form snapshots (``src_ord`` present, BYDB_DEVICE_DECODE)
+    ship tag columns at their narrow local width plus the small [S, L]
+    remap LUTs, and exact-int fields at i8/i16 — the device decode
+    stage (ops.decode.decode_chunk, fused into the plan kernel) widens
+    them back; PCIe traffic shrinks by the width ratio.
+    ``ship_stats`` (list, GIL-atomic appends from the prefetch worker)
+    collects (shipped_bytes, dense_bytes) per chunk for the decode span
+    and the ``decode_ship_bytes_total`` counters — dense is what the
+    decoded i32/f32 form would have shipped for the same columns.
+    """
     n = end - start
     nb = spec.nrows
+    compressed = "src_ord" in cols
 
     def pad(a: np.ndarray, dtype):
         out = np.zeros((nb,), dtype=dtype)
@@ -1387,15 +1590,66 @@ def _device_chunk(cols: dict, start: int, end: int, spec: PlanSpec, epoch: int) 
         "ts": jnp.asarray(ts.astype(np.int32)),
         "series": pad(cols["series"] % (2**31), np.int32),
         "valid": jnp.asarray(valid),
-        "tags_code": {t: pad(cols["tags_code"][t], np.int32) for t in spec.tags_code},
-        "fields": {f: pad(cols["fields"][f], np.float32) for f in spec.fields},
     }
+    shipped = dense = 0
+    if compressed:
+        from banyandb_tpu.storage import encoded as enc_mod
+
+        if spec.tags_code:
+            chunk["tags_enc"] = {
+                t: pad(cols["tags_enc"][t], cols["tags_enc"][t].dtype)
+                for t in spec.tags_code
+            }
+            # the [S, L] remap LUTs are per part-batch, not per chunk:
+            # pack + ship once and share the device buffer across the
+            # staged loop's chunks (lut_cache lives for one reduction;
+            # the single prefetch worker builds chunks sequentially)
+            luts = {}
+            for t in spec.tags_code:
+                dev = None if lut_cache is None else lut_cache.get(t)
+                if dev is None:
+                    dev = jnp.asarray(enc_mod.pack_luts(cols["tags_lut"][t]))
+                    if lut_cache is not None:
+                        lut_cache[t] = dev
+                    shipped += dev.nbytes
+                luts[t] = dev
+            chunk["tags_lut"] = luts
+            chunk["src_ord"] = pad(cols["src_ord"], enc_mod.SRC_ORD_DTYPE)
+            shipped += chunk["src_ord"].nbytes
+            for t in spec.tags_code:
+                shipped += chunk["tags_enc"][t].nbytes
+                dense += nb * 4
+        fields_enc = {}
+        fields_f32 = {}
+        for f in spec.fields:
+            ndt = cols["fields_narrow"].get(f)
+            if ndt is not None:
+                fields_enc[f] = pad(cols["fields"][f], ndt)
+                shipped += fields_enc[f].nbytes
+            else:
+                fields_f32[f] = pad(cols["fields"][f], np.float32)
+                shipped += fields_f32[f].nbytes
+            dense += nb * 4
+        if fields_enc:
+            chunk["fields_enc"] = fields_enc
+        chunk["fields"] = fields_f32
+    else:
+        chunk["tags_code"] = {
+            t: pad(cols["tags_code"][t], np.int32) for t in spec.tags_code
+        }
+        chunk["fields"] = {
+            f: pad(cols["fields"][f], np.float32) for f in spec.fields
+        }
+        dense = (len(spec.tags_code) + len(spec.fields)) * nb * 4
+        shipped = dense
     # always present: the device-chunk cache is keyed by (gather, shape,
     # columns) and shared across plan variants — a chunk built for a
     # rep-less plan must still serve a rep-tracking one
     row = np.zeros((nb,), dtype=np.int32)
     row[:n] = np.arange(start, end, dtype=np.int32)
     chunk["row"] = jnp.asarray(row)
+    if ship_stats is not None:
+        ship_stats.append((shipped, dense))
     return chunk
 
 

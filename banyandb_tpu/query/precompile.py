@@ -222,6 +222,19 @@ def measure_warm_args(spec) -> tuple:
     )
 
 
+def measure_decode_warm_args(spec) -> tuple:
+    """Warm args for the COMPRESSED staged ship form (the production
+    default under ``BYDB_DEVICE_DECODE=1``) at the canonical widths."""
+    import jax.numpy as jnp
+
+    return (
+        _zeros_like_structs(decode_chunk_struct(spec)),
+        _zeros_like_structs(pred_struct(spec)),
+        jnp.float32(0.0),
+        jnp.float32(1.0),
+    )
+
+
 def mask_warm_args(mspec) -> tuple:
     cols, vals = mask_structs(mspec)
     return (_zeros_like_structs(cols), _zeros_like_structs(vals))
@@ -238,12 +251,157 @@ def fused_chunk_struct(fspec) -> dict:
     )
 
 
+def _decode_lut_len(spec, t: str) -> int:
+    for tag, radix in zip(spec.group_tags, spec.radices):
+        if tag == t:
+            return 1 << max(int(radix) - 1, 1).bit_length()
+    return 16
+
+
+def _decode_code_dtype(spec, t: str):
+    """Canonical narrow code width per tag: from the group radix where
+    the signature pins one, i8 otherwise (the dashboard population's
+    dictionaries are small).  Production widths are data-dependent — a
+    mismatch just means one extra trace on first contact, the same cost
+    class as an unseen row bucket."""
+    import jax.numpy as jnp
+
+    import numpy as _np
+
+    from banyandb_tpu.storage import encoded as enc_mod
+
+    for tag, radix in zip(spec.group_tags, spec.radices):
+        if tag == t:
+            return jnp.dtype(enc_mod.code_dtype(int(radix)))
+    return jnp.dtype(_np.int8)
+
+
+def decode_chunk_struct(spec) -> dict:
+    """ShapeDtypeStruct pytree for the COMPRESSED ship form of one
+    STAGED chunk (measure_exec._device_chunk's compressed branch) at
+    the canonical single-source shape of fused_decode_chunk_struct;
+    the staged form never carries a ``tags_code`` key (the fused
+    stacker keeps an empty one)."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    n = spec.nrows
+    out = {
+        "ts": S((n,), jnp.int32),
+        "series": S((n,), jnp.int32),
+        "valid": S((n,), jnp.bool_),
+        "row": S((n,), jnp.int32),
+        "fields": {
+            f: S((n,), jnp.float32)
+            for f in spec.fields
+            if f == spec.hist_field
+        },
+    }
+    if spec.tags_code:
+        out["tags_enc"] = {
+            t: S((n,), _decode_code_dtype(spec, t)) for t in spec.tags_code
+        }
+        out["tags_lut"] = {
+            t: S((1, _decode_lut_len(spec, t)), jnp.int32)
+            for t in spec.tags_code
+        }
+        out["src_ord"] = S((n,), jnp.int16)
+    enc = {
+        f: S((n,), jnp.int16)
+        for f in spec.fields
+        if f != spec.hist_field
+    }
+    if enc:
+        out["fields_enc"] = enc
+    return out
+
+
+def fused_decode_chunk_struct(fspec) -> dict:
+    """ShapeDtypeStruct pytree for the COMPRESSED ship form of a fused
+    part-batch (``BYDB_DEVICE_DECODE``, fused_exec._stacked_chunks'
+    compressed branch), at a canonical single-source shape:
+
+    - tag columns as narrow local codes (width from the group radix, i8
+      otherwise) plus a ``[1, L]`` remap LUT with L
+      the power-of-two bucket of the tag's radix (group tags) or 16;
+    - one i16 source-ordinal column;
+    - fields as i16 exact-int columns, except the histogram field
+      (percentile inputs are real-valued) which stays dense f32.
+
+    Production widths vary with the data (i8 dictionaries, multi-source
+    LUT stacks — jit re-specializes per pytree); this canonical shape is
+    what the ``fused+decode/*`` budget rows lower and jaxpr-audit, the
+    same way nrows is a representative row bucket."""
+    import jax
+    import jax.numpy as jnp
+
+    S = jax.ShapeDtypeStruct
+    spec = fspec.plan
+    c, n = fspec.num_chunks, spec.nrows
+    lut_len = lambda t: _decode_lut_len(spec, t)  # noqa: E731
+
+    out = {
+        "ts": S((c, n), jnp.int32),
+        "series": S((c, n), jnp.int32),
+        "valid": S((c, n), jnp.bool_),
+        "row": S((c, n), jnp.int32),
+        "tags_code": {},
+        "fields": {
+            f: S((c, n), jnp.float32)
+            for f in spec.fields
+            if f == spec.hist_field
+        },
+    }
+    if spec.tags_code:
+        out["tags_enc"] = {
+            t: S((c, n), _decode_code_dtype(spec, t))
+            for t in spec.tags_code
+        }
+        out["tags_lut"] = {
+            t: S((1, lut_len(t)), jnp.int32) for t in spec.tags_code
+        }
+        out["src_ord"] = S((c, n), jnp.int16)
+    enc = {
+        f: S((c, n), jnp.int16)
+        for f in spec.fields
+        if f != spec.hist_field
+    }
+    if enc:
+        out["fields_enc"] = enc
+    return out
+
+
+def builtin_fused_decode():
+    """(name, FusedSpec) pairs for the ``fused+decode/*`` audit rows —
+    the SAME FusedSpecs as builtin_fused() (the ship form is not part of
+    the plan signature), paired by the kernel audit with the compressed
+    chunk structs from fused_decode_chunk_struct."""
+    return tuple(
+        (name.replace("fused/", "fused+decode/"), fspec)
+        for name, fspec in builtin_fused()
+    )
+
+
 def fused_warm_args(fspec) -> tuple:
     """Zero-filled production-shaped args for one fused plan program."""
     import jax.numpy as jnp
 
     return (
         _zeros_like_structs(fused_chunk_struct(fspec)),
+        _zeros_like_structs(pred_struct(fspec.plan)),
+        jnp.float32(0.0),
+        jnp.float32(1.0),
+    )
+
+
+def fused_decode_warm_args(fspec) -> tuple:
+    """Warm args for the COMPRESSED fused ship form (the production
+    default under ``BYDB_DEVICE_DECODE=1``) at the canonical widths."""
+    import jax.numpy as jnp
+
+    return (
+        _zeros_like_structs(fused_decode_chunk_struct(fspec)),
         _zeros_like_structs(pred_struct(fspec.plan)),
         jnp.float32(0.0),
         jnp.float32(1.0),
@@ -410,36 +568,49 @@ class PrecompileRegistry:
 
         from banyandb_tpu.query import fused_exec, measure_exec, stream_exec
 
+        from banyandb_tpu.storage import encoded as enc_mod
+
+        # measure/fused kernels trace per chunk-pytree STRUCTURE, and
+        # the compressed ship form (BYDB_DEVICE_DECODE, default on) is a
+        # different structure from the dense one — warm the form(s)
+        # production queries will actually resolve, at the canonical
+        # decode widths
         if kind == "measure":
-            cache, build, args = (
+            cache, build = (
                 measure_exec._KERNEL_CACHE,
                 measure_exec._build_kernel,
-                measure_warm_args(spec),
             )
+            args_list = [measure_warm_args(spec)]
+            if enc_mod.device_decode_enabled():
+                args_list.append(measure_decode_warm_args(spec))
         elif kind == "fused":
-            cache, build, args = (
+            cache, build = (
                 fused_exec._KERNEL_CACHE,
                 fused_exec._build_kernel,
-                fused_warm_args(spec),
             )
+            args_list = [fused_warm_args(spec)]
+            if enc_mod.device_decode_enabled():
+                args_list.append(fused_decode_warm_args(spec))
         elif kind == "stream_mask":
-            cache, build, args = (
+            cache, build = (
                 stream_exec._KERNEL_CACHE,
                 stream_exec._build_kernel,
-                mask_warm_args(spec),
             )
+            args_list = [mask_warm_args(spec)]
         else:
             return
         kernel = cache.get(spec)
         if kernel is None:
             kernel = cache[spec] = build(spec)
-        # one dispatch on zero args of the production shapes: populates
-        # the jit executable cache AND (through utils/compile_cache) the
-        # persistent XLA cache; values are irrelevant to the cache key
-        # bdlint: disable=host-sync -- warming runs on a background
-        # thread and MUST block until the compile finishes; there is no
-        # result to batch
-        jax.block_until_ready(kernel(*args))
+        # one dispatch per ship form on zero args of the production
+        # shapes: populates the jit executable cache AND (through
+        # utils/compile_cache) the persistent XLA cache; values are
+        # irrelevant to the cache key
+        for args in args_list:
+            # bdlint: disable=host-sync -- warming runs on a background
+            # thread and MUST block until the compile finishes; there is
+            # no result to batch
+            jax.block_until_ready(kernel(*args))
 
     def warm(self, include_builtin: bool = True, sigs=None) -> int:
         """Compile signatures now (callers wanting async use warm_async)."""

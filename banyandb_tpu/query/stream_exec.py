@@ -54,7 +54,12 @@ def _build_kernel(spec: _MaskSpec):
     def kernel(cols, pred_vals):
         mask = jnp.ones(spec.nrows, dtype=bool)
         for i, (op, _nv) in enumerate(spec.preds):
-            col = cols[i]
+            # device-side decode stage (ROADMAP item 3): columns arrive
+            # at their stored narrow width when the source was read with
+            # narrow_codes and widen here, on device; i32 columns pass
+            # through (astype is the identity) — predicate codes are
+            # always i32, so the mask math is identical either way
+            col = ops.widen_codes(cols[i])
             v = pred_vals[i]
             if op in ("in", "not_in"):
                 m = ops.in_set_mask(col, v)
@@ -100,8 +105,11 @@ def device_tag_mask(src: ColumnData, conds: list[Condition]):
             preds.append((c.op, 1))
             pred_vals.append(jnp.int32(code))
         # pad with a sentinel that matches nothing real; padded rows are
-        # discarded by the caller's slice anyway
-        padded = np.full(nrows, -3, dtype=np.int32)
+        # discarded by the caller's slice anyway.  The column keeps its
+        # incoming width (narrow i8/i16 under the device-decode read
+        # path — every signed width holds the -1/-2/-3 sentinels), so a
+        # compressed column crosses PCIe compressed.
+        padded = np.full(nrows, -3, dtype=col.dtype)
         padded[:n] = col
         cols.append(jnp.asarray(padded))
 

@@ -129,11 +129,27 @@ def encode_dict_codes(codes: np.ndarray) -> bytes:
     return bytes([width]) + zst.compress(packed.tobytes())
 
 
-def decode_dict_codes(blob: bytes, count: int) -> np.ndarray:
+def _dict_codes_view(blob: bytes) -> np.ndarray:
+    """Read-only frombuffer view of the stored code payload."""
     width = blob[0]
     dtype = {1: np.int8, 2: np.int16, 4: np.int32, 8: np.int64}[width]
-    out = np.frombuffer(zst.decompress(blob[1:]), dtype=dtype)
-    return out.astype(np.int32)
+    return np.frombuffer(zst.decompress(blob[1:]), dtype=dtype)
+
+
+def decode_dict_codes(blob: bytes, count: int) -> np.ndarray:
+    return _dict_codes_view(blob).astype(np.int32)  # astype = one copy
+
+
+def decode_dict_codes_narrow(blob: bytes, count: int) -> np.ndarray:
+    """Dict codes at their STORED narrow width (i8/i16/i32) — the
+    device-decode ship form (ROADMAP item 3): the widen-to-i32 happens
+    on device (ops.decode), so a code column crosses PCIe at 1/4 or 1/2
+    of the dense width.  i64-stored codes (never produced by
+    encode_dict_codes' downcast, but tolerated) widen here."""
+    out = _dict_codes_view(blob)
+    if out.dtype == np.int64:
+        return out.astype(np.int32)
+    return out.copy()  # writable (frombuffer views are read-only)
 
 
 def encode_strings(values: list[bytes]) -> bytes:

@@ -195,6 +195,33 @@ def child_main() -> None:
     numpy_executor(d, region_ne=3)
     numpy_s = time.perf_counter() - t0
 
+    # ---- decode microbench (ROADMAP item 3 done-bar) --------------------
+    # device-side widen+remap (the compressed-ship decode stage) against
+    # the host numpy widen+LUT-gather it replaces, same column — the
+    # ">= 4x host baseline" claim is this ratio on a TPU run
+    from banyandb_tpu.ops import decode as ops_decode
+
+    codes8 = (d["svc"] % 128).astype(np.int8)
+    lut = np.arange(128, dtype=np.int32)
+
+    def host_decode():
+        return lut[codes8.astype(np.int32)]
+
+    t0 = time.perf_counter()
+    for _ in range(final_iters):
+        host_decode()
+    host_dec_s = (time.perf_counter() - t0) / final_iters
+    dev_codes = jnp.asarray(codes8)
+    dev_lut = jnp.asarray(lut.reshape(1, -1))
+    dev_ord = jnp.zeros(n_rows, jnp.int16)
+    dec_fn = jax.jit(ops_decode.dict_remap)
+    jax.block_until_ready(dec_fn(dev_codes, dev_lut, dev_ord))
+    t0 = time.perf_counter()
+    for _ in range(final_iters):
+        out = dec_fn(dev_codes, dev_lut, dev_ord)
+    jax.block_until_ready(out)
+    dev_dec_s = (time.perf_counter() - t0) / final_iters
+
     print(
         json.dumps(
             {
@@ -206,6 +233,8 @@ def child_main() -> None:
                 "method": best,
                 "rows": n_rows,
                 "probe_ms": {m: round(s * 1e3, 2) for m, s in probe.items()},
+                "decode_gpoints_per_s": round(n_rows / dev_dec_s / 1e9, 3),
+                "decode_vs_host": round(host_dec_s / dev_dec_s, 2),
             }
         )
     )
@@ -444,6 +473,32 @@ def e2e_main() -> None:
 
             stage_breakdown = obs_prom.stage_breakdown(metrics_text())
 
+            def decode_counters() -> dict:
+                """Device-decode evidence (ROADMAP item 3), meaningful
+                even on a cpu-fallback run: compressed-vs-dense shipped
+                bytes and zone-skipped blocks, scraped from the RUNNING
+                server's counters."""
+                txt = metrics_text()
+                shipped = obs_prom.gauge_value(
+                    txt, "banyandb_decode_ship_bytes_total",
+                    {"form": "shipped"},
+                ) or 0.0
+                dense = obs_prom.gauge_value(
+                    txt, "banyandb_decode_ship_bytes_total",
+                    {"form": "dense"},
+                ) or 0.0
+                skipped = obs_prom.gauge_value(
+                    txt, "banyandb_blocks_skipped_total", {"reason": "zone"}
+                ) or 0.0
+                return {
+                    "shipped_bytes": shipped,
+                    "dense_bytes": dense,
+                    "compression_ratio": round(dense / shipped, 2)
+                    if shipped
+                    else None,
+                    "blocks_skipped_total": skipped,
+                }
+
             # ---- staged-vs-fused A/B over the warm-distinct set ------
             # BYDB_FUSED flips LIVE on the in-process server; each leg
             # runs a FRESH distinct set (new seed => no partials-cache
@@ -542,6 +597,10 @@ def e2e_main() -> None:
                     "fused": os.environ.get("BYDB_FUSED", "1"),
                     "fused_speedup": fused_ab["fused_speedup"],
                     "fused_ab": fused_ab,
+                    "device_decode": os.environ.get(
+                        "BYDB_DEVICE_DECODE", "1"
+                    ),
+                    "decode_counters": decode_counters(),
                 }
             )
         )

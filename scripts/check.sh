@@ -43,6 +43,13 @@ echo "== fused-executor smoke =="
 # (docs/performance.md "Fused whole-plan executor")
 env JAX_PLATFORMS=cpu python scripts/fused_smoke.py || fail=1
 
+echo "== device-decode smoke =="
+# compressed-ship A/B byte parity on a real multi-block part, zone-map
+# block skipping with identical results, decode span + shipped-bytes
+# counters, fused+decode budget agreement
+# (docs/performance.md "Device-side decode & zone maps")
+env JAX_PLATFORMS=cpu python scripts/decode_smoke.py || fail=1
+
 echo "== sanitize smoke (bdsan) =="
 # live-engine stress slice under BYDB_SANITIZE=1: lock-order witnesses
 # consistent with the declared graph, zero leaked threads/fds, seeded

@@ -515,6 +515,7 @@ def test_registry_and_plan_audit_agree():
     builtin_names = (
         {n for n, _ in precompile.builtin_plans()}
         | {n for n, _ in precompile.builtin_fused()}
+        | {n for n, _ in precompile.builtin_fused_decode()}
         | {n for n, _ in precompile.builtin_masks()}
     )
     missing = builtin_names - audit_names

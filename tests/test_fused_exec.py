@@ -228,17 +228,7 @@ def _scenarios():
 
 
 def _partial_bytes(p) -> bytes:
-    parts = [p.count.tobytes(), p.codes.tobytes() if p.codes is not None else b""]
-    for d in (p.sums, p.mins, p.maxs):
-        for k in sorted(d):
-            parts.append(d[k].tobytes())
-    if p.hist is not None:
-        parts.append(p.hist.tobytes())
-    if p.rep_key is not None:
-        parts.append(p.rep_key.tobytes())
-    if p.rep_vals is not None:
-        parts.append(repr(sorted(p.rep_vals.items())).encode())
-    return b"".join(parts)
+    return p.content_bytes()  # the shared parity oracle (Partials)
 
 
 def _result_json(m, req, partial) -> str:
@@ -284,6 +274,39 @@ def test_parity_all_builtin_signatures(name, monkeypatch):
     assert t_fused["dispatches"] == 1
     assert _partial_bytes(p_staged) == _partial_bytes(p_fused)
     assert _result_json(m, req, p_staged) == _result_json(m, req, p_fused)
+
+
+@pytest.mark.parametrize("name", [s[0] for s in _scenarios()])
+@pytest.mark.parametrize("fused", [False, True])
+def test_device_decode_parity_all_builtin_signatures(name, fused, monkeypatch):
+    """``BYDB_DEVICE_DECODE=1`` (compressed ship + in-kernel decode,
+    ISSUE 9) is byte-identical to ``=0`` on partials bytes AND result
+    JSON for every builtin plan signature, in both executors — the same
+    A/B contract BYDB_FUSED carries."""
+    m, req, srcs = next(
+        (m, r, s) for n, m, r, s in _scenarios() if n == name
+    )
+    monkeypatch.setenv("BYDB_DEVICE_DECODE", "0")
+    p_dense, _ = _run(m, req, srcs, fused=fused, monkeypatch=monkeypatch)
+    monkeypatch.setenv("BYDB_DEVICE_DECODE", "1")
+    p_dec, t_dec = _run(m, req, srcs, fused=fused, monkeypatch=monkeypatch)
+    if fused:
+        assert t_dec["dispatches"] == 1  # decode fused into the one program
+    assert _partial_bytes(p_dense) == _partial_bytes(p_dec)
+    assert _result_json(m, req, p_dense) == _result_json(m, req, p_dec)
+
+
+def test_device_decode_multichunk_parity(monkeypatch):
+    """Compressed ship over a multi-chunk part-batch: still one fused
+    dispatch, byte-identical to the dense multi-chunk run."""
+    monkeypatch.setattr(measure_exec, "SCAN_CHUNK", 2048)
+    name, m, req, srcs = _scenarios()[1]
+    monkeypatch.setenv("BYDB_DEVICE_DECODE", "0")
+    p_dense, _ = _run(m, req, srcs, fused=True, monkeypatch=monkeypatch)
+    monkeypatch.setenv("BYDB_DEVICE_DECODE", "1")
+    p_dec, t_dec = _run(m, req, srcs, fused=True, monkeypatch=monkeypatch)
+    assert t_dec["chunks"] == 4 and t_dec["dispatches"] == 1
+    assert _partial_bytes(p_dense) == _partial_bytes(p_dec)
 
 
 def test_multichunk_parity_one_dispatch(monkeypatch):
