@@ -128,7 +128,7 @@ class TierMigrator:
                     progress["shipped"] = sorted(done)
                     self._save_progress(progress)
             if new_this_pass == 0 and all(
-                len(sh.mem) == 0 for sh in seg.shards
+                not sh.has_unflushed for sh in seg.shards
             ):
                 return shipped
             shipped += new_this_pass
@@ -180,7 +180,7 @@ class TierMigrator:
                         stack.enter_context(db._lock)
                         for sh in seg.shards:
                             stack.enter_context(sh._lock)
-                        if any(len(sh.mem) > 0 for sh in seg.shards):
+                        if any(sh.has_unflushed for sh in seg.shards):
                             # a write slipped in after the quiesce pass:
                             # leave the segment in place for the next run
                             # rather than dropping unshipped rows

@@ -117,6 +117,10 @@ class DataNode:
                 "prometheus": obs_metrics.global_meter().prometheus_text()
             },
         )
+        # streaming-aggregation control surface (query/streamagg.py):
+        # liaisons broadcast dashboard signature registrations here;
+        # stats expose window/watermark state per node
+        self.bus.subscribe("streamagg", self._on_streamagg)
         # operator flush surface (data-node SnapshotService analog):
         # persists memtables to parts on demand — ops tooling and tests
         # use it to bound the direct-write plane's crash-loss window
@@ -132,6 +136,25 @@ class DataNode:
         from banyandb_tpu.cluster import schema_gossip
 
         schema_gossip.register_handlers(self.bus, self.registry)
+
+    def _on_streamagg(self, env: dict) -> dict:
+        op = env.get("op", "stats")
+        if op == "register":
+            info = self.measure.streamagg.register(
+                env["group"],
+                env["measure"],
+                key_tags=tuple(env.get("key_tags", ())),
+                fields=tuple(env.get("fields", ())),
+                window_millis=env.get("window_millis"),
+                max_windows=env.get("max_windows"),
+            )
+            return {"registered": info, "node": self.name}
+        if op == "stats":
+            return {
+                "streamagg": self.measure.streamagg.stats(),
+                "node": self.name,
+            }
+        raise ValueError(f"bad streamagg op {op!r}")
 
     def _on_diagnostics(self, env: dict) -> dict:
         from banyandb_tpu.admin.diagnostics import DiagnosticsCollector
@@ -550,6 +573,81 @@ class DataNode:
                 )
         else:
             self._observe_topn_part(group, pmeta, min_ts, shard_idx, part_name)
+            try:
+                self._observe_streamagg_part(
+                    group, pmeta, shard_idx, part_dir
+                )
+            except Exception as exc:  # noqa: BLE001 - an install must
+                # never fail over the windows; but a part whose rows
+                # did NOT reach them makes every covered answer an
+                # undercount, so coverage is POISONED (affected ranges
+                # rescan) instead of served with a silent gap
+                logging.getLogger("banyandb.datanode").exception(
+                    "streamagg window update failed for installed part %s",
+                    part_dir,
+                )
+                measure_name = pmeta.get("measure")
+                if measure_name:
+                    self.measure.streamagg.invalidate(
+                        group, measure_name,
+                        reason=f"install hook failed: {exc}",
+                        # the failed part's rows may lie ABOVE the
+                        # watermark: poison up to its max event ts
+                        up_to=pmeta.get("max_ts"),
+                    )
+
+    def _observe_streamagg_part(
+        self, group: str, pmeta: dict, shard_idx: int, part_dir
+    ) -> None:
+        """Feed an installed part's rows through the continuous
+        streaming-aggregation windows (query/streamagg.py) — the wqueue
+        drain path bypasses MeasureEngine.write, which is where direct
+        writes update windows.  Install-digest idempotence upstream
+        guarantees a re-shipped part reaches this hook at most once, so
+        windows never double-count."""
+        import numpy as np
+
+        measure_name = pmeta.get("measure")
+        if not measure_name:
+            return
+        needs = self.measure.streamagg.needs(group, measure_name)
+        if needs is None:
+            return
+        if pmeta.get("rows") == 0:
+            return  # wqueue row-count stamp: empty part, skip the read
+        need_tags, need_fields = needs
+        from banyandb_tpu.storage.part import Part
+
+        part = Part(part_dir)
+        cols = part.read(
+            range(len(part.blocks)),
+            tags=[t for t in need_tags if t in part.meta["tags"]],
+            fields=[f for f in need_fields if f in part.meta["fields"]],
+            cached=False,
+        )
+        n = int(cols.ts.size)
+        if n == 0:
+            return
+        from banyandb_tpu.query.streamagg import (
+            coldata_field_col,
+            coldata_tag_col,
+        )
+
+        def tag_col(t: str):
+            return coldata_tag_col(cols, t, n)
+
+        def field_col(f: str):
+            return coldata_field_col(cols, f, n)
+
+        self.measure.streamagg.observe(
+            group, measure_name,
+            ts=cols.ts, series=cols.series, versions=cols.version,
+            shards=int(shard_idx), tag_col=tag_col, field_col=field_col,
+            # part identity: a registration backfill that already
+            # consumed this part makes this hook a no-op for that
+            # signature (the raced-install dedup contract)
+            part_id=str(part_dir),
+        )
 
     def _index_trace_part(
         self, group: str, pmeta: dict, min_ts: int, shard_idx: int, part_dir

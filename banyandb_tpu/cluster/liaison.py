@@ -149,6 +149,14 @@ class Liaison:
         # newest schema content pushed per (kind, key) — the barrier's
         # trusted "node is ahead" witness (see sync_schema)
         self._schema_latest: dict[tuple[str, str], str] = {}
+        # streamagg registrations this liaison has broadcast, keyed by
+        # signature identity: nodes that were down at register time (or
+        # that join later) receive them when probe() sees them alive —
+        # a restarting node's own persisted registry only covers
+        # signatures it had already received
+        self._streamagg_regs: dict[tuple, dict] = {}
+        self._streamagg_sent: dict[str, set] = {}  # node -> sig keys
+        self._streamagg_lock = threading.Lock()  # guards the two above
         self.handoff = None
         if handoff_root:
             from banyandb_tpu.cluster.handoff import HandoffController
@@ -185,6 +193,30 @@ class Liaison:
                 pass
         with self._alive_lock:
             self.alive = alive
+        # streamagg catch-up: any alive node missing a broadcast
+        # registration gets it now (idempotent server-side); keyed on
+        # sent-state, not on the down->up transition, so a failed send
+        # retries at the next probe
+        for node in self.selector.nodes:
+            if node.name not in alive:
+                continue
+            with self._streamagg_lock:
+                todo = [
+                    (key, env)
+                    for key, env in self._streamagg_regs.items()
+                    if key not in self._streamagg_sent.get(node.name, ())
+                ]
+            for key, env in todo:  # RPCs OUTSIDE the lock
+                try:
+                    self.transport.call(
+                        node.addr, "streamagg", env, timeout=_RPC_SYNC_S
+                    )
+                except TransportError:
+                    continue  # node flapped: retry at the next probe
+                with self._streamagg_lock:
+                    self._streamagg_sent.setdefault(
+                        node.name, set()
+                    ).add(key)
         # Hinted-handoff replay (handoff_controller.go:82): drain the spool
         # of EVERY alive node with pending entries — keyed on pending, not
         # on the down->up transition, so a partially failed replay retries
@@ -293,6 +325,53 @@ class Liaison:
             if _time.monotonic() >= deadline:
                 return False
             _time.sleep(0.05)
+
+    # -- streaming aggregation control plane (query/streamagg.py) -----------
+    def register_streamagg(
+        self,
+        group: str,
+        measure: str,
+        key_tags,
+        fields,
+        window_millis: Optional[int] = None,
+        max_windows: Optional[int] = None,
+    ) -> dict[str, dict]:
+        """Broadcast one materialized dashboard signature to every alive
+        data node (windows are node-local per shard; each node backfills
+        its own parts, so the scatter's per-shard folds merge like scan
+        partials).  -> {node: ack}.  Down nodes re-register themselves
+        at restart from their persisted streamagg registry."""
+        env = {
+            "op": "register",
+            "group": group,
+            "measure": measure,
+            "key_tags": list(key_tags),
+            "fields": list(fields),
+            "window_millis": window_millis,
+            "max_windows": max_windows,
+        }
+        key = (
+            group, measure, tuple(sorted(key_tags)),
+            tuple(sorted(fields)), window_millis,
+        )
+        # remembered for probe()'s catch-up: nodes down right now (and
+        # nodes joining later) receive the registration when they are
+        # next seen alive — their own persisted registry only covers
+        # signatures they had already received
+        with self._streamagg_lock:
+            self._streamagg_regs[key] = env
+        acks: dict[str, dict] = {}
+        for n in self.selector.nodes:
+            if n.name not in self.alive:
+                continue
+            # sync-tier timeout: registration backfills from the node's
+            # existing parts, which can be a real scan
+            acks[n.name] = self.transport.call(
+                n.addr, "streamagg", env, timeout=_RPC_SYNC_S
+            )
+            with self._streamagg_lock:
+                self._streamagg_sent.setdefault(n.name, set()).add(key)
+        return acks
 
     # -- liaison write queue (wqueue.go:75 analog) --------------------------
     def enable_write_queue(self, spool_root, **kw):

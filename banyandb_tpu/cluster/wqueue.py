@@ -292,6 +292,10 @@ class WriteQueue:
                     # re-delivery of THIS part from an independent later
                     # seal of byte-identical content (client retry batch)
                     "seal_session": session,
+                    # row count stamped for the receiver's ingest-side
+                    # consumers (the streamagg install hook short-
+                    # circuits empty parts on it without a part read)
+                    "rows": int(np.count_nonzero(mask)),
                 }
                 if catalog == "trace":
                     extra_meta["ordered_tags"] = list(
@@ -327,6 +331,11 @@ class WriteQueue:
                 self._pending.extend(sealed)
                 self._part_bytes.update(sizes)
                 self._spool_bytes += sum(sizes.values())
+            from banyandb_tpu.obs.metrics import global_meter
+
+            global_meter().counter_add(
+                "wqueue_sealed_rows", float(len(buf))
+            )
         except Exception:
             # undo everything (renamed-but-unregistered parts included):
             # the restored rows below are the single surviving copy

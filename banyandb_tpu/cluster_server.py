@@ -296,6 +296,36 @@ class LiaisonServer:
         b.subscribe(Topic.TRACE_WRITE, self._trace_write)
         b.subscribe(Topic.TRACE_QUERY_BY_ID, self._trace_query_by_id)
         b.subscribe(TOPIC_QL, self._ql)
+        # streaming-aggregation control plane: the liaison broadcasts a
+        # dashboard-signature registration to every alive data node
+        # (windows are node-local; each node backfills its own shards)
+        b.subscribe("streamagg", self._streamagg)
+
+    def _streamagg(self, env: dict):
+        # same op surface as the standalone/data-node handlers (default
+        # op=stats), fanned out to the alive data nodes
+        op = env.get("op", "stats")
+        if op == "register":
+            return {
+                "acks": self.liaison.register_streamagg(
+                    env["group"],
+                    env["measure"],
+                    key_tags=tuple(env.get("key_tags", ())),
+                    fields=tuple(env.get("fields", ())),
+                    window_millis=env.get("window_millis"),
+                    max_windows=env.get("max_windows"),
+                )
+            }
+        if op == "stats":
+            out = {}
+            for n in self.liaison.selector.nodes:
+                if n.name not in self.liaison.alive:
+                    continue
+                out[n.name] = self.liaison.transport.call(
+                    n.addr, "streamagg", {"op": "stats"}, timeout=10.0
+                ).get("streamagg")
+            return {"streamagg": out}
+        raise ValueError(f"bad streamagg op {op!r}")
 
     def _registry_op(self, env: dict):
         """Schema CRUD lands in the liaison registry, then pushes to every

@@ -141,6 +141,15 @@ class MeasureEngine:
         # Serving-cache companions: persistent dictionaries + remaps per
         # measure (measure_exec.DictState), created lazily under the lock.
         self._dict_states: dict[tuple[str, str], measure_exec.DictState] = {}
+        # Continuous streaming aggregation (query/streamagg.py): rolling
+        # materialized windows for registered dashboard signatures,
+        # updated at ingest and reloaded (with a deterministic part
+        # backfill) across restarts.  Function-local import: the engines
+        # layer reaches the executor layer lazily, like flush()'s
+        # precompile hook.
+        from banyandb_tpu.query.streamagg import StreamAggRegistry
+
+        self.streamagg = StreamAggRegistry(self)
 
     def _dict_state(self, group: str, name: str) -> "measure_exec.DictState":
         key = (group, name)
@@ -209,56 +218,102 @@ class MeasureEngine:
         db = self._tsdb(req.group)
         shard_num = self.registry.get_group(req.group).resource_opts.shard_num
         n = 0
-        for p in req.points:
-            # Series identity is (measure, entity values) — two measures
-            # sharing an entity tuple must not collide in the series index.
-            entity = [req.name.encode()] + [
-                hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
-            ]
-            sid = hashing.series_id(entity)
-            seg = db.segment_for(p.ts_millis)
-            version = p.version or _next_versions(1)
-            tag_bytes = {
-                t.name: _tag_to_bytes(p.tags.get(t.name), t.type)
-                for t in m.tags
-            }
-            for f in _raw_fields(m):
-                tag_bytes[_RAW_FIELD_PREFIX + f.name] = _raw_field_bytes(
-                    p.fields.get(f.name)
-                )
-            field_vals = {
-                f.name: float(p.fields.get(f.name, 0))
-                for f in _numeric_fields(m)
-            }
-            if m.index_mode:
-                # Index-mode measures live entirely in the series index —
-                # one doc per data point (handleIndexMode,
-                # banyand/measure/write_standalone.go:348).
-                _index_mode_write(
-                    seg, m, sid, p.ts_millis, version, tag_bytes, field_vals
+        # streaming-aggregation hook rows (query/streamagg.py): only
+        # collected when a materialized signature is registered for this
+        # measure — the common case pays one frozenset lookup
+        sa_rows = (
+            []
+            if not m.index_mode
+            and self.streamagg.active(req.group, req.name)
+            else None
+        )
+        # ingest gate (query/streamagg.py): ticket in before rows
+        # become memtable-visible, out after the window observe — a
+        # concurrent registration backfill drains these tickets before
+        # it stops buffering, so pre-snapshot rows never double-apply
+        self.streamagg.ingest_enter()
+        try:
+            for p in req.points:
+                # Series identity is (measure, entity values) — two measures
+                # sharing an entity tuple must not collide in the series index.
+                entity = [req.name.encode()] + [
+                    hashing.entity_bytes(p.tags[t]) for t in m.entity.tag_names
+                ]
+                sid = hashing.series_id(entity)
+                seg = db.segment_for(p.ts_millis)
+                version = p.version or _next_versions(1)
+                tag_bytes = {
+                    t.name: _tag_to_bytes(p.tags.get(t.name), t.type)
+                    for t in m.tags
+                }
+                for f in _raw_fields(m):
+                    tag_bytes[_RAW_FIELD_PREFIX + f.name] = _raw_field_bytes(
+                        p.fields.get(f.name)
+                    )
+                field_vals = {
+                    f.name: float(p.fields.get(f.name, 0))
+                    for f in _numeric_fields(m)
+                }
+                if m.index_mode:
+                    # Index-mode measures live entirely in the series index —
+                    # one doc per data point (handleIndexMode,
+                    # banyand/measure/write_standalone.go:348).
+                    _index_mode_write(
+                        seg, m, sid, p.ts_millis, version, tag_bytes, field_vals
+                    )
+                    n += 1
+                    continue
+                shard = hashing.shard_id(sid, shard_num)
+                entity_tags = {t: tag_bytes[t] for t in m.entity.tag_names}
+                entity_tags["@measure"] = req.name.encode()
+                seg.series_index.insert_series(sid, entity_tags)
+                seg.shards[shard].ingest(
+                    lambda mem: mem.append_measure(
+                        m.name,
+                        _tag_col_names(m),
+                        [f.name for f in _numeric_fields(m)],
+                        p.ts_millis,
+                        sid,
+                        version,
+                        tag_bytes,
+                        field_vals,
+                    )
                 )
                 n += 1
-                continue
-            shard = hashing.shard_id(sid, shard_num)
-            entity_tags = {t: tag_bytes[t] for t in m.entity.tag_names}
-            entity_tags["@measure"] = req.name.encode()
-            seg.series_index.insert_series(sid, entity_tags)
-            seg.shards[shard].ingest(
-                lambda mem: mem.append_measure(
-                    m.name,
-                    _tag_col_names(m),
-                    [f.name for f in _numeric_fields(m)],
-                    p.ts_millis,
-                    sid,
-                    version,
-                    tag_bytes,
-                    field_vals,
-                )
-            )
-            n += 1
-            if not _internal:
-                self.topn.observe(m, p)
+                if sa_rows is not None:
+                    sa_rows.append(
+                        (p.ts_millis, sid, version, shard, tag_bytes, field_vals)
+                    )
+                if not _internal:
+                    self.topn.observe(m, p)
+            if sa_rows:
+                self._observe_streamagg_rows(m, sa_rows)
+        finally:
+            self.streamagg.ingest_exit()
         return n
+
+    def _observe_streamagg_rows(self, m: Measure, rows: list) -> None:
+        """Row-path bridge onto the columnar streamagg observe: rows are
+        (ts, sid, version, shard, tag_bytes dict, field_vals dict)."""
+        n = len(rows)
+        ts = np.fromiter((r[0] for r in rows), np.int64, count=n)
+        sids = np.fromiter((r[1] for r in rows), np.int64, count=n)
+        vers = np.fromiter((r[2] for r in rows), np.int64, count=n)
+        shards = np.fromiter((r[3] for r in rows), np.int64, count=n)
+        self.streamagg.observe(
+            m.group,
+            m.name,
+            ts=ts,
+            series=sids,
+            versions=vers,
+            shards=shards,
+            tag_col=lambda t: np.asarray(
+                [r[4].get(t, b"") for r in rows], dtype=object
+            ),
+            field_col=lambda f: np.fromiter(
+                (r[5].get(f, 0.0) for r in rows), np.float64, count=n
+            ),
+        )
 
     def write_points_bulk(self, req: WriteRequest) -> int:
         """Row-shaped request -> columnar ingest: the wire handlers'
@@ -500,49 +555,76 @@ class MeasureEngine:
                         },
                     )
             return n
-        for start in np.unique(seg_starts).tolist():
-            seg = seg_for(int(start))
-            seg_mask = seg_starts == start
-            # series registration is PER SEGMENT (each segment owns its own
-            # series index, same as the row path): one doc per distinct
-            # entity appearing in this segment
-            seg_rows = np.nonzero(seg_mask)[0]
-            first = np.unique(inv[seg_mask], return_index=True)[1]
-            for row in seg_rows[first].tolist():
-                doc = {t: tag_bytes[t][row] for t in m.entity.tag_names}
-                doc["@measure"] = name.encode()
-                seg.series_index.insert_series(int(sids[row]), doc)
-            for shard_idx in np.unique(shards[seg_mask]).tolist():
-                mask = seg_mask & (shards == shard_idx)
-                idx = np.nonzero(mask)[0]
-                sel_tags = {}
-                for t, col in tag_bytes.items():
+        self.streamagg.ingest_enter()  # see write(): backfill drain gate
+        try:
+            for start in np.unique(seg_starts).tolist():
+                seg = seg_for(int(start))
+                seg_mask = seg_starts == start
+                # series registration is PER SEGMENT (each segment owns its own
+                # series index, same as the row path): one doc per distinct
+                # entity appearing in this segment
+                seg_rows = np.nonzero(seg_mask)[0]
+                first = np.unique(inv[seg_mask], return_index=True)[1]
+                for row in seg_rows[first].tolist():
+                    doc = {t: tag_bytes[t][row] for t in m.entity.tag_names}
+                    doc["@measure"] = name.encode()
+                    seg.series_index.insert_series(int(sids[row]), doc)
+                for shard_idx in np.unique(shards[seg_mask]).tolist():
+                    mask = seg_mask & (shards == shard_idx)
+                    idx = np.nonzero(mask)[0]
+                    sel_tags = {}
+                    for t, col in tag_bytes.items():
+                        if col is None:
+                            sel_tags[t] = None
+                        elif isinstance(col, DictColumn):
+                            sel_tags[t] = col.take(idx)
+                        else:
+                            sel_tags[t] = [col[i] for i in idx]
+                    sel_fields = {}
+                    for f in _numeric_fields(m):
+                        v = num_fields.get(f.name)
+                        sel_fields[f.name] = (
+                            np.asarray(v)[idx] if v is not None else None
+                        )
+                    shard_obj = seg.shards[int(shard_idx)]
+                    shard_obj.ingest(
+                        lambda mem: mem.append_measure_bulk(
+                            name,
+                            _tag_col_names(m),
+                            [f.name for f in _numeric_fields(m)],
+                            ts_millis[idx],
+                            sids[idx],
+                            versions[idx],
+                            sel_tags,
+                            sel_fields,
+                        )
+                    )
+            self.topn.observe_columns(m, ts_millis, tags, num_fields)
+            if self.streamagg.active(group, name):
+
+                def _sa_tag(t: str) -> np.ndarray:
+                    col = tag_bytes.get(t)
                     if col is None:
-                        sel_tags[t] = None
-                    elif isinstance(col, DictColumn):
-                        sel_tags[t] = col.take(idx)
-                    else:
-                        sel_tags[t] = [col[i] for i in idx]
-                sel_fields = {}
-                for f in _numeric_fields(m):
-                    v = num_fields.get(f.name)
-                    sel_fields[f.name] = (
-                        np.asarray(v)[idx] if v is not None else None
-                    )
-                shard_obj = seg.shards[int(shard_idx)]
-                shard_obj.ingest(
-                    lambda mem: mem.append_measure_bulk(
-                        name,
-                        _tag_col_names(m),
-                        [f.name for f in _numeric_fields(m)],
-                        ts_millis[idx],
-                        sids[idx],
-                        versions[idx],
-                        sel_tags,
-                        sel_fields,
-                    )
+                        return np.full(n, b"", dtype=object)
+                    if isinstance(col, DictColumn):
+                        return np.asarray(col.values, dtype=object)[
+                            np.asarray(col.codes)
+                        ]
+                    return np.asarray(col, dtype=object)
+
+                def _sa_field(f: str) -> np.ndarray:
+                    col = num_fields.get(f)
+                    if col is None:
+                        return np.zeros(n, dtype=np.float64)
+                    return np.asarray(col, dtype=np.float64)
+
+                self.streamagg.observe(
+                    group, name,
+                    ts=ts_millis, series=sids, versions=versions,
+                    shards=shards, tag_col=_sa_tag, field_col=_sa_field,
                 )
-        self.topn.observe_columns(m, ts_millis, tags, num_fields)
+        finally:
+            self.streamagg.ingest_exit()
         return n
 
     def ensure_result_measure(self, group: str) -> None:
@@ -599,6 +681,20 @@ class MeasureEngine:
         db = self._tsdb(group)
         with t.span("analyze"):
             plan = logical.analyze_measure(m, req)
+        # Materialized-window rewrite (query/streamagg.py): an aggregate
+        # whose (signature, time range, group-by) is covered by rolling
+        # windows folds states instead of rescanning parts; partial
+        # head/tail windows rescan ONLY the uncovered sub-ranges.
+        if plan.find("GroupByAggregate") is not None and not m.index_mode:
+            cover = self.streamagg.plan_cover(m, req)
+            if cover is not None:
+                res = self._query_materialized(
+                    m, req, db, plan, cover, shard_ids, tracer, t,
+                    t_start, own_tracer,
+                )
+                if res is not None:
+                    return res
+                # coverage lost (window evicted mid-plan): full rescan
         t_pg = time.perf_counter()  # stage metric covers ONLY part gather
         with t.span("part_gather") as gs:
             if plan.leaf().kind == "IndexModeScan":
@@ -649,6 +745,81 @@ class MeasureEngine:
                 res.trace["span_tree"] = tracer.finish()
         return res
 
+    def _query_materialized(
+        self, m, req, db, plan, cover, shard_ids, tracer, t, t_start,
+        own_tracer,
+    ) -> QueryResult:
+        """Answer a covered aggregate from materialized rolling windows
+        (query/streamagg.py): fold window states into partials, rescan
+        only the uncovered head/tail ranges, then run the ordinary
+        combine/finalize tail — `BYDB_STREAMAGG=0` byte-parity rides on
+        the finalize path being shared."""
+        analyzers = self._tag_analyzers(m.group, req.name)
+        with t.span("streamagg") as ss:
+            span = ss if tracer is not None else None
+            parts = self.streamagg.answer(
+                cover,
+                shard_ids=shard_ids,
+                rescan=lambda b, e: self._rescan_partials(
+                    db, m, req, b, e, shard_ids, analyzers, span
+                ),
+                span=span,
+            )
+            if parts is None:
+                return None  # coverage lost: caller runs the rescan
+            try:
+                res = measure_exec.finalize_partials(
+                    m, req, parts, span=span
+                )
+            finally:
+                _H_QUERY.observe(
+                    (time.perf_counter() - t_start) * 1000
+                )
+        if req.trace:
+            from banyandb_tpu.storage.cache import device_cache, global_cache
+
+            res.trace = {
+                "spans": [
+                    {
+                        "name": "streamagg",
+                        "duration_ms": round(
+                            (time.perf_counter() - t_start) * 1000, 3
+                        ),
+                        "coverage": cover.kind,
+                    }
+                ],
+                "serving_cache": global_cache().stats(),
+                "device_cache": device_cache().stats(),
+                "total_ms": round(
+                    (time.perf_counter() - t_start) * 1000, 3
+                ),
+                "plan": plan.explain(),
+            }
+            if own_tracer:
+                res.trace["span_tree"] = tracer.finish()
+        return res
+
+    def _rescan_partials(
+        self, db, m, req, begin, end, shard_ids, analyzers, span
+    ):
+        """Bounded rescan of one uncovered sub-range through the normal
+        gather+compute path (block selection prunes to the range; the
+        merged-part retry lives in gather_query_sources)."""
+        import dataclasses as _dc
+
+        from banyandb_tpu.api.model import TimeRange as _TR
+
+        sub = _dc.replace(req, time_range=_TR(begin, end))
+        sources = self.gather_query_sources(
+            sub, shard_ids=shard_ids, serial=True
+        )
+        return measure_exec.compute_partials(
+            m, sub, sources,
+            dict_state=self._dict_state(m.group, req.name),
+            analyzers=analyzers,
+            span=span,
+        )
+
     def query_partials(
         self,
         req: QueryRequest,
@@ -665,6 +836,39 @@ class MeasureEngine:
         t0 = time.perf_counter()
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
+        # Materialized-window map phase: a covered node folds its local
+        # shard subset's window states into one Partials (merged across
+        # shards/nodes by the liaison exactly like scan partials).  The
+        # percentile second round pins hist_range and must rescan —
+        # windows hold no histograms.
+        if hist_range is None and not m.index_mode:
+            cover = self.streamagg.plan_cover(m, req)
+            if cover is not None:
+                analyzers = self._tag_analyzers(group, req.name)
+                with t.span("streamagg") as ss:
+                    span = ss if tracer is not None else None
+                    parts = self.streamagg.answer(
+                        cover,
+                        shard_ids=shard_ids,
+                        rescan=lambda b, e: self._rescan_partials(
+                            self._tsdb(group), m, req, b, e,
+                            shard_ids, analyzers, span,
+                        ),
+                        span=span,
+                    )
+                    if parts is not None:
+                        try:
+                            out = (
+                                parts[0]
+                                if len(parts) == 1
+                                else measure_exec.combine_partials(parts)
+                            )
+                        finally:
+                            _H_QUERY.observe(
+                                (time.perf_counter() - t0) * 1000
+                            )
+                        return out
+                # coverage lost mid-plan: fall through to the rescan
         t_pg = time.perf_counter()  # stage metric covers ONLY part gather
         with t.span("part_gather") as gs:
             sources = self.gather_query_sources(req, shard_ids=shard_ids)
@@ -715,10 +919,12 @@ class MeasureEngine:
             pass
         return out
 
-    def gather_query_sources(self, req, shard_ids=None):
+    def gather_query_sources(self, req, shard_ids=None, serial=False):
         """Source selection for the map phase, shared by the host partial
-        path and the mesh fast path (parallel/mesh_query.py): same
-        segment/series pruning, same retry on concurrently-merged parts."""
+        path, the mesh fast path (parallel/mesh_query.py) and the
+        streamagg bounded rescans (`serial=True` skips the part
+        prefetch thread): same segment/series pruning, same retry on
+        concurrently-merged parts."""
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
@@ -726,7 +932,9 @@ class MeasureEngine:
             return self._index_sources(db, m, req, shard_ids)
         for attempt in range(3):
             try:
-                return self._gather_sources(db, m, req, shard_ids=shard_ids)
+                return self._gather_sources(
+                    db, m, req, shard_ids=shard_ids, serial=serial
+                )
             except FileNotFoundError:
                 if attempt == 2:
                     raise
@@ -756,7 +964,12 @@ class MeasureEngine:
         return out
 
     def _gather_sources(
-        self, db: TSDB, m: Measure, req: QueryRequest, shard_ids=None
+        self,
+        db: TSDB,
+        m: Measure,
+        req: QueryRequest,
+        shard_ids=None,
+        serial: bool = False,
     ) -> list[ColumnData]:
         """Collect per-source decode thunks (metadata-only work: segment
         selection, series-index pruning, block selection), then evaluate
@@ -853,8 +1066,11 @@ class MeasureEngine:
             for shard_idx, shard in enumerate(seg.shards):
                 if shard_ids is not None and shard_idx not in shard_ids:
                     continue
-                mem_cols = shard.mem.columns_for(m.name)
-                if mem_cols is not None and mem_cols.ts.size:
+                # live memtable + any in-flight flush snapshot (rows
+                # between flush's two commit points stay visible;
+                # version dedup collapses a racing double-expose)
+                hot_cols = shard.hot_columns(m.name)
+                for mem_cols in hot_cols:
                     read_ops.append(
                         lambda mc=mem_cols, filt=_series_rows: filt(
                             mc, mc.cache_key
@@ -877,7 +1093,7 @@ class MeasureEngine:
                 if zone_conds and shard_parts:
                     from banyandb_tpu.storage.part import KeyInterval
 
-                    if mem_cols is not None and mem_cols.ts.size:
+                    for mem_cols in hot_cols:
                         kept_intervals.append(
                             KeyInterval.conservative(
                                 int(mem_cols.series.min()),
@@ -916,10 +1132,18 @@ class MeasureEngine:
                         )
         # a mid-stream decode error (e.g. a part merged away under us)
         # re-raises here exactly as the serial loop would — query()'s
-        # FileNotFoundError retry still applies
+        # FileNotFoundError retry still applies.  `serial` (bounded
+        # streamagg head/tail rescans) skips the prefetch thread
+        # entirely: results are byte-identical by the pipeline contract,
+        # and at a few blocks of work the thread handoffs cost more
+        # than the overlap buys — especially under write-saturated GIL
         return [
             src
-            for src in prefetched(read_ops, name="bydb-part-prefetch")
+            for src in prefetched(
+                read_ops,
+                name="bydb-part-prefetch",
+                enabled=False if serial else None,
+            )
             if src is not None
         ]
 

@@ -274,8 +274,9 @@ class StreamEngine:
             for shard_idx, shard in enumerate(seg.shards):
                 if shard_ids is not None and shard_idx not in shard_ids:
                     continue
-                mem_cols = shard.mem.columns_for(s.name)
-                if mem_cols is not None and mem_cols.ts.size:
+                # live memtable + in-flight flush snapshot (rows stay
+                # visible while their part encodes outside the lock)
+                for mem_cols in shard.hot_columns(s.name):
                     read_ops.append(lambda mc=mem_cols: mc)
                 for part in shard.parts:
                     if part.meta.get("stream") != s.name:

@@ -284,8 +284,9 @@ class TraceEngine:
         out: list[dict] = []
         for seg in db.segments:
             shard = seg.shards[shard_idx]
-            mem_cols = shard.mem.columns_for(name)
-            sources = [mem_cols] if mem_cols is not None and mem_cols.ts.size else []
+            # live memtable + in-flight flush snapshot (flush encodes
+            # parts outside the shard lock)
+            sources = list(shard.hot_columns(name))
             for part in shard.parts:
                 if part.meta.get("trace") != name:
                     continue

@@ -40,6 +40,7 @@ TOPIC_METRICS = "metrics"
 TOPIC_SLOWLOG = "slowlog"
 from banyandb_tpu.admin.diagnostics import DIAG_TOPIC as TOPIC_DIAGNOSTICS  # noqa: E402
 TOPIC_TOPN = "topn"
+TOPIC_STREAMAGG = "streamagg"
 
 # conservative per-point admission estimate for the memory protector
 _POINT_BYTES = 256
@@ -49,6 +50,44 @@ def _rss() -> int:
     from banyandb_tpu.admin.protector import process_rss
 
     return process_rss()
+
+
+def _served_class(tree: dict) -> str:
+    """Classify how a query was answered from its span tree:
+
+    - ``materialized``: a ``streamagg`` span ran — the answer folded
+      materialized rolling windows (query/streamagg.py), possibly with
+      bounded head/tail rescans;
+    - ``replay``: every reduce leg was a partials serving-cache hit —
+      the latency measures cache replay, not scan work;
+    - ``scan``: at least one real (cache-miss) reduction ran.
+    """
+    reduce_tags: list[dict] = []
+    saw_streamagg = False
+
+    def walk(node):
+        nonlocal saw_streamagg
+        if not isinstance(node, dict):
+            return
+        if node.get("name") == "streamagg" and (
+            (node.get("tags") or {}).get("coverage") in ("covered", "partial")
+        ):
+            saw_streamagg = True
+        if node.get("name") == "reduce":
+            reduce_tags.append(node.get("tags", {}) or {})
+        for c in node.get("children", ()) or ():
+            walk(c)
+
+    walk(tree)
+    if saw_streamagg:
+        return "materialized"
+    # (a streamagg span tagged coverage="lost" fell back to rescan and
+    # is deliberately NOT counted as materialized — see walk() above)
+    if reduce_tags and all(
+        t.get("partials_cache") == "hit" for t in reduce_tags
+    ):
+        return "replay"
+    return "scan"
 
 
 def _jsonable(v):
@@ -93,6 +132,7 @@ class StandaloneServer:
         pprof_port: int | None = None,
         auth_file: str | None = None,
         slow_query_ms: float | None = None,
+        serving_cache_cap: int | None = None,
     ):
         from banyandb_tpu.obs import SlowQueryRecorder
         from banyandb_tpu.obs.metrics import global_meter
@@ -120,6 +160,13 @@ class StandaloneServer:
                 "BYDB_SLOW_QUERY_MS", AccessLog.DEFAULT_SLOW_QUERY_MS
             )
         self.slow_query_ms = slow_query_ms
+        # serving-cache entry capacity (flag > BYDB_SERVING_CACHE_CAP
+        # env > bytes-only): the r06 load run showed entry churn is an
+        # operator-sized knob, not a constant
+        if serving_cache_cap is not None and serving_cache_cap > 0:
+            from banyandb_tpu.storage.cache import global_cache
+
+            global_cache().set_cap(serving_cache_cap)
         self.slowlog = SlowQueryRecorder()
         self.access_log = AccessLog(
             self.root / "logs" / "access.log", slow_query_ms=slow_query_ms
@@ -233,6 +280,7 @@ class StandaloneServer:
         b.subscribe(TOPIC_SLOWLOG, self._slowlog)
         b.subscribe(TOPIC_DIAGNOSTICS, self._diagnostics)
         b.subscribe(TOPIC_TOPN, self._topn)
+        b.subscribe(TOPIC_STREAMAGG, self._streamagg)
 
     # -- handlers -----------------------------------------------------------
     def _measure_write(self, env):
@@ -384,8 +432,14 @@ class StandaloneServer:
             ("device", device_cache()),
         ):
             st = cache.stats()
-            for k in ("hits", "misses", "evictions", "entries", "bytes"):
+            for k in (
+                "hits", "misses", "evictions", "entries", "bytes",
+                "cap", "churn",
+            ):
                 self.meter.gauge_set(f"{scope}_cache_{k}", float(st[k]))
+        # materialized rolling-window plane (query/streamagg.py):
+        # window/state population + per-signature watermark gauges
+        self.measure.streamagg.export_gauges()
         cc = compile_cache.stats()
         self.meter.gauge_set("compile_cache_enabled", float(cc["enabled"]))
         for k in ("hits", "misses", "entries"):
@@ -394,6 +448,25 @@ class StandaloneServer:
         for k in ("recorded", "compiled", "errors"):
             self.meter.gauge_set(f"precompile_{k}", float(pr[k]))
         return {"prometheus": self.meter.prometheus_text()}
+
+    def _streamagg(self, env):
+        """Streaming-aggregation control surface (query/streamagg.py):
+        register materialized dashboard signatures / read window
+        state."""
+        op = env.get("op", "stats")
+        if op == "register":
+            info = self.measure.streamagg.register(
+                env["group"],
+                env["measure"],
+                key_tags=tuple(env.get("key_tags", ())),
+                fields=tuple(env.get("fields", ())),
+                window_millis=env.get("window_millis"),
+                max_windows=env.get("max_windows"),
+            )
+            return {"registered": info}
+        if op == "stats":
+            return {"streamagg": self.measure.streamagg.stats()}
+        raise KeyError(f"bad streamagg op {op!r}")
 
     def _topn(self, env):
         """TopN query over pre-aggregated windows (TopNService analog)."""
@@ -521,7 +594,11 @@ class StandaloneServer:
             tree=tree, res=res, ql=env["ql"],
         )
         attach_tree(res, req, tree)
-        return {"result": result_to_json(res)}
+        # serve-path marker OUTSIDE the result payload (the A/B byte
+        # parity contracts compare reply["result"] only): the load
+        # harness splits its latency headline into cache replay vs real
+        # (cache-miss) scans vs materialized-window reads with this
+        return {"result": result_to_json(res), "served": _served_class(tree)}
 
     def _ql_trace(self, req: QueryRequest) -> QueryResult:
         from banyandb_tpu.query import ql_exec
@@ -697,6 +774,11 @@ def build_config():
         "slow-query threshold: queries at/over it get the access-log "
         "slow mark AND a flight-recorder entry (cli.py slowlog)", float,
     )
+    cfg.register(
+        "serving-cache-cap", 0,
+        "serving-cache ENTRY capacity on top of the byte budget "
+        "(BYDB_SERVING_CACHE_CAP env; 0 = bytes-only)", int,
+    )
     # role topology (pkg/cmdsetup/root.go:89-91 standalone/data/liaison)
     cfg.register("role", "standalone", "standalone | data | liaison", str)
     cfg.register("name", "", "node name (data role)", str)
@@ -750,6 +832,9 @@ def main(argv=None) -> None:
         "liaison": [
             ("pprof-port", s.pprof_port != -1),
             ("name", bool(s.name)),
+            # liaisons hold no serving cache; data nodes size theirs via
+            # the BYDB_SERVING_CACHE_CAP env (per-process)
+            ("serving-cache-cap", s.serving_cache_cap != 0),
         ],
         "standalone": [
             ("discovery", bool(s.discovery)),
@@ -769,6 +854,12 @@ def main(argv=None) -> None:
     if s.role == "data":
         from banyandb_tpu.cluster_server import DataServer
 
+        if s.serving_cache_cap:
+            # data nodes hold the serving cache in cluster mode: the
+            # entry-cap knob applies there exactly like standalone
+            from banyandb_tpu.storage.cache import global_cache
+
+            global_cache().set_cap(s.serving_cache_cap)
         srv = DataServer(s.root, name=s.name, port=s.port)
 
         def announce():
@@ -810,6 +901,7 @@ def main(argv=None) -> None:
             http_port=None if s.http_port < 0 else s.http_port,
             pprof_port=None if s.pprof_port < 0 else s.pprof_port,
             slow_query_ms=s.slow_query_ms,
+            serving_cache_cap=s.serving_cache_cap or None,
         )
 
         def announce():

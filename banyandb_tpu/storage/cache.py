@@ -19,11 +19,18 @@ from __future__ import annotations
 import os
 import threading
 from collections import OrderedDict
-from typing import Callable
+from typing import Callable, Optional
 
 import numpy as np
 
+from banyandb_tpu.utils.envflag import env_int
+
 DEFAULT_BUDGET = int(os.environ.get("BYDB_SERVING_CACHE_BYTES", 256 << 20))
+# optional ENTRY capacity on top of the byte budget: the load harness
+# showed a 916-entry squeeze churning 18k evictions in 10 minutes
+# (docs/load_r06.json) — operators size the entry population explicitly
+# with BYDB_SERVING_CACHE_CAP / --serving-cache-cap (0 = bytes-only)
+DEFAULT_CAP = env_int("BYDB_SERVING_CACHE_CAP", 0)
 
 
 def _sizeof(obj) -> int:
@@ -45,14 +52,37 @@ def _sizeof(obj) -> int:
 class ServingCache:
     """LRU byte-budget cache; values must be treated as immutable."""
 
-    def __init__(self, budget_bytes: int = DEFAULT_BUDGET):
+    def __init__(
+        self,
+        budget_bytes: int = DEFAULT_BUDGET,
+        max_entries: Optional[int] = None,
+    ):
         self.budget = budget_bytes
+        # entry cap: 0 = unlimited (byte budget only); None inherits the
+        # BYDB_SERVING_CACHE_CAP env default read at import
+        self.cap = DEFAULT_CAP if max_entries is None else int(max_entries)
         self._lock = threading.Lock()
         self._entries: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
         self.bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+
+    def set_cap(self, max_entries: int) -> None:
+        """Reconfigure the entry cap live (server flag); evicts down to
+        the new bound immediately."""
+        with self._lock:
+            self.cap = int(max_entries)
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        while self._entries and (
+            self.bytes > self.budget
+            or (self.cap and len(self._entries) > self.cap)
+        ):
+            _, (_, evicted) = self._entries.popitem(last=False)
+            self.bytes -= evicted
+            self.evictions += 1
 
     def get_or_load(self, key: tuple, loader: Callable[[], object]):
         with self._lock:
@@ -74,10 +104,7 @@ class ServingCache:
                 self.bytes -= prev[1]
             self._entries[key] = (value, size)
             self.bytes += size
-            while self.bytes > self.budget and self._entries:
-                _, (_, evicted) = self._entries.popitem(last=False)
-                self.bytes -= evicted
-                self.evictions += 1
+            self._evict_locked()
         return value
 
     def invalidate_prefix(self, prefix: tuple) -> int:
@@ -99,13 +126,20 @@ class ServingCache:
 
     def stats(self) -> dict:
         with self._lock:
+            lookups = self.hits + self.misses
             return {
                 "entries": len(self._entries),
                 "bytes": self.bytes,
                 "budget": self.budget,
+                "cap": self.cap,
                 "hits": self.hits,
                 "misses": self.misses,
                 "evictions": self.evictions,
+                # eviction churn: evictions per lookup — the r06 squeeze
+                # signal (18102 evictions / 76k lookups) as one number
+                "churn": round(self.evictions / lookups, 4)
+                if lookups
+                else 0.0,
             }
 
 
@@ -115,8 +149,10 @@ _global = ServingCache()
 # — its own budget so HBM residency is bounded independently of the host
 # cache (default 1 GiB: a deliberate slice of the chip's 16-32 GiB HBM,
 # since resident chunks save both decode AND host->device transfer).
+# max_entries=0: the serving-cache ENTRY cap (BYDB_SERVING_CACHE_CAP) is
+# a host-cache knob and must not silently bound HBM residency too.
 DEVICE_BUDGET = int(os.environ.get("BYDB_DEVICE_CACHE_BYTES", 1 << 30))
-_device = ServingCache(DEVICE_BUDGET)
+_device = ServingCache(DEVICE_BUDGET, max_entries=0)
 
 
 def global_cache() -> ServingCache:
@@ -131,5 +167,5 @@ def reset_global_cache(budget_bytes: int = DEFAULT_BUDGET) -> ServingCache:
     """Test hook / server reconfiguration."""
     global _global, _device
     _global = ServingCache(budget_bytes)
-    _device = ServingCache(DEVICE_BUDGET)
+    _device = ServingCache(DEVICE_BUDGET, max_entries=0)
     return _global

@@ -69,6 +69,15 @@ class Shard:
         self.mem = mem_factory()
         self._epoch = 0
         self._parts: dict[str, Part] = {}
+        # in-flight flush snapshot ((resource name, ColumnData), ...):
+        # drained memtable rows stay queryable while their part encodes
+        # OUTSIDE the lock (see flush()).  Immutable tuple rebinds only.
+        self._flushing: tuple = ()
+        # serializes whole flush() invocations: the lifecycle loop and
+        # the operator flush/snapshot surface may race, and _flushing is
+        # a single slot — a second concurrent flush would overwrite the
+        # first's in-flight snapshot and hide its rows mid-encode
+        self._flush_mutex = threading.Lock()
         self._load_snapshot()
 
     def _notify_part_built(self, part_dir, extra_meta) -> None:
@@ -137,6 +146,8 @@ class Shard:
                 shutil.rmtree(pdir, ignore_errors=True)
         for pdir in self.root.glob(".tmp-merge-*"):
             shutil.rmtree(pdir, ignore_errors=True)
+        for pdir in self.root.glob(".tmp-flush-*"):
+            shutil.rmtree(pdir, ignore_errors=True)
 
     def _publish(self) -> None:
         fs.atomic_write_json(
@@ -163,23 +174,46 @@ class Shard:
         """Memtable -> new part(s) + snapshot publish. Returns part names.
 
         Multi-resource memtables (measure engines) drain to one part per
-        resource; the snapshot publish at the end is the single MVCC
-        commit point for all of them.
+        resource.  The shard lock is held only for the two O(1) commit
+        points — the memtable swap and the rename+publish — NEVER across
+        the part encode/write: at sustained ingest a whole-memtable
+        encode is hundreds of ms, and holding the lock there stalled
+        every concurrent append AND every query's ``parts`` snapshot
+        behind the flush (the streamagg load run measured multi-second
+        query tails from exactly this).  Between the two commit points
+        the drained rows stay queryable through the ``_flushing``
+        snapshot (``hot_columns``); a reader racing the second commit
+        may see a row in BOTH the flushing snapshot and the new part,
+        which the (series, ts) max-version dedup every query path
+        already applies collapses to one — rows are never invisible.
         """
+        import shutil
+        import uuid as _uuid
+
+        with self._flush_mutex:
+            return self._flush_serialized(shutil, _uuid)
+
+    def _flush_serialized(self, shutil, _uuid) -> Optional[list[str]]:
         with self._lock:
             if len(self.mem) == 0:
                 return None
             drained = self.mem.drain()
+            # publish the flushing snapshot BEFORE swapping the memtable:
+            # hot_columns reads (mem, _flushing) lock-free in that order,
+            # so rows must appear in _flushing before they vanish from
+            # mem — the transient double-expose dedups, a gap would not
+            self._flushing = tuple(
+                (name, cols) for name, cols, _m in drained
+            )
             self.mem = self._mem_factory()
-            names = []
-            built = []
+        tmp_dirs: list[tuple[Path, dict]] = []
+        try:
             for _suffix, cols, extra_meta in drained:
                 if cols.ts.size == 0:
                     continue
-                self._epoch += 1
-                name = f"part-{self._epoch:016x}"
+                tmp = self.root / f".tmp-flush-{_uuid.uuid4().hex}"
                 PartWriter.write(
-                    self.root / name,
+                    tmp,
                     ts=cols.ts,
                     series=cols.series,
                     version=cols.version,
@@ -189,16 +223,59 @@ class Shard:
                     extra_meta=extra_meta,
                     payloads=cols.payloads,
                 )
-                self._parts[name] = Part(self.root / name)
-                names.append(name)
-                built.append((self.root / name, extra_meta))
-            self._publish()
+                tmp_dirs.append((tmp, extra_meta))
+            names = []
+            built = []
+            with self._lock:
+                for tmp, extra_meta in tmp_dirs:
+                    self._epoch += 1
+                    name = f"part-{self._epoch:016x}"
+                    os.rename(tmp, self.root / name)
+                    self._parts[name] = Part(self.root / name)
+                    names.append(name)
+                    built.append((self.root / name, extra_meta))
+                self._publish()
+                self._flushing = ()
+        except BaseException:
+            # failed encode: same contract as before (rows in a failed
+            # flush are lost with the exception surfaced), but the
+            # flushing snapshot must not keep serving rows that will
+            # never become a part
+            with self._lock:
+                self._flushing = ()
+            for tmp, _m in tmp_dirs:
+                shutil.rmtree(tmp, ignore_errors=True)
+            raise
         # sidecar builds decode whole parts — outside the lock so appends
         # and publishes don't stall (queries before sidecars exist simply
         # scan unpruned; pruning is optional)
         for part_dir, extra_meta in built:
             self._notify_part_built(part_dir, extra_meta)
         return names
+
+    @property
+    def has_unflushed(self) -> bool:
+        """Rows not yet committed to a published part: live memtable OR
+        an in-flight flush snapshot (tier migration's quiescence gate
+        must count both, or it could drop a segment whose last rows are
+        mid-encode)."""
+        return len(self.mem) > 0 or bool(self._flushing)
+
+    def hot_columns(self, resource: str) -> list:
+        """Unflushed sources for one resource: the live memtable plus
+        any in-flight flush snapshot (rows between flush's two commit
+        points).  Read lock-free — ``mem`` and ``_flushing`` are
+        immutable-snapshot rebinds, and the memtable-first read order
+        plus version dedup downstream makes every interleaving with
+        flush() exact (see flush())."""
+        out = []
+        mem_cols = self.mem.columns_for(resource)
+        if mem_cols is not None and mem_cols.ts.size:
+            out.append(mem_cols)
+        for rname, cols in self._flushing:
+            if rname == resource and cols.ts.size:
+                out.append(cols)
+        return out
 
     def merge(
         self,

@@ -640,4 +640,11 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # grpc's C++ worker threads can abort ("terminate called without an
+    # active exception") during ordinary interpreter teardown on this
+    # gVisor-class kernel AFTER every invariant already passed — same
+    # exit contract as server.py main(): skip C++ teardown entirely
+    os._exit(rc)

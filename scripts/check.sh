@@ -50,6 +50,13 @@ echo "== device-decode smoke =="
 # (docs/performance.md "Device-side decode & zone maps")
 env JAX_PLATFORMS=cpu python scripts/decode_smoke.py || fail=1
 
+echo "== streamagg smoke =="
+# materialized rolling windows: registration backfill, ingest across a
+# window rotation, BYDB_STREAMAGG=0 A/B byte parity (covered, partial,
+# evicted-horizon), streamagg span + counters, registry store
+# round-trip (docs/performance.md "Continuous streaming aggregation")
+env JAX_PLATFORMS=cpu python scripts/streamagg_smoke.py || fail=1
+
 echo "== sanitize smoke (bdsan) =="
 # live-engine stress slice under BYDB_SANITIZE=1: lock-order witnesses
 # consistent with the declared graph, zero leaked threads/fds, seeded

@@ -149,6 +149,46 @@ def test_lru_eviction_respects_budget():
     assert st["entries"] < 20  # older entries evicted
 
 
+def test_entry_cap_evicts_beyond_capacity():
+    """BYDB_SERVING_CACHE_CAP (ISSUE 10 satellite): an explicit entry
+    capacity bounds the population independently of the byte budget —
+    the r06 load run's 916-entry squeeze becomes an operator knob."""
+    c = ServingCache(budget_bytes=1 << 30, max_entries=5)
+    for i in range(12):
+        c.get_or_load(("k", i), lambda: np.zeros(10, np.int8))
+    st = c.stats()
+    assert st["entries"] == 5
+    assert st["cap"] == 5
+    assert st["evictions"] == 7
+    # LRU: the newest entries survive
+    hits_before = c.stats()["hits"]
+    c.get_or_load(("k", 11), lambda: (_ for _ in ()).throw(AssertionError))
+    assert c.stats()["hits"] == hits_before + 1
+
+
+def test_entry_cap_env_default(monkeypatch):
+    from banyandb_tpu.storage import cache as cache_mod
+
+    monkeypatch.setattr(cache_mod, "DEFAULT_CAP", 3)
+    c = ServingCache(budget_bytes=1 << 30)
+    assert c.cap == 3
+    for i in range(6):
+        c.get_or_load(("e", i), lambda: np.zeros(1, np.int8))
+    assert c.stats()["entries"] == 3
+
+
+def test_set_cap_live_shrinks_and_churn_reported():
+    c = ServingCache(budget_bytes=1 << 30)
+    for i in range(10):
+        c.get_or_load(("k", i), lambda: np.zeros(1, np.int8))
+    assert c.stats()["entries"] == 10
+    c.set_cap(4)
+    st = c.stats()
+    assert st["entries"] == 4 and st["evictions"] == 6
+    # eviction-churn gauge input: evictions per lookup
+    assert st["churn"] == pytest.approx(6 / 10, abs=1e-4)
+
+
 def test_oversized_value_served_uncached():
     c = ServingCache(budget_bytes=100)
     v = c.get_or_load(("big",), lambda: np.zeros(1000, np.int8))
