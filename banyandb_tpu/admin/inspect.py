@@ -58,6 +58,50 @@ def inspect_root(root: str | Path) -> dict:
     return out
 
 
+def inspect_property_index(idx_dir: str | Path) -> dict:
+    """Segment-level stats for one property shard index
+    (``<root>/data/property/<group>/shard-N.idx`` — cmd/dump property
+    analog).  Read-only: manifest + per-segment headers and tombstone
+    counts, never a doc materialization."""
+    from banyandb_tpu.index.segment import Segment
+    from banyandb_tpu.utils import fs
+
+    idx_dir = Path(idx_dir)
+    man_path = idx_dir / "manifest.json"
+    if not man_path.exists():
+        raise ValueError(
+            f"dump: {idx_dir} has no manifest.json — not a property "
+            "shard index (expected <root>/data/property/<group>/"
+            "shard-N.idx)"
+        )
+    man = fs.read_json(man_path)
+    segments = []
+    for ent in man.get("segments", []):
+        name, gen = ent["name"], ent.get("tomb_gen", 0)
+        tomb = idx_dir / f"{name}.tomb-{gen}" if gen else None
+        seg = Segment(idx_dir / f"{name}.seg", tomb_path=tomb)
+        try:
+            segments.append(
+                {
+                    "name": name,
+                    "docs": seg.n,
+                    "alive": seg.alive_count,
+                    "tomb_gen": gen,
+                    "keyword_fields": list(seg.kw_fields),
+                    "numeric_fields": list(seg.num_fields),
+                    "bytes": (idx_dir / f"{name}.seg").stat().st_size,
+                }
+            )
+        finally:
+            seg.close()
+    return {
+        "manifest": man,
+        "segments": segments,
+        "docs": sum(s["docs"] for s in segments),
+        "alive": sum(s["alive"] for s in segments),
+    }
+
+
 def inspect_part(part_dir: str | Path) -> dict:
     """Column-level stats for one part (cmd/dump measure analog).
 

@@ -481,19 +481,39 @@ class WireServices:
                     raise ValueError(f"TopN condition op {op} not supported")
                 conds.append((c.name, op, wire.tag_value_to_py(c.value)))
 
-            ranked = topn_mod.query_topn(
-                self.measure,
-                group,
-                req.name,
-                TimeRange(
-                    wire.ts_to_millis(req.time_range.begin),
-                    wire.ts_to_millis(req.time_range.end),
-                ),
-                n=req.top_n or 10,
-                direction="asc" if req.field_value_sort == 2 else "desc",
-                agg=wire._AGG_FN.get(req.agg, "sum"),
-                conditions=tuple(conds),
-            )
+            begin = wire.ts_to_millis(req.time_range.begin)
+            end = wire.ts_to_millis(req.time_range.end)
+            direction = "asc" if req.field_value_sort == 2 else "desc"
+            agg = wire._AGG_FN.get(req.agg, "sum")
+            if hasattr(self.measure, "topn_scatter"):
+                # worker-pool facade: result-measure rows are worker-
+                # local, so TopN scatters per-node ranked lists and
+                # concat re-ranks (never a shard-routed query_measure,
+                # which would silently miss rows)
+                scatter = self.measure.topn_scatter({
+                    "group": group,
+                    "name": req.name,
+                    "time_range": [begin, end],
+                    "n": req.top_n or 10,
+                    "direction": direction,
+                    "agg": agg,
+                    "conditions": [list(c) for c in conds],
+                })
+                ranked = [
+                    (tuple(it["entity"]), it["value"])
+                    for it in scatter["items"]
+                ]
+            else:
+                ranked = topn_mod.query_topn(
+                    self.measure,
+                    group,
+                    req.name,
+                    TimeRange(begin, end),
+                    n=req.top_n or 10,
+                    direction=direction,
+                    agg=agg,
+                    conditions=tuple(conds),
+                )
             # the output value is typed like the SOURCE measure's field
             # (int64 aggregation stays integral, mean truncates)
             as_int = False
@@ -515,6 +535,17 @@ class WireServices:
                         int(value) if as_int else float(value)
                     )
                 )
+            if hasattr(self.measure, "topn_scatter") and scatter.get(
+                "degraded"
+            ):
+                # a down worker leg makes the ranking partial: surface
+                # it in-band like every degraded query (wire contract)
+                from types import SimpleNamespace
+
+                wire.fill_degraded(out, SimpleNamespace(
+                    degraded=True,
+                    unavailable_nodes=scatter.get("unavailable_nodes", []),
+                ))
             return out
         except Exception as e:  # noqa: BLE001
             _abort(context, e)

@@ -114,13 +114,18 @@ def main(argv=None) -> int:
     dp = sub.add_parser(
         "dump",
         help="offline part dump (cmd/dump analog): column extents, "
-        "block stats, zone-map presence",
+        "block stats, zone-map presence; sidx parts and property shard "
+        "indexes have their own formats",
     )
     dp.add_argument(
-        "kind", choices=["measure", "stream", "trace"],
-        help="expected resource kind (validated against part metadata)",
+        "kind", choices=["measure", "stream", "trace", "sidx", "property"],
+        help="expected resource kind (validated against part metadata; "
+        "property takes a shard-N.idx directory instead of a part dir)",
     )
-    dp.add_argument("part_dir", help="one part-<id> directory")
+    dp.add_argument(
+        "part_dir",
+        help="one part-<id> directory (property: one shard-N.idx dir)",
+    )
 
     lc = sub.add_parser(
         "lifecycle",
@@ -250,8 +255,22 @@ def main(argv=None) -> int:
             print("inspect needs --root or --part", file=sys.stderr)
             return 2
     elif args.cmd == "dump":
-        from banyandb_tpu.admin.inspect import inspect_part
+        from banyandb_tpu.admin.inspect import (
+            inspect_part,
+            inspect_property_index,
+        )
 
+        if args.kind == "property":
+            try:
+                doc = inspect_property_index(args.part_dir)
+            except (ValueError, KeyError, OSError) as e:
+                # an inconsistent index (manifest-listed segment gone,
+                # malformed manifest entry) must exit 2 like a non-index
+                # dir, not traceback on the operator
+                print(f"dump: {e}", file=sys.stderr)
+                return 2
+            print(json.dumps(doc, indent=1))
+            return 0
         doc = inspect_part(args.part_dir)
         if doc["meta"].get(args.kind) is None:
             print(

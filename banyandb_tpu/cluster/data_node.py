@@ -63,18 +63,32 @@ class DataNode:
         shutil.rmtree(self.root / ".sync-staging", ignore_errors=True)
         self._register_handlers()
 
-    def start_lifecycle(self, **kw) -> None:
+    def start_lifecycle(self, local_flush: bool = True, **kw) -> None:
         """Background flush/merge/retention over ALL engines' TSDBs —
         installed stream/measure parts (liaison wqueue, tier sync) merge
         and retention-sweep like locally-written ones; the extra tick
-        runs trace maintenance (blooms + sidx flush/merge)."""
+        runs trace maintenance (blooms + sidx flush/merge).
+
+        local_flush=False keeps every maintenance tick (merge sweep,
+        retention, rotation, blooms, series-index persist — all
+        idempotent over immutable parts) but never drains memtables or
+        sidx ordered keys: parts then publish ONLY through explicit
+        engine flushes.  Worker processes need this — their parent trims
+        its replay journal on the flushes IT initiates, so a loop-driven
+        drain here would persist journaled rows the parent still replays
+        after a crash, duplicating stream/trace appends (measure rows
+        collapse in version dedup; streams/traces have none)."""
+        if not local_flush:
+            # no shard grows a memtable this large: the flush stage
+            # visits every tick but never drains
+            kw.setdefault("flush_min_rows", 1 << 62)
         self.measure.start_lifecycle(
             extra_tsdbs=lambda: (
                 list(self.stream._tsdbs.values())
                 + list(self.trace._tsdbs.values())
             ),
             extra_tick=lambda: self.trace.maintain(flush_sidx=False),
-            pre_flush=self.trace._flush_sidx_first,
+            pre_flush=self.trace._flush_sidx_first if local_flush else None,
             **kw,
         )
 
@@ -83,6 +97,9 @@ class DataNode:
 
     def _register_handlers(self) -> None:
         self.bus.subscribe(Topic.MEASURE_WRITE, self._on_measure_write)
+        self.bus.subscribe(
+            Topic.MEASURE_WRITE_COLUMNS, self._on_measure_write_columns
+        )
         self.bus.subscribe(Topic.MEASURE_QUERY_PARTIAL, self._on_measure_query_partial)
         self.bus.subscribe(Topic.MEASURE_QUERY_RAW, self._on_measure_query_raw)
         self.bus.subscribe(Topic.STREAM_WRITE, self._on_stream_write)
@@ -121,6 +138,10 @@ class DataNode:
         # liaisons broadcast dashboard signature registrations here;
         # stats expose window/watermark state per node
         self.bus.subscribe("streamagg", self._on_streamagg)
+        # node-local TopN ranking over pre-aggregated windows — scatter
+        # callers (the worker pool, a future liaison TopN plane) merge
+        # per-node ranked lists
+        self.bus.subscribe("topn", self._on_topn)
         # operator flush surface (data-node SnapshotService analog):
         # persists memtables to parts on demand — ops tooling and tests
         # use it to bound the direct-write plane's crash-loss window
@@ -174,9 +195,13 @@ class DataNode:
             except KeyError:
                 self.stream.create_stream(serde.stream_schema_from_json(item))
         self.disk.check_write()
+        import time as _time
+
+        t0 = _time.perf_counter()
         n = self.stream.write(
             env["group"], env["name"], serde.elements_from_json(env["elements"])
         )
+        self._observe_write("stream", t0)
         return {"written": n}
 
     def _on_stream_query(self, env: dict) -> dict:
@@ -194,7 +219,7 @@ class DataNode:
             self.stream.get_stream(req.groups[0], req.name)
         except KeyError:
             return {"data_points": []}
-        tracer = self._node_tracer(req)
+        tracer = self._node_tracer(req, env)
         res = self.stream.query(req, shard_ids=shard_ids, tracer=tracer)
         out = {
             "data_points": [
@@ -219,10 +244,14 @@ class DataNode:
             except KeyError:
                 self.trace.create_trace(serde.trace_schema_from_json(item))
         self.disk.check_write()
+        import time as _time
+
+        t0 = _time.perf_counter()
         n = self.trace.write(
             env["group"], env["name"], serde.spans_from_json(env["spans"]),
             ordered_tags=tuple(env.get("ordered_tags", ())),
         )
+        self._observe_write("trace", t0)
         return {"written": n}
 
     def _on_trace_query(self, env: dict) -> dict:
@@ -258,11 +287,75 @@ class DataNode:
         return {"results": [[int(k), tid] for k, tid in keyed]}
 
     # -- write plane --------------------------------------------------------
+    @staticmethod
+    def _observe_write(model: str, t0: float) -> None:
+        """write_ms{model} on the node-local meter: in worker mode this
+        is what gives the merged /metrics its per-worker write labels."""
+        import time as _time
+
+        from banyandb_tpu.obs.metrics import global_meter
+
+        global_meter().observe(
+            "write_ms", (_time.perf_counter() - t0) * 1000, {"model": model}
+        )
+
     def _on_measure_write(self, env: dict) -> dict:
+        import time as _time
+
         self.disk.check_write()
         req = serde.write_request_from_json(env["request"])
+        t0 = _time.perf_counter()
         n = self.measure.write(req)
+        self._observe_write("measure", t0)
         return {"written": n}
+
+    def _on_measure_write_columns(self, env: dict) -> dict:
+        """Columnar write envelope on the data-node role: the vectorized
+        ingest wire shape the standalone server already speaks, decoded
+        with the shared serde codec.  The shard-owning worker processes
+        (cluster/workers.py) receive their per-shard ingest slices on
+        this topic."""
+        import time as _time
+
+        self.disk.check_write()
+        t0 = _time.perf_counter()
+        n = self.measure.write_columns(**serde.write_columns_env_decode(env))
+        self._observe_write("measure", t0)
+        return {"written": n}
+
+    def _on_topn(self, env: dict) -> dict:
+        """TopN query over this node's pre-aggregated windows
+        (TopNService analog, node-local half): ranked items carry their
+        entities so a scatter caller can merge — entities are
+        shard-routed, so cross-node entity sets are disjoint and the
+        merge is concat + re-rank."""
+        from banyandb_tpu.api.model import TimeRange
+        from banyandb_tpu.models import topn as topn_mod
+
+        rules = {r.name for r in self.registry.list_topn(env["group"])}
+        if env["name"] not in rules:
+            raise KeyError(
+                f"topn rule {env['name']} not found in group {env['group']}"
+            )
+        ranked = topn_mod.query_topn(
+            self.measure,
+            env["group"],
+            env["name"],
+            TimeRange(*env["time_range"]),
+            n=env.get("n", 10),
+            direction=env.get("direction", "desc"),
+            agg=env.get("agg", "sum"),
+            # JSON round-trip turns the (tag, op, value) triples into
+            # lists; query_topn wants tuples
+            conditions=tuple(
+                (c[0], c[1], c[2]) for c in env.get("conditions", ())
+            ),
+        )
+        return {
+            "items": [
+                {"entity": list(ent), "value": val} for ent, val in ranked
+            ]
+        }
 
     # -- query plane --------------------------------------------------------
     @staticmethod
@@ -289,12 +382,17 @@ class DataNode:
                 "query deadline exhausted before node scan"
             )
 
-    def _node_tracer(self, req):
-        """Per-node tracer when the request is traced: this node runs its
-        own span tree and ships the subtree back in the reply for the
-        liaison's cluster-wide merge (pkg/query/tracer propagation,
-        dquery/measure.go:104 analog)."""
-        if not req.trace:
+    def _node_tracer(self, req, env: "dict | None" = None):
+        """Per-node tracer when the request is traced OR the scatter
+        caller runs its own tracer (``want_subtree`` on the envelope —
+        the liaison stamps it whenever it holds a real tracer, e.g. the
+        always-on serving-surface one): this node runs its own span tree
+        and ships the subtree back in the reply for the caller's
+        cluster-wide merge (pkg/query/tracer propagation,
+        dquery/measure.go:104 analog).  The subtree rides the BUS reply,
+        never the user-facing result, so untraced responses are
+        byte-identical either way."""
+        if not req.trace and not (env or {}).get("want_subtree"):
             return None
         from banyandb_tpu.obs.tracer import Tracer
 
@@ -305,7 +403,7 @@ class DataNode:
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         hist_range = tuple(env["hist_range"]) if env.get("hist_range") else None
-        tracer = self._node_tracer(req)
+        tracer = self._node_tracer(req, env)
         partials = self.measure.query_partials(
             req, shard_ids=shard_ids, hist_range=hist_range, tracer=tracer
         )
@@ -318,7 +416,7 @@ class DataNode:
         self._check_deadline(env)
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
-        tracer = self._node_tracer(req)
+        tracer = self._node_tracer(req, env)
         res = self.measure.query(req, shard_ids=shard_ids, tracer=tracer)
         out = {"data_points": res.data_points}
         if tracer is not None:

@@ -243,12 +243,15 @@ class FaultPlane:
             return None
         return _PlaneSyncInjector(self)
 
-    def kills_for_cycle(self, cycle: int) -> list[str]:
-        """Node names the schedule kills at this chaos cycle (site=kill,
-        kind=<node>, at=<cycle>).  Consumed by the harness; the plane
-        never kills anything itself."""
+    def kills_for_cycle(self, cycle: int, site: str = "kill") -> list[str]:
+        """Node names the schedule kills at this chaos cycle
+        (site=<site>, kind=<node>, at=<cycle>).  Consumed by the
+        harness; the plane never kills anything itself.  ``site``
+        selects the kill plane: ``kill`` = cluster data nodes,
+        ``worker`` = shard-owning worker processes of the multi-process
+        data plane (cluster/workers.py)."""
         out = []
-        for rule in self._by_site.get("kill", ()):
+        for rule in self._by_site.get(site, ()):
             if int(rule.params.get("at", 0)) == cycle:
                 out.append(rule.kind)
         return out

@@ -326,6 +326,15 @@ class Liaison:
                 return False
             _time.sleep(0.05)
 
+    def forget_streamagg_sent(self, node_name: str) -> None:
+        """Drop the sent-state for one node so the next probe() re-sends
+        every remembered streamagg registration.  Callers that restart a
+        node IN PLACE (the worker pool's crash-restart path) use this:
+        the fresh process re-registers from its persisted registry, but
+        registrations broadcast while it was down exist only here."""
+        with self._streamagg_lock:
+            self._streamagg_sent.pop(node_name, None)
+
     # -- streaming aggregation control plane (query/streamagg.py) -----------
     def register_streamagg(
         self,
@@ -660,13 +669,17 @@ class Liaison:
         return out, uncovered
 
     def _scatter_one(
-        self, topic, node, shards, env_of, guard, t, on_reply, retry
+        self, topic, node, shards, env_of, guard, t, on_reply, retry,
+        timeout_cap_s: float | None = None,
     ) -> None:
         """One scatter leg under the query guard: deadline-clamped
         timeout, deadline_ms stamped on the envelope, structured failure
         handling.  `retry` (list or None) collects hard-failed legs for
         the caller's one failover round; shed/deadline rejections mark
-        the node unavailable without eviction (it is healthy)."""
+        the node unavailable without eviction (it is healthy).
+        `timeout_cap_s` further clamps the RPC timeout — the last-chance
+        same-node retry uses it so a genuinely dead node costs seconds,
+        not the whole remaining budget."""
         if guard.expired():
             guard.mark(node.name, "deadline")
             return
@@ -678,11 +691,21 @@ class Liaison:
             deadline_ms=guard.deadline_ms(),
             deadline_unix_ms=time.time() * 1000.0 + guard.deadline_ms(),
         )
+        if t is not NOOP_TRACER:
+            # the caller holds a REAL tracer (serving surfaces always
+            # do): ask the node for its span subtree even when the user
+            # request is untraced — the graft feeds the slow-query
+            # recorder and serve-path classification, and rides only
+            # the bus reply, never the user-facing result
+            env["want_subtree"] = True
         with t.span(f"scatter:{node.name}") as sp:
             sp.tag("shards", list(shards))
+            timeout = guard.rpc_timeout()
+            if timeout_cap_s is not None:
+                timeout = min(timeout, timeout_cap_s)
             try:
                 r = self.transport.call(
-                    node.addr, topic, env, timeout=guard.rpc_timeout()
+                    node.addr, topic, env, timeout=timeout
                 )
             except TransportError as e:
                 sp.error(str(e))
@@ -727,7 +750,19 @@ class Liaison:
         for node, shards in retry:
             placed, uncovered = self._reassign(shards, exclude=failed)
             if uncovered:
-                guard.mark(node.name, "unreachable")
+                # no surviving replica: before degrading, the ORIGINAL
+                # node gets the one failover attempt instead — a
+                # transient transport failure (the wedged-channel dial
+                # this kernel occasionally hands out; call() already
+                # evicted it) heals on a fresh dial, and a query leg is
+                # idempotent.  A genuinely dead node fails the terminal
+                # retry and the leg degrades exactly as before; the
+                # capped timeout keeps that cost to seconds even when
+                # the fresh dial itself wedges in connect.
+                self._scatter_one(
+                    topic, node, uncovered, env_of, guard, t, on_reply,
+                    None, timeout_cap_s=3.0,
+                )
             for alt, alt_shards in placed.items():
                 # second failure is terminal for the leg (retry=None)
                 self._scatter_one(

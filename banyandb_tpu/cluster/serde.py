@@ -161,6 +161,98 @@ def write_request_from_json(d: dict) -> WriteRequest:
     return WriteRequest(d["group"], d["name"], tuple(pts))
 
 
+# -- columnar measure write envelope (Topic.MEASURE_WRITE_COLUMNS) ----------
+# One codec for every consumer of the vectorized ingest wire shape: the
+# standalone server, the data-node role, and the shard-owning worker
+# processes (cluster/workers.py) all decode the same envelope; the
+# worker pool re-encodes per-shard slices of it with the same layout.
+
+
+def write_columns_env_decode(env: dict) -> dict:
+    """MEASURE_WRITE_COLUMNS envelope -> write_columns kwargs.
+
+    ts and numeric fields ride as base64-packed little-endian arrays,
+    tag columns as JSON string lists or {"dict": [...], "codes": b64-i32}
+    dictionary pairs (which stay dictionary-encoded end-to-end)."""
+    from banyandb_tpu.models.measure import DictColumn
+
+    ts = np.frombuffer(_unb64(env["ts"]), dtype="<i8").copy()
+    versions = (
+        np.frombuffer(_unb64(env["versions"]), dtype="<i8").copy()
+        if env.get("versions")
+        else None
+    )
+    tags: dict = {}
+    for k, v in env.get("tags", {}).items():
+        if isinstance(v, dict):
+            codes = np.frombuffer(_unb64(v["codes"]), dtype="<i4")
+            tags[k] = DictColumn(list(v["dict"]), codes)
+        else:
+            tags[k] = v
+    fields = {
+        k: np.frombuffer(_unb64(v), dtype="<f8").copy()
+        for k, v in env.get("fields", {}).items()
+    }
+    return {
+        "group": env["group"],
+        "name": env["name"],
+        "ts_millis": ts,
+        "tags": tags,
+        "fields": fields,
+        "versions": versions,
+    }
+
+
+def write_columns_env_slice(cols: dict, idx: np.ndarray) -> dict:
+    """Re-encode a row subset of decoded write-columns kwargs back into
+    the wire envelope (the worker pool's per-shard ingest split).
+    Dictionary tags keep their dict and slice only the codes."""
+    from banyandb_tpu.models.measure import DictColumn
+
+    env: dict = {
+        "group": cols["group"],
+        "name": cols["name"],
+        "ts": _b64(
+            np.ascontiguousarray(
+                cols["ts_millis"][idx], dtype="<i8"
+            ).tobytes()
+        ),
+    }
+    if cols.get("versions") is not None:
+        env["versions"] = _b64(
+            np.ascontiguousarray(
+                cols["versions"][idx], dtype="<i8"
+            ).tobytes()
+        )
+    tags: dict = {}
+    for k, v in cols.get("tags", {}).items():
+        if isinstance(v, DictColumn):
+            tags[k] = {
+                "dict": list(v.values),
+                "codes": _b64(
+                    np.ascontiguousarray(
+                        np.asarray(v.codes)[idx], dtype="<i4"
+                    ).tobytes()
+                ),
+            }
+        elif v is not None:
+            tags[k] = [v[int(i)] for i in idx]
+    if tags:
+        env["tags"] = tags
+    fields = {
+        k: _b64(
+            np.ascontiguousarray(
+                np.asarray(v)[idx], dtype="<f8"
+            ).tobytes()
+        )
+        for k, v in cols.get("fields", {}).items()
+        if v is not None
+    }
+    if fields:
+        env["fields"] = fields
+    return env
+
+
 # -- stream elements / trace spans (one wire format, used by the
 #    standalone server AND the data-node role) ------------------------------
 

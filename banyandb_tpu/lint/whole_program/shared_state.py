@@ -109,6 +109,18 @@ _MUTATORS = {
 _SERVICER_SUFFIXES = ("Services", "Servicer")
 _HTTP_HANDLER_BASES = ("BaseHTTPRequestHandler",)
 
+# Worker-entry functions: each runs as the MAIN thread of a spawned
+# subprocess (``python -m banyandb_tpu.cluster.workers``).  The static
+# Thread/Timer/subscribe discovery cannot see across an exec boundary,
+# so process entries are declared here — everything one reaches is a
+# concurrent root population exactly like a Thread target (the worker's
+# serve loop then spawns its own writer/executor threads, which the
+# ordinary registration discovery picks up inside the entry's closure).
+_PROCESS_ENTRY_QUALS = (
+    "banyandb_tpu.cluster.workers:worker_main",
+    "banyandb_tpu.cluster.workers:_WorkerServer.serve",
+)
+
 
 @dataclass(frozen=True)
 class Root:
@@ -141,6 +153,10 @@ def discover_roots(program: Program) -> list[Root]:
             short = r.target.split(":", 1)[1]
             label = f'{r.kind} "{r.name}"' if r.name else f"{r.kind} {short}"
             put(r.target, r.kind, label)
+    for qual in _PROCESS_ENTRY_QUALS:
+        if qual in program.functions:
+            short = qual.split(":", 1)[1]
+            put(qual, "process", f"process {short}")
     for mod, cls_name, methods in program.iter_classes():
         if cls_name.endswith(_SERVICER_SUFFIXES):
             for meth, qual in sorted(methods.items()):

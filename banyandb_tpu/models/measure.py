@@ -124,6 +124,68 @@ class DictColumn:
         return np.asarray(self.values, dtype=object)[self.codes].tolist()
 
 
+def series_ids_for_columns(
+    name: str, ent_cols: list, n: int
+) -> "tuple[np.ndarray, np.ndarray]":
+    """Vectorized series-id assignment for columnar ingest: hash each
+    DISTINCT entity tuple once.  ``ent_cols`` holds one column per
+    entity tag, each a ``DictColumn`` of canonical bytes or a per-row
+    bytes list.  -> (per-row series ids [n], unique-inverse index [n]).
+
+    Shared by ``MeasureEngine.write_columns`` and the worker pool's
+    shard router (cluster/workers.py) so in-process and multi-process
+    ingest route every row to the same shard."""
+    radix_prod = 1
+    for c in ent_cols:
+        if isinstance(c, DictColumn):
+            radix_prod *= max(len(c.values), 1)
+    if all(isinstance(c, DictColumn) for c in ent_cols) and (
+        radix_prod < 2**62  # int64 mixed-radix key must not wrap
+    ):
+        # all-encoded fast lane: distinct entities are distinct
+        # mixed-radix code keys — int unique, zero per-row Python
+        key = np.zeros(n, dtype=np.int64)
+        for c in ent_cols:
+            key = key * len(c.values) + np.asarray(c.codes, dtype=np.int64)
+        uk, inv = np.unique(key, return_inverse=True)
+        radices = [len(c.values) for c in ent_cols]
+        digits: list[np.ndarray] = []
+        rem = uk
+        for r in reversed(radices):
+            digits.append(rem % r)
+            rem = rem // r
+        digits.reverse()  # per-entity-tag unique codes aligned with uk
+        uniq_sids = np.fromiter(
+            (
+                hashing.series_id(
+                    [name.encode()]
+                    + [
+                        ent_cols[j].values[int(digits[j][i])]
+                        for j in range(len(ent_cols))
+                    ]
+                )
+                for i in range(len(uk))
+            ),
+            dtype=np.int64,
+            count=len(uk),
+        )
+    else:
+        rowed = [
+            c.row_values() if isinstance(c, DictColumn) else c
+            for c in ent_cols
+        ]
+        ent_rows = np.empty(n, dtype=object)
+        for i in range(n):
+            ent_rows[i] = tuple(c[i] for c in rowed)
+        uniq, inv = np.unique(ent_rows, return_inverse=True)
+        uniq_sids = np.fromiter(
+            (hashing.series_id([name.encode(), *e]) for e in uniq),
+            dtype=np.int64,
+            count=len(uniq),
+        )
+    return uniq_sids[inv], inv
+
+
 class MeasureEngine:
     """All measure resources of all groups, one TSDB per group."""
 
@@ -462,55 +524,7 @@ class MeasureEngine:
 
         # --- series ids: hash each DISTINCT entity tuple once -------------
         ent_cols = [tag_bytes[t] for t in m.entity.tag_names]
-        radix_prod = 1
-        for c in ent_cols:
-            if isinstance(c, DictColumn):
-                radix_prod *= max(len(c.values), 1)
-        if all(isinstance(c, DictColumn) for c in ent_cols) and (
-            radix_prod < 2**62  # int64 mixed-radix key must not wrap
-        ):
-            # all-encoded fast lane: distinct entities are distinct
-            # mixed-radix code keys — int unique, zero per-row Python
-            key = np.zeros(n, dtype=np.int64)
-            for c in ent_cols:
-                key = key * len(c.values) + np.asarray(c.codes, dtype=np.int64)
-            uk, inv = np.unique(key, return_inverse=True)
-            radices = [len(c.values) for c in ent_cols]
-            digits: list[np.ndarray] = []
-            rem = uk
-            for r in reversed(radices):
-                digits.append(rem % r)
-                rem = rem // r
-            digits.reverse()  # per-entity-tag unique codes aligned with uk
-            uniq_sids = np.fromiter(
-                (
-                    hashing.series_id(
-                        [name.encode()]
-                        + [
-                            ent_cols[j].values[int(digits[j][i])]
-                            for j in range(len(ent_cols))
-                        ]
-                    )
-                    for i in range(len(uk))
-                ),
-                dtype=np.int64,
-                count=len(uk),
-            )
-        else:
-            rowed = [
-                c.row_values() if isinstance(c, DictColumn) else c
-                for c in ent_cols
-            ]
-            ent_rows = np.empty(n, dtype=object)
-            for i in range(n):
-                ent_rows[i] = tuple(c[i] for c in rowed)
-            uniq, inv = np.unique(ent_rows, return_inverse=True)
-            uniq_sids = np.fromiter(
-                (hashing.series_id([name.encode(), *e]) for e in uniq),
-                dtype=np.int64,
-                count=len(uniq),
-            )
-        sids = uniq_sids[inv]
+        sids, inv = series_ids_for_columns(name, ent_cols, n)
         shards = sids % shard_num
 
         seg_cache: dict[int, object] = {}

@@ -262,7 +262,12 @@ class PropertyEngine:
         return self._revision
 
     def persist(self) -> None:
-        for idx in self._shards.values():
+        # snapshot under the lock: a concurrent first-touch (lifecycle
+        # property sweep, schema-plane write) growing _shards mid-walk
+        # is a RuntimeError otherwise
+        with self._lock:
+            shards = list(self._shards.values())
+        for idx in shards:
             idx.persist()
 
     def persist_group(self, group: str) -> None:

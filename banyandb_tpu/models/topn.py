@@ -518,8 +518,13 @@ def query_topn(
         cur = best.get(disp)
         if cur is None or (v > cur if direction == "desc" else v < cur):
             best[disp] = v
+    # entity tie-break: equal values must rank identically here and in
+    # the worker pool's concat re-rank (cluster/workers.py), where ties
+    # would otherwise follow worker index instead of engine group order
     pairs = sorted(
-        best.items(), key=lambda kv: kv[1], reverse=(direction == "desc")
+        best.items(),
+        key=lambda kv: (kv[1], kv[0]),
+        reverse=(direction == "desc"),
     )
     if agg == "count":  # one distinct item per entity reaches the agg
         return [(ent, 1.0) for ent, _ in pairs[:n]]

@@ -92,13 +92,31 @@ def stage_breakdown(
 
     -> {stage: {"count": n, "p50_ms": ..., "p99_ms": ...}} — the
     bench-artifact section ROADMAP item 1 wants landing with every TPU
-    run (gather vs device-execute vs merge, measured not inferred)."""
-    out: dict[str, dict] = {}
+    run (gather vs device-execute vs merge, measured not inferred).
+
+    Series sharing a stage but differing in OTHER labels (the
+    multi-process data plane stamps ``worker="wNNN"`` per worker
+    exposition) merge before inversion: buckets share the exponential
+    bound grid, so summing cumulative counts per bound is exact."""
+    merged: dict[str, dict] = {}
     for key, entry in histogram_series(text, metric).items():
-        labels = dict(key)
-        stage = labels.get("stage")
+        stage = dict(key).get("stage")
         if stage is None or entry["count"] == 0:
             continue
+        slot = merged.setdefault(
+            stage, {"buckets": {}, "count": 0, "sum": 0.0}
+        )
+        for bound, cum in entry["buckets"]:
+            slot["buckets"][bound] = slot["buckets"].get(bound, 0.0) + cum
+        slot["count"] += entry["count"]
+        slot["sum"] += entry["sum"]
+    out: dict[str, dict] = {}
+    for stage, slot in merged.items():
+        entry = {
+            "buckets": sorted(slot["buckets"].items()),
+            "count": slot["count"],
+            "sum": slot["sum"],
+        }
         rec: dict = {"count": entry["count"]}
         for q in quantiles:
             rec[f"p{int(q * 100)}_ms"] = round(quantile(entry, q), 3)
@@ -118,7 +136,7 @@ def stage_breakdown_delta(
     a run — e.g. each leg of the bench's fused-vs-staged A/B — gets its
     own quantiles instead of the process-lifetime aggregate."""
     prior = histogram_series(before, metric)
-    out: dict[str, dict] = {}
+    merged: dict[str, dict] = {}
     for key, entry in histogram_series(after, metric).items():
         stage = dict(key).get("stage")
         if stage is None:
@@ -137,8 +155,23 @@ def stage_breakdown_delta(
             total = entry["sum"] - base["sum"]
         if count <= 0:
             continue
-        window = {"buckets": buckets, "count": count, "sum": total}
-        rec: dict = {"count": count}
+        # merge across non-stage labels (per-worker expositions), same
+        # shared-bound-grid argument as stage_breakdown
+        slot = merged.setdefault(
+            stage, {"buckets": {}, "count": 0, "sum": 0.0}
+        )
+        for bound, cum in buckets:
+            slot["buckets"][bound] = slot["buckets"].get(bound, 0.0) + cum
+        slot["count"] += count
+        slot["sum"] += total
+    out: dict[str, dict] = {}
+    for stage, slot in merged.items():
+        window = {
+            "buckets": sorted(slot["buckets"].items()),
+            "count": slot["count"],
+            "sum": slot["sum"],
+        }
+        rec: dict = {"count": slot["count"]}
         for q in quantiles:
             rec[f"p{int(q * 100)}_ms"] = round(quantile(window, q), 3)
         out[stage] = rec

@@ -238,7 +238,17 @@ class StreamAggRegistry:
         self._seq = 0
         self._store = Path(engine.root) / "streamagg-registry.json"
         self._meter = obs_metrics.global_meter()
-        self._load()
+        # BYDB_STREAMAGG_AUTOLOAD=0 defers the persisted-registry reload
+        # to an explicit load_persisted() call.  Shard-owning worker
+        # processes (cluster/workers.py) boot with it off: the parent
+        # replays its write journal into the fresh memtable FIRST, then
+        # triggers the load — so the registration backfill's
+        # (series, ts, version) dedup sees replayed rows and parts in
+        # ONE snapshot instead of double-folding rows that are in both.
+        from banyandb_tpu.utils.envflag import env_flag
+
+        if env_flag("BYDB_STREAMAGG_AUTOLOAD", True):
+            self._load()
 
     # -- registration / persistence -----------------------------------------
     def active(self, group: str, measure: str) -> bool:
@@ -404,7 +414,14 @@ class StreamAggRegistry:
         except OSError:
             log.exception("streamagg registry persist failed (state kept)")
 
-    def _load(self) -> None:
+    def load_persisted(self) -> int:
+        """Explicit persisted-registry reload for deferred-autoload
+        processes (the worker-restart sequence: replay, THEN load).
+        Idempotent — register() returns existing state for known
+        signatures.  -> number of persisted records processed."""
+        return self._load()
+
+    def _load(self) -> int:
         """Reload persisted registrations (engine restart): each one
         re-registers with a fresh backfill, rebuilding windows
         deterministically from whatever parts survived on disk — the
@@ -412,11 +429,12 @@ class StreamAggRegistry:
         in flight, and install-digest dedup keeps re-ships single."""
         try:
             if not self._store.exists():
-                return
+                return 0
             doc = fs.read_json(self._store)
         except (OSError, ValueError):
-            return
-        for rec in doc.get("signatures", []):
+            return 0
+        recs = doc.get("signatures", [])
+        for rec in recs:
             try:
                 self.register(
                     rec["group"], rec["measure"],
@@ -427,6 +445,7 @@ class StreamAggRegistry:
             except Exception:  # noqa: BLE001 — a stale entry (dropped
                 # measure, renamed tag) must not take the engine down
                 log.exception("streamagg: stale registration %r skipped", rec)
+        return len(recs)
 
     # -- backfill ------------------------------------------------------------
     def _backfill_snapshot(self, spec: SigSpec) -> tuple[list, set]:

@@ -77,6 +77,28 @@ def leaked_threads(
         time.sleep(0.05)
 
 
+def process_snapshot() -> frozenset:
+    """Baseline snapshot of registered child processes (the worker
+    pool's subprocesses report spawn/reap through utils.procreg)."""
+    from banyandb_tpu.utils import procreg
+
+    return procreg.snapshot()
+
+
+def leaked_processes(before: frozenset, grace_s: float = 2.0) -> list:
+    """(pid, label) for child processes spawned during the scope that
+    are still registered — a worker the owner neither stopped nor
+    reaped.  The grace window covers a stop() racing the check."""
+    from banyandb_tpu.utils import procreg
+
+    deadline = time.monotonic() + grace_s
+    while True:
+        leaked = procreg.live(exclude=before)
+        if not leaked or time.monotonic() >= deadline:
+            return leaked
+        time.sleep(0.05)
+
+
 def open_fds() -> set:
     """Open descriptor numbers (Linux /proc; empty set elsewhere)."""
     try:
@@ -125,9 +147,10 @@ def leaked_fds(
 class LeakReport:
     threads: list
     fds: list
+    procs: list = None  # type: ignore[assignment]
 
     def clean(self) -> bool:
-        return not self.threads and not self.fds
+        return not self.threads and not self.fds and not self.procs
 
     def render(self) -> str:
         lines = []
@@ -135,6 +158,8 @@ class LeakReport:
             lines.append(f"leaked thread: {t.name} (ident={t.ident})")
         for fd, target in self.fds:
             lines.append(f"leaked fd: {fd} -> {target}")
+        for pid, label in self.procs or ():
+            lines.append(f"leaked process: {label} (pid={pid})")
         return "\n".join(lines) or "clean"
 
 
@@ -151,10 +176,12 @@ class LeakTracker:
         self.track_fds = track_fds
         self._threads: set = set()
         self._fds: set = set()
+        self._procs: frozenset = frozenset()
 
     def snapshot(self) -> "LeakTracker":
         self._threads = thread_snapshot()
         self._fds = open_fds() if self.track_fds else set()
+        self._procs = process_snapshot()
         return self
 
     def check(self, grace_s: float = 2.0) -> LeakReport:
@@ -166,4 +193,5 @@ class LeakTracker:
             if self.track_fds
             else []
         )
-        return LeakReport(threads=threads, fds=fds)
+        procs = leaked_processes(self._procs, grace_s=min(grace_s, 2.0))
+        return LeakReport(threads=threads, fds=fds, procs=procs)

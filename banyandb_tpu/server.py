@@ -133,10 +133,11 @@ class StandaloneServer:
         auth_file: str | None = None,
         slow_query_ms: float | None = None,
         serving_cache_cap: int | None = None,
+        workers: int | None = None,
     ):
         from banyandb_tpu.obs import SlowQueryRecorder
         from banyandb_tpu.obs.metrics import global_meter
-        from banyandb_tpu.utils.envflag import env_float
+        from banyandb_tpu.utils.envflag import env_float, env_int
 
         self.root = Path(root)
         self.registry = SchemaRegistry(self.root)
@@ -144,11 +145,39 @@ class StandaloneServer:
         self.stream = StreamEngine(self.registry, self.root / "data")
         self.trace = TraceEngine(self.registry, self.root / "data")
         self.property = PropertyEngine(self.registry, self.root / "data")
+        # Multi-process data plane (docs/performance.md): BYDB_WORKERS=N
+        # maps shard ownership to N worker subprocesses — measure/stream/
+        # trace writes partition by shard hash to the owning worker,
+        # queries scatter-gather over the intra-node liaison machinery,
+        # and result JSON stays byte-identical to the N=0 layout.  The
+        # parent engines above then hold no data-plane rows; they keep
+        # serving the property plane and schema state.
+        self.pool = None
+        n_workers = (
+            workers if workers is not None else env_int("BYDB_WORKERS", 0)
+        )
+        if n_workers > 0:
+            from banyandb_tpu.cluster.workers import (
+                PoolMeasureAdapter,
+                PoolStreamAdapter,
+                PoolTraceAdapter,
+                WorkerPool,
+            )
+
+            self.pool = WorkerPool(self.root, self.registry, n_workers)
+            self._pool_measure = PoolMeasureAdapter(self.pool)
+            self._pool_stream = PoolStreamAdapter(self.pool)
+            self._pool_trace = PoolTraceAdapter(self.pool)
         # the process-global registry: engine/executor/fabric instruments
         # (query stages, rpc, lifecycle loops) land in the same exposition
         # as the server's own counters
         self.meter = global_meter()
-        self.self_metrics = SelfMeasureSink(self.meter, self.measure)
+        # self-measures ride the data plane: in worker mode they route
+        # through the pool like any other measure write
+        self.self_metrics = SelfMeasureSink(
+            self.meter,
+            self._pool_measure if self.pool is not None else self.measure,
+        )
         self.protector = MemoryProtector()
         from banyandb_tpu.admin.diskmonitor import DiskMonitor
 
@@ -183,15 +212,31 @@ class StandaloneServer:
         # None disables a tier
         self.wire = None
         self.http = None
+        # wire/http surfaces speak to the data plane through whatever
+        # shape is live: engines in-process, or the liaison adapters
+        # over the worker pool (the cluster_server trio — the pool's
+        # embedded Liaison has the same surface as the cluster one)
+        if self.pool is not None:
+            # every model rides its pool adapter, not a bare liaison
+            # one: wire writes must journal-then-forward (the crash
+            # contract covers EVERY ack, not just bus writes) and wire
+            # TopN needs the pool's scatter plane (topn_scatter)
+            _wire_measure = self._pool_measure
+            _wire_stream = self._pool_stream
+            _wire_trace = self._pool_trace
+        else:
+            _wire_measure, _wire_stream, _wire_trace = (
+                self.measure, self.stream, self.trace,
+            )
         if wire_port is not None:
             from banyandb_tpu.api.grpc_server import WireServer, WireServices
 
             self._wire_services = WireServices(
                 self.registry,
-                self.measure,
-                self.stream,
+                _wire_measure,
+                _wire_stream,
                 property_engine=self.property,
-                trace_engine=self.trace,
+                trace_engine=_wire_trace,
                 node_info={
                     "name": "standalone",
                     "grpc_address": f"127.0.0.1:{wire_port}",
@@ -208,10 +253,10 @@ class StandaloneServer:
 
             svcs = getattr(self, "_wire_services", None) or WireServices(
                 self.registry,
-                self.measure,
-                self.stream,
+                _wire_measure,
+                _wire_stream,
                 property_engine=self.property,
-                trace_engine=self.trace,
+                trace_engine=_wire_trace,
             )
             # one users file governs both surfaces: an auth_file that only
             # locked gRPC while HTTP served the same CRUD would be a trap
@@ -293,9 +338,15 @@ class StandaloneServer:
         self.protector.acquire(size)
         t0 = time.perf_counter()
         try:
-            # batch decode -> columns -> bulk path (identical semantics to
-            # the row path incl. TopN observation; VERDICT r4 missing #3)
-            n = self.measure.write_points_bulk(req)
+            if self.pool is not None:
+                # shard-partitioned forward to the owning workers
+                # (journaled ack — docs/performance.md)
+                n = self.pool.write_measure(req)
+            else:
+                # batch decode -> columns -> bulk path (identical
+                # semantics to the row path incl. TopN observation;
+                # VERDICT r4 missing #3)
+                n = self.measure.write_points_bulk(req)
         finally:
             self.protector.release(size)
         ms = (time.perf_counter() - t0) * 1000
@@ -311,46 +362,28 @@ class StandaloneServer:
         dictionary pairs.  One decode pass feeds write_columns — the
         envelope exists because per-point JSON dicts were the measured
         hot loop of the wire ingest path (VERDICT r4 weak #3)."""
-        import base64
-
-        import numpy as np
-
         group, name = env["group"], env["name"]
-        ts = np.frombuffer(base64.b64decode(env["ts"]), dtype="<i8").copy()
-        n = ts.size
+        # row count from base64 length arithmetic — the ts column is
+        # decoded exactly once, inside the codec (or the pool's router)
+        ts_b64 = env["ts"]
+        pad = 2 if ts_b64.endswith("==") else (1 if ts_b64.endswith("=") else 0)
+        n = ((len(ts_b64) // 4) * 3 - pad) // 8
         size = n * _POINT_BYTES
         self.disk.check_write()
         self.protector.acquire(size)
         t0 = time.perf_counter()
         try:
-            versions = (
-                np.frombuffer(
-                    base64.b64decode(env["versions"]), dtype="<i8"
-                ).copy()
-                if env.get("versions")
-                else None
-            )
-            from banyandb_tpu.models.measure import DictColumn
-
-            tags = {}
-            for k, v in env.get("tags", {}).items():
-                if isinstance(v, dict):
-                    codes = np.frombuffer(
-                        base64.b64decode(v["codes"]), dtype="<i4"
-                    )
-                    # stays dictionary-encoded end-to-end (engine +
-                    # memtable consume the codes directly)
-                    tags[k] = DictColumn(list(v["dict"]), codes)
-                else:
-                    tags[k] = v
-            fields = {
-                k: np.frombuffer(base64.b64decode(v), dtype="<f8").copy()
-                for k, v in env.get("fields", {}).items()
-            }
-            written = self.measure.write_columns(
-                group, name,
-                ts_millis=ts, tags=tags, fields=fields, versions=versions,
-            )
+            if self.pool is not None:
+                # vectorized shard routing + per-worker envelope slices
+                # (cluster/workers.py); the codes stay dictionary-
+                # encoded end-to-end on both paths
+                written = self.pool.write_measure_columns(env)
+            else:
+                # shared wire codec (cluster/serde.py): engine +
+                # memtable consume the decoded codes directly
+                written = self.measure.write_columns(
+                    **serde.write_columns_env_decode(env)
+                )
         finally:
             self.protector.release(size)
         ms = (time.perf_counter() - t0) * 1000
@@ -370,7 +403,10 @@ class StandaloneServer:
         with tracer.span("wire_decode"):
             req = serde.query_request_from_json(env["request"])
         t0 = time.perf_counter()
-        res = self.measure.query(req, tracer=tracer)
+        if self.pool is not None:
+            res = self.pool.query_measure(req, tracer=tracer)
+        else:
+            res = self.measure.query(req, tracer=tracer)
         ms = (time.perf_counter() - t0) * 1000
         tree = tracer.finish()
         self.meter.observe("measure_query_ms", ms)
@@ -447,13 +483,30 @@ class StandaloneServer:
         pr = default_registry().stats()
         for k in ("recorded", "compiled", "errors"):
             self.meter.gauge_set(f"precompile_{k}", float(pr[k]))
-        return {"prometheus": self.meter.prometheus_text()}
+        if self.pool is not None:
+            # pool gauges set BEFORE the render so the scrape that
+            # matters most — every worker down, empty worker_text —
+            # still carries workers_alive/workers_total
+            self.meter.gauge_set("workers_alive", float(len(self.pool.liaison.alive)))
+            self.meter.gauge_set("workers_total", float(self.pool.n))
+        text = self.meter.prometheus_text()
+        if self.pool is not None:
+            # graft worker expositions with per-worker labels — the
+            # scrapers (obs/prom.py) aggregate across the worker label
+            worker_text = self.pool.metrics_text()
+            if worker_text:
+                text = text + "\n" + worker_text
+        return {"prometheus": text}
 
     def _streamagg(self, env):
         """Streaming-aggregation control surface (query/streamagg.py):
         register materialized dashboard signatures / read window
         state."""
         op = env.get("op", "stats")
+        if self.pool is not None:
+            # windows are worker-local per shard: registrations
+            # broadcast (with rejoin catch-up), stats fan out
+            return self.pool.streamagg(env)
         if op == "register":
             info = self.measure.streamagg.register(
                 env["group"],
@@ -478,6 +531,10 @@ class StandaloneServer:
             raise KeyError(
                 f"topn rule {env['name']} not found in group {env['group']}"
             )
+        if self.pool is not None:
+            # scatter the node-local ranking; entities are shard-routed
+            # so the concat re-rank is exact (cluster/workers.py)
+            return self.pool.topn(env)
         ranked = topn_mod.query_topn(
             self.measure,
             env["group"],
@@ -486,6 +543,11 @@ class StandaloneServer:
             n=env.get("n", 10),
             direction=env.get("direction", "desc"),
             agg=env.get("agg", "sum"),
+            # same envelope contract as DataNode._on_topn, so the
+            # pool/0-mode A/B stays symmetric when a caller filters
+            conditions=tuple(
+                (c[0], c[1], c[2]) for c in env.get("conditions", ())
+            ),
         )
         return {
             "items": [
@@ -504,9 +566,17 @@ class StandaloneServer:
     def _stream_write(self, env):
         self.disk.check_write()
         t0 = time.perf_counter()
-        n = self.stream.write(
-            env["group"], env["name"], serde.elements_from_json(env["elements"])
-        )
+        if self.pool is not None:
+            # elements already ride the liaison wire shape; the pool
+            # routes them by entity-hash shard to the owning workers
+            n = self.pool.write_stream(
+                env["group"], env["name"], env["elements"]
+            )
+        else:
+            n = self.stream.write(
+                env["group"], env["name"],
+                serde.elements_from_json(env["elements"]),
+            )
         self.meter.observe(
             "write_ms", (time.perf_counter() - t0) * 1000, {"model": "stream"}
         )
@@ -518,7 +588,10 @@ class StandaloneServer:
         req = serde.query_request_from_json(env["request"])
         tracer = Tracer("standalone:stream")
         t0 = time.perf_counter()
-        res = self.stream.query(req, tracer=tracer)
+        if self.pool is not None:
+            res = self.pool.query_stream(req, tracer=tracer)
+        else:
+            res = self.stream.query(req, tracer=tracer)
         ms = (time.perf_counter() - t0) * 1000
         tree = tracer.finish()
         self._observe_query(
@@ -530,19 +603,30 @@ class StandaloneServer:
     def _trace_write(self, env):
         self.disk.check_write()
         t0 = time.perf_counter()
-        n = self.trace.write(
-            env["group"], env["name"], serde.spans_from_json(env["spans"]),
-            ordered_tags=tuple(env.get("ordered_tags", ())),
-        )
+        if self.pool is not None:
+            n = self.pool.write_trace(
+                env["group"], env["name"], env["spans"],
+                ordered_tags=tuple(env.get("ordered_tags", ())),
+            )
+        else:
+            n = self.trace.write(
+                env["group"], env["name"], serde.spans_from_json(env["spans"]),
+                ordered_tags=tuple(env.get("ordered_tags", ())),
+            )
         self.meter.observe(
             "write_ms", (time.perf_counter() - t0) * 1000, {"model": "trace"}
         )
         return {"written": n}
 
     def _trace_query(self, env):
-        spans = self.trace.query_by_trace_id(
-            env["group"], env["name"], env["trace_id"]
-        )
+        if self.pool is not None:
+            spans = self.pool.query_trace_by_id(
+                env["group"], env["name"], env["trace_id"]
+            )
+        else:
+            spans = self.trace.query_by_trace_id(
+                env["group"], env["name"], env["trace_id"]
+            )
         return {"spans": serde.spans_to_json(spans)}
 
     def _property_apply(self, env):
@@ -577,7 +661,10 @@ class StandaloneServer:
         tracer = Tracer(f"standalone:{catalog}")
         t0 = time.perf_counter()
         if catalog == "stream":
-            res = self.stream.query(req, tracer=tracer)
+            if self.pool is not None:
+                res = self.pool.query_stream(req, tracer=tracer)
+            else:
+                res = self.stream.query(req, tracer=tracer)
         elif catalog == "trace":
             with tracer.span("execute"):
                 res = self._ql_trace(req)
@@ -585,7 +672,10 @@ class StandaloneServer:
             with tracer.span("execute"):
                 res = self._ql_property(req)
         else:
-            res = self.measure.query(req, tracer=tracer)
+            if self.pool is not None:
+                res = self.pool.query_measure(req, tracer=tracer)
+            else:
+                res = self.measure.query(req, tracer=tracer)
         ms = (time.perf_counter() - t0) * 1000
         tree = tracer.finish()
         self._observe_query(
@@ -603,7 +693,8 @@ class StandaloneServer:
     def _ql_trace(self, req: QueryRequest) -> QueryResult:
         from banyandb_tpu.query import ql_exec
 
-        return ql_exec.execute_trace_ql(self.trace, req)
+        engine = self._pool_trace if self.pool is not None else self.trace
+        return ql_exec.execute_trace_ql(engine, req)
 
     def _ql_property(self, req: QueryRequest) -> QueryResult:
         from banyandb_tpu.query import ql_exec
@@ -665,9 +756,14 @@ class StandaloneServer:
     def _snapshot(self, env):
         # flush everything so on-disk state is complete, then report dirs
         flushed = []
-        flushed += self.measure.flush()
-        flushed += self.stream.flush()
-        flushed += self.trace.flush()
+        if self.pool is not None:
+            # worker flushes also trim the parent write journal to the
+            # flush watermark (cluster/workers.py)
+            flushed += self.pool.flush()
+        else:
+            flushed += self.measure.flush()
+            flushed += self.stream.flush()
+            flushed += self.trace.flush()
         self.property.persist()
         self.self_metrics.flush()  # self-measures land in _monitoring
         return {"flushed": flushed, "root": str(self.root)}
@@ -732,10 +828,17 @@ class StandaloneServer:
         self.self_metrics.stop()
         self.watchdog.stop()
         self.grpc.stop()
+        # ALL ingress surfaces close before the pool: a write landing
+        # after pool.stop() would ack into a journal that dies with the
+        # process (acked-write loss on graceful shutdown)
         if self.wire is not None:
             self.wire.stop()
         if self.http is not None:
             self.http.stop()
+        if self.pool is not None:
+            # graceful worker shutdown: lifecycle loops stop, engines
+            # close, processes reap (bdsan process hygiene)
+            self.pool.stop()
         if self.pprof is not None:
             self.pprof.stop()
         self.access_log.close()
@@ -778,6 +881,13 @@ def build_config():
         "serving-cache-cap", 0,
         "serving-cache ENTRY capacity on top of the byte budget "
         "(BYDB_SERVING_CACHE_CAP env; 0 = bytes-only)", int,
+    )
+    cfg.register(
+        "workers", -1,
+        "shard-owning worker processes for the data plane "
+        "(BYDB_WORKERS env): N>0 partitions shards over N subprocesses, "
+        "0 = single-process layout, -1 = auto (on by default on hosts "
+        "with >= 4 cores)", int,
     )
     # role topology (pkg/cmdsetup/root.go:89-91 standalone/data/liaison)
     cfg.register("role", "standalone", "standalone | data | liaison", str)
@@ -828,6 +938,10 @@ def main(argv=None) -> None:
             ("pprof-port", s.pprof_port != -1),
             ("discovery", bool(s.discovery)),
             ("replicas", s.replicas != 0),
+            # the multi-process data plane currently lives in the
+            # standalone role; cluster data nodes scale by adding node
+            # processes (ROADMAP item 3)
+            ("workers", s.workers not in (-1, 0)),
         ],
         "liaison": [
             ("pprof-port", s.pprof_port != -1),
@@ -835,6 +949,7 @@ def main(argv=None) -> None:
             # liaisons hold no serving cache; data nodes size theirs via
             # the BYDB_SERVING_CACHE_CAP env (per-process)
             ("serving-cache-cap", s.serving_cache_cap != 0),
+            ("workers", s.workers not in (-1, 0)),
         ],
         "standalone": [
             ("discovery", bool(s.discovery)),
@@ -894,6 +1009,14 @@ def main(argv=None) -> None:
     elif s.role != "standalone":
         raise SystemExit(f"unknown role {s.role!r}")
     else:
+        # on-by-default A/B flag (docs/performance.md "Multi-process
+        # data plane"): auto resolves to a worker fleet on hosts with
+        # enough cores to win from one; tiny hosts keep the
+        # single-process layout (a 2-core box convoys either way)
+        workers = s.workers
+        if workers < 0:
+            cpu = _os.cpu_count() or 1
+            workers = min(4, cpu // 2) if cpu >= 4 else 0
         srv = StandaloneServer(
             s.root,
             s.port,
@@ -902,11 +1025,17 @@ def main(argv=None) -> None:
             pprof_port=None if s.pprof_port < 0 else s.pprof_port,
             slow_query_ms=s.slow_query_ms,
             serving_cache_cap=s.serving_cache_cap or None,
+            workers=workers,
         )
 
         def announce():
             srv.start()
             print(f"banyandb-tpu standalone listening on {srv.addr}", flush=True)
+            if srv.pool is not None:
+                print(
+                    f"multi-process data plane: {srv.pool.n} shard workers",
+                    flush=True,
+                )
             if srv.wire is not None:
                 print(f"wire gRPC (banyandb.*.v1) on :{srv.wire.port}", flush=True)
             if srv.http is not None:

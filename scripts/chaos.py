@@ -357,6 +357,93 @@ def _smoke_fault_schedule(tmp, seed: int, stats: dict) -> None:
     assert p1.history == p2.history, "fault plane is not deterministic"
 
 
+def _smoke_worker_cycles(tmp, seed: int, stats: dict) -> None:
+    """Multi-process data plane crash contract (docs/performance.md):
+    SIGKILL shard-owning workers mid-ingest per a ``worker``-site kill
+    schedule, assert zero acked-write loss after journal replay and a
+    BOUNDED degraded window with explicit markers."""
+    from banyandb_tpu.cluster import faults
+    from banyandb_tpu.cluster.bus import Topic
+    from banyandb_tpu.server import TOPIC_QL, StandaloneServer
+
+    # the kill-schedule plane carries WHICH worker dies at WHICH cycle;
+    # the harness performs the kill (site=worker, PR-7 contract)
+    plane = faults.configure(f"seed={seed};worker=w000:at=1;worker=w001:at=2")
+    srv = StandaloneServer(tmp / "workers", port=0, workers=2)
+    srv.start()
+    acked = 0
+    degraded_windows = []
+    try:
+        _schema(srv.registry, group="cg", shard_num=4)
+
+        def write(n=60):
+            nonlocal acked
+            from banyandb_tpu.cluster import serde as _serde
+            from banyandb_tpu.api import WriteRequest
+
+            r = srv.bus.handle(
+                Topic.MEASURE_WRITE.value,
+                {
+                    "request": _serde.write_request_to_json(
+                        WriteRequest("cg", "m", _points(acked, n))
+                    )
+                },
+            )
+            acked += r["written"]
+
+        ql = (
+            "SELECT count(v) FROM MEASURE m IN cg "
+            f"TIME BETWEEN {T0} AND {T0 + 50_000_000}"
+        )
+
+        def probe() -> tuple[int, bool]:
+            res = srv.bus.handle(TOPIC_QL, {"ql": ql})["result"]
+            total = int(sum(res["values"].get("count", [])))
+            if res.get("degraded"):
+                assert res["unavailable_nodes"], "degraded without markers"
+            return total, bool(res.get("degraded"))
+
+        write(200)
+        srv.pool.flush()  # journal trim: replay covers only the window
+        write(100)
+        for cycle in (1, 2):
+            for victim in plane.kills_for_cycle(cycle, site="worker"):
+                widx = srv.pool._names.index(victim)
+                srv.pool.kill_worker(widx)
+                t_kill = time.monotonic()
+                write(80)  # acked DURING the dead window (journal spool)
+                saw_degraded = False
+                deadline = time.monotonic() + 45
+                while time.monotonic() < deadline:
+                    total, degraded = probe()
+                    if degraded:
+                        saw_degraded = True
+                    if not degraded and total == acked:
+                        break
+                    time.sleep(0.2)
+                window_s = time.monotonic() - t_kill
+                degraded_windows.append(round(window_s, 2))
+                assert saw_degraded, (
+                    f"cycle {cycle}: no explicit degraded answer while "
+                    f"{victim} was down"
+                )
+                total, degraded = probe()
+                assert not degraded and total == acked, (
+                    f"cycle {cycle}: acked-write loss or unbounded "
+                    f"degradation ({total} != {acked}, degraded={degraded})"
+                )
+                stats["worker_kill_cycles"] = (
+                    stats.get("worker_kill_cycles", 0) + 1
+                )
+        assert max(degraded_windows) < 45, degraded_windows
+        stats["worker_degraded_windows_s"] = degraded_windows
+        stats["worker_restarts"] = srv.pool.restarts
+        stats["worker_acked"] = acked
+    finally:
+        faults.clear()
+        srv.stop()
+
+
 def run_smoke(tmp_root, seed: int = 42, budget_s: float = 3.0) -> dict:
     from pathlib import Path
 
@@ -370,9 +457,11 @@ def run_smoke(tmp_root, seed: int = 42, budget_s: float = 3.0) -> dict:
     _smoke_wqueue_cycles(tmp, budget_s, stats)
     _smoke_degradation(tmp, budget_s, stats)
     _smoke_fault_schedule(tmp, seed, stats)
+    _smoke_worker_cycles(tmp, seed, stats)
     stats["wall_s"] = round(time.perf_counter() - t0, 2)
     assert stats["kill_cycles"] >= 3
     assert stats["degraded_seen"] >= 1
+    assert stats["worker_kill_cycles"] >= 2
     return stats
 
 

@@ -69,6 +69,13 @@ echo "== obs smoke =="
 # bucketed stage histograms on /metrics (docs/observability.md)
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py || fail=1
 
+echo "== workers smoke =="
+# multi-process data plane: BYDB_WORKERS=2 vs 0 scatter BYTE parity,
+# per-worker span graft + labeled /metrics, worker SIGKILL -> restart +
+# journal replay with zero acked loss
+# (docs/performance.md "Multi-process data plane")
+env JAX_PLATFORMS=cpu python scripts/workers_smoke.py || fail=1
+
 echo "== chaos smoke =="
 # 3 in-process data-node kill/restart cycles under the liaison write
 # queue + a degradation scenario + a seeded fault schedule: zero

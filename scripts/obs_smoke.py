@@ -235,8 +235,113 @@ def main() -> int:
         f"# fused A/B: 1 dispatch/part-batch on {len(subtrees)} nodes, "
         "staged flip byte-identical"
     )
+
+    # -- 6: multi-process data plane graft (docs/performance.md) ----------
+    # a BYDB_WORKERS=2 standalone server produces ONE merged tree whose
+    # scatter legs carry grafted worker subtrees, and the merged
+    # /metrics exposition carries worker-labeled stage histograms that
+    # the shared scraper aggregates across workers
+    _worker_graft_smoke()
     print("obs_smoke: OK")
     return 0
+
+
+def _worker_graft_smoke() -> None:
+    import json as _json
+
+    from banyandb_tpu.api import (
+        Aggregation,
+        Catalog,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        GroupBy,
+        Measure,
+        QueryRequest,
+        ResourceOpts,
+        TagSpec,
+        TagType,
+        TimeRange,
+    )
+    from banyandb_tpu.cluster import serde
+    from banyandb_tpu.cluster.bus import Topic
+    from banyandb_tpu.obs import prom as obs_prom
+    from banyandb_tpu.server import StandaloneServer
+
+    tmp = tempfile.mkdtemp(prefix="bydb-obs-workers-")
+    srv = StandaloneServer(tmp, port=0, workers=2)
+    try:
+        srv.start()
+        srv.registry.create_group(
+            Group("wg", Catalog.MEASURE, ResourceOpts(shard_num=4))
+        )
+        srv.registry.create_measure(
+            Measure(
+                group="wg", name="m",
+                tags=(TagSpec("svc", TagType.STRING),),
+                fields=(FieldSpec("v", FieldType.FLOAT),),
+                entity=Entity(("svc",)),
+            )
+        )
+        pts = [
+            {"ts": T0 + i, "tags": {"svc": f"s{i % 6}"},
+             "fields": {"v": float(i % 9)}, "version": 1}
+            for i in range(300)
+        ]
+        srv.bus.handle(
+            Topic.MEASURE_WRITE.value,
+            {"request": {"group": "wg", "name": "m", "points": pts}},
+        )
+        req = QueryRequest(
+            ("wg",), "m", TimeRange(T0, T0 + 10_000),
+            group_by=GroupBy(("svc",)), agg=Aggregation("sum", "v"),
+            trace=True, limit=100,
+        )
+        res = srv.bus.handle(
+            Topic.MEASURE_QUERY_RAW.value,
+            {"request": serde.query_request_to_json(req)},
+        )["result"]
+        tree = res["trace"]["span_tree"]
+
+        def find_all(node, pred, out):
+            if isinstance(node, dict):
+                if pred(node):
+                    out.append(node)
+                for c in node.get("children", ()) or ():
+                    find_all(c, pred, out)
+            return out
+
+        legs = find_all(
+            tree, lambda n: str(n.get("name", "")).startswith("scatter:w"), []
+        )
+        assert len(legs) >= 2, (
+            f"worker scatter legs missing: {_json.dumps(tree)[:300]}"
+        )
+        for leg in legs:
+            sub = find_all(
+                leg, lambda n: str(n.get("name", "")).startswith("data:w"), []
+            )
+            assert sub, f"scatter leg {leg.get('name')} has no grafted subtree"
+            assert find_all(sub[0], lambda n: n.get("name") == "reduce", []), (
+                f"{leg.get('name')}: grafted subtree carries no reduce span"
+            )
+        text = srv.bus.handle("metrics", {})["prometheus"]
+        assert 'worker="w000"' in text and 'worker="w001"' in text
+        assert "banyandb_worker" in text or "banyandb_workers_alive" in text
+        stages = obs_prom.stage_breakdown(text)
+        assert stages.get("gather", {}).get("count", 0) > 0, (
+            f"scraper lost worker-labeled stage series: {sorted(stages)}"
+        )
+        print(
+            f"# worker graft: {len(legs)} scatter legs with data:w* "
+            "subtrees, worker-labeled stage histograms scraped"
+        )
+    finally:
+        srv.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,258 @@
+"""Multi-process data plane smoke (docs/performance.md "Multi-process
+data plane") — the check.sh gate for cluster/workers.py:
+
+1. boots a standalone server with BYDB_WORKERS=2 beside a BYDB_WORKERS=0
+   twin over identical writes (row + columnar envelopes) and asserts
+   result JSON BYTE PARITY across aggregate / grouped / percentile /
+   raw shapes;
+2. asserts the scatter span graft: a traced worker-mode query carries
+   one merged tree with per-worker ``scatter:<name>`` spans and worker
+   ``data:<name>`` subtrees, and /metrics carries worker-labeled series;
+3. SIGKILLs a worker mid-ingest and asserts restart + journal replay
+   recovers every acked row with an explicit degraded window in between.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("BYDB_WORKERS", "0")  # the harness passes workers=N
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+T0 = 1_700_000_000_000
+HI = T0 + 1_000_000_000
+
+
+def _schema(srv):
+    from banyandb_tpu.api import (
+        Catalog,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        Measure,
+        ResourceOpts,
+        TagSpec,
+        TagType,
+    )
+
+    srv.registry.create_group(
+        Group("g", Catalog.MEASURE, ResourceOpts(shard_num=4))
+    )
+    srv.registry.create_measure(
+        Measure(
+            group="g",
+            name="m",
+            tags=(
+                TagSpec("svc", TagType.STRING),
+                TagSpec("region", TagType.STRING),
+            ),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+
+
+def _write(srv, base, n, rows=True):
+    import base64
+
+    import numpy as np
+
+    from banyandb_tpu.cluster.bus import Topic
+
+    if rows:
+        pts = [
+            {
+                "ts": T0 + (base + i) * 10,
+                "tags": {"svc": f"s{(base + i) % 5}", "region": f"r{i % 3}"},
+                "fields": {"v": float((base + i) % 11)},
+                "version": 1,
+            }
+            for i in range(n)
+        ]
+        r = srv.bus.handle(
+            Topic.MEASURE_WRITE.value,
+            {"request": {"group": "g", "name": "m", "points": pts}},
+        )
+    else:
+        ts = (T0 + (base + np.arange(n)) * 10).astype("<i8")
+        r = srv.bus.handle(
+            Topic.MEASURE_WRITE_COLUMNS.value,
+            {
+                "group": "g",
+                "name": "m",
+                "ts": base64.b64encode(ts.tobytes()).decode(),
+                "versions": base64.b64encode(
+                    np.ones(n, dtype="<i8").tobytes()
+                ).decode(),
+                "tags": {
+                    "svc": {
+                        "dict": [f"s{i}" for i in range(5)],
+                        "codes": base64.b64encode(
+                            ((base + np.arange(n)) % 5)
+                            .astype("<i4")
+                            .tobytes()
+                        ).decode(),
+                    },
+                    "region": [f"r{i % 3}" for i in range(n)],
+                },
+                "fields": {
+                    "v": base64.b64encode(
+                        ((base + np.arange(n)) % 11)
+                        .astype("<f8")
+                        .tobytes()
+                    ).decode(),
+                },
+            },
+        )
+    assert r["written"] == n, r
+    return n
+
+
+QLS = [
+    f"SELECT count(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} GROUP BY svc",
+    f"SELECT sum(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} "
+    f"WHERE region = 'r1' GROUP BY svc",
+    f"SELECT percentile(v, 90) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI}",
+    f"SELECT * FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} LIMIT 9 OFFSET 3",
+]
+
+
+def main() -> int:
+    from banyandb_tpu.server import TOPIC_QL, TOPIC_SNAPSHOT, StandaloneServer
+
+    tmp = tempfile.mkdtemp(prefix="bydb-workers-smoke-")
+
+    def boot(workers, name):
+        # 0 passes through verbatim: the parity baseline must pin the
+        # single-process layout even when BYDB_WORKERS is exported
+        srv = StandaloneServer(
+            os.path.join(tmp, name), port=0, workers=workers
+        )
+        srv.start()
+        _schema(srv)
+        _write(srv, 0, 150, rows=True)
+        _write(srv, 150, 150, rows=False)
+        srv.bus.handle(TOPIC_SNAPSHOT, {})
+        return srv
+
+    srv0 = boot(0, "mode0")
+    srv2 = boot(2, "mode2")
+    try:
+        # 1. scatter parity: byte-identical result JSON
+        for ql in QLS:
+            a = json.dumps(
+                srv0.bus.handle(TOPIC_QL, {"ql": ql})["result"],
+                sort_keys=True,
+            )
+            b = json.dumps(
+                srv2.bus.handle(TOPIC_QL, {"ql": ql})["result"],
+                sort_keys=True,
+            )
+            assert a == b, f"A/B divergence for {ql}:\n0: {a[:300]}\nN: {b[:300]}"
+        print("parity: result JSON byte-identical across", len(QLS), "shapes")
+
+        # 2. span graft: one merged tree, per-worker subtrees
+        from banyandb_tpu.api import (
+            Aggregation,
+            GroupBy,
+            QueryRequest,
+            TimeRange,
+        )
+        from banyandb_tpu.cluster import serde
+        from banyandb_tpu.cluster.bus import Topic
+
+        req = QueryRequest(
+            ("g",), "m", TimeRange(T0, HI),
+            group_by=GroupBy(("svc",)), agg=Aggregation("sum", "v"),
+            trace=True, limit=100,
+        )
+        traced = srv2.bus.handle(
+            Topic.MEASURE_QUERY_RAW.value,
+            {"request": serde.query_request_to_json(req)},
+        )["result"]
+        tree = traced["trace"]["span_tree"]
+
+        def find_all(node, pred, out):
+            if isinstance(node, dict):
+                if pred(node):
+                    out.append(node)
+                for c in node.get("children", ()) or ():
+                    find_all(c, pred, out)
+            return out
+
+        scatter = find_all(
+            tree, lambda n: str(n.get("name", "")).startswith("scatter:w"), []
+        )
+        assert len(scatter) >= 2, f"expected >=2 worker scatter legs: {tree}"
+        subtrees = find_all(
+            tree, lambda n: str(n.get("name", "")).startswith("data:w"), []
+        )
+        assert len(subtrees) >= 2, "worker span subtrees not grafted"
+        text = srv2.bus.handle("metrics", {})["prometheus"]
+        assert 'worker="w000"' in text and 'worker="w001"' in text, (
+            "per-worker metric labels missing from merged exposition"
+        )
+        print(
+            f"graft: {len(scatter)} scatter legs, {len(subtrees)} worker "
+            "subtrees, worker-labeled /metrics"
+        )
+
+        # 3. kill/restart: journal replay, explicit degraded window
+        acked = 300
+        srv2.pool.flush()
+        acked += _write(srv2, 300, 80, rows=False)
+        srv2.pool.kill_worker(0)
+        acked += _write(srv2, 380, 40, rows=True)  # journal-spooled ack
+        count_ql = (
+            f"SELECT count(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI}"
+        )
+        saw_degraded = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            res = srv2.bus.handle(TOPIC_QL, {"ql": count_ql})["result"]
+            total = int(sum(res["values"].get("count", [])))
+            if res.get("degraded"):
+                saw_degraded = True
+                assert res["unavailable_nodes"] == ["w000"], res
+            if not res.get("degraded") and total == acked:
+                break
+            time.sleep(0.2)
+        res = srv2.bus.handle(TOPIC_QL, {"ql": count_ql})["result"]
+        total = int(sum(res["values"].get("count", [])))
+        assert total == acked and not res.get("degraded"), (
+            f"acked-write loss across SIGKILL: {total} != {acked} "
+            f"(degraded={res.get('degraded')})"
+        )
+        assert saw_degraded, "no explicit degraded marker during the outage"
+        assert srv2.pool.restarts >= 1
+        print(
+            f"kill/restart: {acked} acked rows intact after SIGKILL+replay "
+            f"(restarts={srv2.pool.restarts}, "
+            f"window={round(time.monotonic() - t0, 1)}s)"
+        )
+        print("workers smoke: OK")
+        return 0
+    finally:
+        srv2.stop()
+        srv0.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    rc = main()
+    # grpc's C++ teardown can abort on this kernel after success (the
+    # chaos harness does the same); the asserts above already ran
+    sys.stdout.flush()
+    os._exit(rc)

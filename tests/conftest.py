@@ -14,6 +14,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # warming in the general suite (tests/test_cold_path.py re-enables it
 # explicitly to exercise the precompile registry)
 os.environ.setdefault("BYDB_PRECOMPILE", "0")
+# no shard-worker subprocesses in the general suite (the BYDB_FUSED-
+# style A/B contract is pinned explicitly by tests/test_workers.py,
+# which passes workers=N to the server; everything else runs the
+# single-process layout it was written against)
+os.environ.setdefault("BYDB_WORKERS", "0")
 # race/leak sanitizers on for the whole suite (BYDB_SANITIZE=0 opts out)
 os.environ.setdefault("BYDB_SANITIZE", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
@@ -76,6 +81,7 @@ def _bdsan_guard(request):
 
     sanitize.arm_watchdog(_TEST_WATCHDOG_S)
     before = leaks.thread_snapshot()
+    before_procs = leaks.process_snapshot()
     yield
     sanitize.disarm_watchdog()
     leaked = leaks.leaked_threads(before, grace_s=5.0)
@@ -85,6 +91,14 @@ def _bdsan_guard(request):
             f"thread parity: test leaked {len(leaked)} thread(s): {names}; "
             "stop()/close()/join() the owner in teardown (allowlist: "
             "sanitize.leaks.DEFAULT_THREAD_ALLOWLIST)"
+        )
+    leaked_procs = leaks.leaked_processes(before_procs, grace_s=5.0)
+    if leaked_procs:
+        names = ", ".join(f"{label} (pid={pid})" for pid, label in leaked_procs)
+        pytest.fail(
+            f"process parity: test leaked {len(leaked_procs)} worker "
+            f"process(es): {names}; stop() the owning pool/server in "
+            "teardown (every spawn registers in utils.procreg)"
         )
 
 

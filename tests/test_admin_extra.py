@@ -80,6 +80,56 @@ def test_inspect_root_and_part(tmp_path):
     assert "timestamps.bin" in detail["files"]
 
 
+def test_dump_sidx_part(tmp_path, capsys):
+    """cli.py dump sidx: a fixture-produced sidx part (ordered trace
+    index) is dump-inspectable wherever it lives — incl. a worker's
+    directory tree (ROADMAP item 6e)."""
+    from banyandb_tpu import cli
+    from banyandb_tpu.index.sidx import SidxStore, encode_ref
+
+    store = SidxStore(tmp_path / "sidx")
+    for i in range(40):
+        store.insert(i, encode_ref(f"trace-{i}", 1_700_000_000_000 + i))
+    name = store.flush()
+    part_dir = tmp_path / "sidx" / name
+    assert cli.main(["dump", "sidx", str(part_dir)]) in (0, None)
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["meta"]["sidx"] is True
+    assert sum(b["count"] for b in doc["blocks"]) == 40
+    # kind validation: an sidx part is NOT a measure part
+    assert cli.main(["dump", "measure", str(part_dir)]) == 2
+
+
+def test_dump_property_shard_index(tmp_path, capsys):
+    """cli.py dump property: segment-level stats for one property shard
+    index (the other format left from ROADMAP item 6e)."""
+    from banyandb_tpu import cli
+    from banyandb_tpu.models.property import Property, PropertyEngine
+
+    reg = SchemaRegistry(tmp_path)
+    reg.create_group(
+        Group("pg", Catalog.MEASURE, ResourceOpts(shard_num=1))
+    )
+    eng = PropertyEngine(reg, tmp_path / "data")
+    for i in range(12):
+        eng.apply(
+            Property(
+                group="pg", name="settings", id=f"p{i}",
+                tags={"k": f"v{i}"},
+            )
+        )
+    eng.persist()
+    eng.close()
+    idx_dir = tmp_path / "data" / "property" / "pg" / "shard-0.idx"
+    assert cli.main(["dump", "property", str(idx_dir)]) in (0, None)
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["docs"] == 12 and doc["alive"] == 12
+    assert doc["segments"], doc
+    assert "k" in doc["segments"][0]["keyword_fields"]
+    # a non-index dir is rejected loudly, not crashed on
+    assert cli.main(["dump", "property", str(tmp_path)]) == 2
+
+
 def test_file_discovery_refresh(tmp_path):
     path = tmp_path / "nodes.json"
     FileDiscovery.write(path, [NodeInfo("a", "local:a")])

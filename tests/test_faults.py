@@ -530,6 +530,35 @@ def test_degraded_markers_on_unreplicated_node_loss(tmp_path):
     _close_all(dns)
 
 
+def test_transient_sole_replica_failure_retries_same_node(tmp_path):
+    """replicas=0: a scatter leg that fails ONCE with a transient
+    transport error (the wedged-channel dial this kernel hands out
+    under load) must not silently degrade — with no replica to fail
+    over to, the original node gets the one failover attempt, and the
+    fresh call completes the result."""
+    transport, liaison, dns = _local_cluster(tmp_path, replicas=0)
+    total = 120
+    liaison.write_measure(WriteRequest("fg", "m", _points(0, total)))
+    for dn in dns.values():
+        dn.measure.flush()
+
+    real_call = transport.call
+    blown = {"n": 0}
+
+    def flaky_call(addr, topic, envelope, timeout=30.0):
+        if topic == Topic.MEASURE_QUERY_PARTIAL and blown["n"] == 0:
+            blown["n"] += 1
+            raise TransportError("wedged channel", kind="error")
+        return real_call(addr, topic, envelope, timeout=timeout)
+
+    transport.call = flaky_call
+    res = liaison.query_measure(_count_req())
+    assert blown["n"] == 1, "fault did not fire"
+    assert _total(res) == total
+    assert not res.degraded, "transient one-shot failure must heal"
+    _close_all(dns)
+
+
 def test_degraded_assignment_time_skip(tmp_path):
     """A node already known dead (probe ran) degrades at PLANNING time:
     its shards are skipped, the query still answers."""

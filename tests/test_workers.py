@@ -1,0 +1,1014 @@
+"""Multi-process data plane (cluster/workers.py, BYDB_WORKERS A/B).
+
+Pins the acceptance contract of docs/performance.md "Multi-process data
+plane":
+
+- result JSON byte-identical between ``workers=0`` (single-process
+  layout) and ``workers=N`` across measure aggregate / grouped /
+  filtered / percentile / raw limit-offset, stream, streamagg-covered
+  and TopN shapes;
+- a SIGKILLed worker restarts with journal replay: zero acked-write
+  loss (incl. writes acked DURING the dead window), bounded degraded
+  window with explicit ``degraded`` + ``unavailable_nodes`` markers;
+- journal trims on worker flush; worker processes register in
+  utils.procreg and are reaped by stop() (bdsan process parity);
+- per-worker metrics labels merge into /metrics, restarts count.
+
+Subprocess boots are ~2s each (jax import), so the A/B pair is built
+once per module and read-mostly tests share it; the kill test owns its
+own server.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.api import (
+    Catalog,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    Measure,
+    ResourceOpts,
+    TagSpec,
+    TagType,
+    TopNAggregation,
+)
+from banyandb_tpu.cluster.bus import Topic
+from banyandb_tpu.server import (
+    TOPIC_QL,
+    TOPIC_SNAPSHOT,
+    TOPIC_STREAMAGG,
+    TOPIC_TOPN,
+    StandaloneServer,
+)
+
+T0 = 1_700_000_000_000
+HI = T0 + 1_000_000_000
+
+
+def _schema(srv):
+    srv.registry.create_group(
+        Group("g", Catalog.MEASURE, ResourceOpts(shard_num=4))
+    )
+    srv.registry.create_measure(
+        Measure(
+            group="g",
+            name="m",
+            tags=(
+                TagSpec("svc", TagType.STRING),
+                TagSpec("region", TagType.STRING),
+            ),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+    srv.registry.create_topn(
+        TopNAggregation(
+            group="g",
+            name="top_svc",
+            source_measure="m",
+            field_name="v",
+            field_value_sort="desc",
+            group_by_tag_names=("svc",),
+            counters_number=100,
+        )
+    )
+    # stream model on the same server
+    srv.bus.handle(
+        "registry",
+        {
+            "op": "create_stream",
+            "kind": "stream",
+            "item": {
+                "group": "g",
+                "name": "logs",
+                "tags": [
+                    {"name": "svc", "type": "string"},
+                    {"name": "level", "type": "string"},
+                ],
+                "entity": ["svc"],
+            },
+        },
+    )
+
+
+def _write_rows(srv, n=240):
+    pts = [
+        {
+            "ts": T0 + i * 10,
+            "tags": {"svc": f"s{i % 7}", "region": f"r{i % 3}"},
+            "fields": {"v": float((i * 7) % 23)},
+            "version": 1,
+        }
+        for i in range(n)
+    ]
+    r = srv.bus.handle(
+        Topic.MEASURE_WRITE.value,
+        {"request": {"group": "g", "name": "m", "points": pts}},
+    )
+    assert r["written"] == n
+    elems = [
+        {
+            "element_id": f"e{i}",
+            "ts": T0 + i * 10,
+            "tags": {"svc": f"s{i % 7}", "level": "ERROR" if i % 5 == 0 else "INFO"},
+            "body": base64.b64encode(f"l{i}".encode()).decode(),
+        }
+        for i in range(60)
+    ]
+    r = srv.bus.handle(
+        Topic.STREAM_WRITE.value,
+        {"group": "g", "name": "logs", "elements": elems},
+    )
+    assert r["written"] == 60
+
+
+def _write_cols(srv, base, n, version=1):
+    ts = (T0 + (base + np.arange(n)) * 10).astype("<i8")
+    env = {
+        "group": "g",
+        "name": "m",
+        "ts": base64.b64encode(ts.tobytes()).decode(),
+        "versions": base64.b64encode(
+            np.full(n, version, dtype="<i8").tobytes()
+        ).decode(),
+        "tags": {
+            "svc": {
+                "dict": [f"s{i}" for i in range(9)],
+                "codes": base64.b64encode(
+                    ((base + np.arange(n)) % 9).astype("<i4").tobytes()
+                ).decode(),
+            },
+            "region": {
+                "dict": ["r0", "r1", "r2"],
+                "codes": base64.b64encode(
+                    ((base + np.arange(n)) % 3).astype("<i4").tobytes()
+                ).decode(),
+            },
+        },
+        "fields": {
+            "v": base64.b64encode(
+                (((base + np.arange(n)) * 3) % 17).astype("<f8").tobytes()
+            ).decode(),
+        },
+    }
+    return srv.bus.handle(Topic.MEASURE_WRITE_COLUMNS.value, env)
+
+
+QUERIES = [
+    # aggregate / grouped / filtered / percentile / raw limit-offset
+    f"SELECT count(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} GROUP BY svc",
+    f"SELECT sum(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} "
+    f"WHERE region = 'r1' GROUP BY svc",
+    f"SELECT mean(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI}",
+    f"SELECT max(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} "
+    f"WHERE svc IN ('s1', 's3') GROUP BY region",
+    f"SELECT percentile(v, 95) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI}",
+    f"SELECT * FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} LIMIT 13",
+    f"SELECT * FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} LIMIT 7 OFFSET 5",
+    # stream
+    f"SELECT svc, level FROM STREAM logs IN g TIME BETWEEN {T0} AND {HI} "
+    f"WHERE level = 'ERROR' LIMIT 100",
+]
+
+
+def _boot(tmp_path, workers, name):
+    srv = StandaloneServer(tmp_path / name, port=0, workers=workers or None)
+    srv.start()
+    _schema(srv)
+    # one covering streamagg signature (region, svc superset of both
+    # query shapes), registered before ingest like a real deployment
+    srv.bus.handle(
+        TOPIC_STREAMAGG,
+        {
+            "op": "register",
+            "group": "g",
+            "measure": "m",
+            "key_tags": ["region", "svc"],
+            "fields": ["v"],
+            "window_millis": 60_000,
+        },
+    )
+    _write_rows(srv)
+    assert _write_cols(srv, 1000, 300)["written"] == 300
+    srv.bus.handle(TOPIC_SNAPSHOT, {})
+    return srv
+
+
+@pytest.fixture(scope="module")
+def ab_pair(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("workers-ab")
+    srv0 = _boot(tmp, 0, "w0")
+    srv2 = _boot(tmp, 2, "w2")
+    yield srv0, srv2
+    srv2.stop()
+    srv0.stop()
+
+
+def test_ab_result_json_byte_identical(ab_pair):
+    srv0, srv2 = ab_pair
+    for ql in QUERIES:
+        a = json.dumps(
+            srv0.bus.handle(TOPIC_QL, {"ql": ql})["result"], sort_keys=True
+        )
+        b = json.dumps(
+            srv2.bus.handle(TOPIC_QL, {"ql": ql})["result"], sort_keys=True
+        )
+        assert a == b, f"A/B divergence for {ql}:\n0: {a[:400]}\nN: {b[:400]}"
+
+
+def test_ab_streamagg_covered_parity_and_materialized(ab_pair):
+    srv0, srv2 = ab_pair
+    ql = (
+        f"SELECT sum(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI} "
+        f"GROUP BY svc"
+    )
+    r0 = srv0.bus.handle(TOPIC_QL, {"ql": ql})
+    r2 = srv2.bus.handle(TOPIC_QL, {"ql": ql})
+    assert json.dumps(r0["result"], sort_keys=True) == json.dumps(
+        r2["result"], sort_keys=True
+    )
+    # both modes fold materialized windows (the serve-path marker walks
+    # grafted worker subtrees in N-mode)
+    assert r0["served"] == "materialized"
+    assert r2["served"] == "materialized"
+
+
+def test_ab_topn_parity(ab_pair):
+    srv0, srv2 = ab_pair
+    # emit pending windows on the 0-mode engine the way the worker ctl
+    # flush already did for N-mode, then re-snapshot both
+    srv0.measure.topn.flush_all_windows()
+    srv0.bus.handle(TOPIC_SNAPSHOT, {})
+    srv2.bus.handle(TOPIC_SNAPSHOT, {})
+    env = {
+        "group": "g",
+        "name": "top_svc",
+        "time_range": [T0 - 120_000, HI],
+        "n": 5,
+        "agg": "max",
+    }
+    a = srv0.bus.handle(TOPIC_TOPN, dict(env))
+    b = srv2.bus.handle(TOPIC_TOPN, dict(env))
+    assert a == b, f"TopN divergence:\n0: {a}\nN: {b}"
+    assert a["items"], "TopN returned no items — vacuous parity"
+    # agg="count" flattens values to 1.0 AFTER ranking: the worker
+    # concat re-rank must still select the same entity set in the same
+    # order (it ranks on the underlying distinct-best value, not 1.0)
+    cenv = dict(env, agg="count", n=3)
+    a = srv0.bus.handle(TOPIC_TOPN, cenv)
+    b = srv2.bus.handle(TOPIC_TOPN, cenv)
+    assert a == b, f"TopN count divergence:\n0: {a}\nN: {b}"
+    assert a["items"] and all(it["value"] == 1.0 for it in a["items"])
+
+
+def test_wire_adapter_topn_in_worker_mode(ab_pair):
+    """The gRPC wire serves measure (incl. TopN) through the pool
+    adapter in worker mode: topn_scatter must agree with the 0-mode
+    engine's query_topn — a shard-routed query_measure of the result
+    measure would silently miss worker-local rows instead."""
+    from banyandb_tpu.api.model import TimeRange
+    from banyandb_tpu.models import topn as topn_mod
+
+    srv0, srv2 = ab_pair
+    # order-independent: emit pending windows on both modes (same prep
+    # as test_ab_topn_parity)
+    srv0.measure.topn.flush_all_windows()
+    srv0.bus.handle(TOPIC_SNAPSHOT, {})
+    srv2.bus.handle(TOPIC_SNAPSHOT, {})
+    # the wire facade IS the pool adapter (journaled writes + scatter
+    # TopN), pinned here so a refactor can't silently swap it back
+    assert srv2._pool_measure.registry is srv2.registry
+    env = {
+        "group": "g", "name": "top_svc",
+        "time_range": [T0 - 120_000, HI], "n": 5, "agg": "max",
+    }
+    items = srv2._pool_measure.topn_scatter(env)["items"]
+    got = [(tuple(it["entity"]), it["value"]) for it in items]
+    want = topn_mod.query_topn(
+        srv0.measure, "g", "top_svc", TimeRange(T0 - 120_000, HI),
+        n=5, agg="max",
+    )
+    assert got and got == want
+    # the full wire handler (banyandb.measure.v1 TopN) over the same
+    # facades: pool-mode reply proto == 0-mode reply proto
+    from banyandb_tpu.api import pb
+    from banyandb_tpu.api.grpc_server import WireServices
+    from banyandb_tpu.api.wire import millis_to_ts
+
+    req = pb.measure_topn_pb2.TopNRequest()
+    req.groups.append("g")
+    req.name = "top_svc"
+    req.time_range.begin.CopyFrom(millis_to_ts(T0 - 120_000))
+    req.time_range.end.CopyFrom(millis_to_ts(HI))
+    req.top_n = 5
+    req.agg = 5  # MAX
+    replies = []
+    for reg, measure in (
+        (srv0.registry, srv0.measure),
+        (srv2.registry, srv2._pool_measure),
+    ):
+        ws = WireServices(reg, measure, None)
+        resp = ws.measure_topn(req, None)
+        for lst in resp.lists:
+            lst.ClearField("timestamp")
+        replies.append(resp.SerializeToString())
+    assert replies[0] == replies[1] and replies[0]
+
+
+def test_worker_metrics_labels_and_stats(ab_pair):
+    _, srv2 = ab_pair
+    text = srv2.bus.handle("metrics", {})["prometheus"]
+    assert 'worker="w000"' in text and 'worker="w001"' in text
+    assert "banyandb_workers_alive 2" in text
+    # per-worker write instrumentation made it into the merged text
+    assert 'banyandb_write_ms_count{model="measure",worker=' in text
+    st = srv2.pool.stats()
+    assert st["workers"] == 2 and sorted(st["alive"]) == ["w000", "w001"]
+
+
+def test_degraded_markers_and_restart_replay(tmp_path):
+    srv = StandaloneServer(tmp_path / "kill", port=0, workers=2)
+    try:
+        srv.start()
+        _schema(srv)
+        srv.bus.handle(
+            TOPIC_STREAMAGG,
+            {
+                "op": "register",
+                "group": "g",
+                "measure": "m",
+                "key_tags": ["region", "svc"],
+                "fields": ["v"],
+                "window_millis": 60_000,
+            },
+        )
+        acked = 0
+        assert _write_cols(srv, 0, 400)["written"] == 400
+        acked += 400
+        # flush trims the journal; later writes live only in journal +
+        # worker memtable
+        srv.pool.flush()
+        assert srv.pool.stats()["journal_entries"] == [0, 0]
+        assert _write_cols(srv, 400, 200)["written"] == 200
+        acked += 200
+        count_ql = (
+            f"SELECT count(v) FROM MEASURE m IN g TIME BETWEEN {T0} AND {HI}"
+        )
+        srv.pool.kill_worker(0)
+        # writes during the dead window: journal-acked (handoff-style),
+        # delivered by restart replay — zero write errors
+        assert _write_cols(srv, 600, 100)["written"] == 100
+        acked += 100
+        # the degraded window is explicit while w000 is down
+        saw_degraded = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            res = srv.bus.handle(TOPIC_QL, {"ql": count_ql})["result"]
+            total = int(sum(res["values"].get("count", [])))
+            if res.get("degraded"):
+                saw_degraded = True
+                assert res["unavailable_nodes"] == ["w000"]
+            if not res.get("degraded") and total == acked:
+                break
+            time.sleep(0.25)
+        assert saw_degraded, "kill window produced no explicit degraded answer"
+        res = srv.bus.handle(TOPIC_QL, {"ql": count_ql})["result"]
+        assert not res.get("degraded")
+        assert int(sum(res["values"].get("count", []))) == acked, (
+            "acked-write loss across worker SIGKILL/restart"
+        )
+        assert srv.pool.restarts >= 1
+        text = srv.bus.handle("metrics", {})["prometheus"]
+        assert "banyandb_worker_restarts_total" in text
+        # streamagg windows rebuilt post-replay without double-folds:
+        # the covered fold equals the rescan count above
+        r = srv.bus.handle(TOPIC_QL, {"ql": count_ql})
+        assert r["served"] == "materialized"
+    finally:
+        srv.stop()
+
+
+def test_wire_stream_trace_writes_journal_across_kill(tmp_path):
+    """The wire surface's stream/trace engines are the POOL adapters
+    (journal-then-forward), not bare liaison ones: the crash contract
+    covers every ack on every model.  Rows written through the adapters
+    before AND during a worker's dead window survive SIGKILL+replay —
+    memtable-only rows can only come back via the parent journal."""
+    from banyandb_tpu.api.model import QueryRequest, TimeRange
+    from banyandb_tpu.api.schema import Stream, Trace
+    from banyandb_tpu.cluster.workers import (
+        PoolStreamAdapter,
+        PoolTraceAdapter,
+    )
+    from banyandb_tpu.models.stream import ElementValue
+    from banyandb_tpu.models.trace import SpanValue
+
+    srv = StandaloneServer(tmp_path / "wt", port=0, wire_port=0, workers=2)
+    try:
+        srv.start()
+        _schema(srv)
+        srv.registry.create_stream(
+            Stream(
+                group="g", name="logs",
+                tags=(TagSpec("svc", TagType.STRING),), entity=("svc",),
+            )
+        )
+        srv.registry.create_trace(
+            Trace(
+                group="g", name="sw",
+                tags=(
+                    TagSpec("trace_id", TagType.STRING),
+                    TagSpec("dur", TagType.INT),
+                ),
+                trace_id_tag="trace_id",
+            )
+        )
+        # the wire serves THROUGH the journaling adapters (wiring pin)
+        assert isinstance(srv._wire_services.stream, PoolStreamAdapter)
+        assert isinstance(srv._wire_services.trace, PoolTraceAdapter)
+
+        def write_batch(base, n):
+            srv._wire_services.stream.write(
+                "g", "logs",
+                [
+                    ElementValue(
+                        element_id=f"e{base + i}", ts_millis=T0 + base + i,
+                        tags={"svc": f"s{(base + i) % 8}"},
+                        body=f"b{base + i}".encode(),
+                    )
+                    for i in range(n)
+                ],
+            )
+            srv._wire_services.trace.write(
+                "g", "sw",
+                [
+                    SpanValue(
+                        ts_millis=T0 + base + i,
+                        tags={
+                            "trace_id": f"t{(base + i) % 4}",
+                            "dur": base + i,
+                        },
+                        span=f"sp{base + i}".encode(),
+                    )
+                    for i in range(n)
+                ],
+                ordered_tags=("dur",),
+            )
+
+        write_batch(0, 40)
+        srv.pool.kill_worker(0)
+        write_batch(40, 24)  # dead-window acks live in the journal alone
+        total = 64
+        sreq = QueryRequest(
+            groups=("g",), name="logs",
+            time_range=TimeRange(T0, T0 + 1_000_000), limit=1000,
+        )
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            res = srv.pool.query_stream(sreq)
+            if not res.degraded and len(res.data_points) == total:
+                break
+            time.sleep(0.25)
+        res = srv.pool.query_stream(sreq)
+        assert not res.degraded
+        assert len(res.data_points) == total, (
+            "stream acked-write loss across worker SIGKILL/restart"
+        )
+        spans = srv.pool.query_trace_by_id("g", "sw", "t1")
+        assert len(spans) == total // 4, (
+            "trace acked-write loss across worker SIGKILL/restart"
+        )
+        assert srv.pool.restarts >= 1
+    finally:
+        srv.stop()
+
+
+def test_dead_worker_journal_cap_sheds(tmp_path):
+    """A dead worker's journal is bounded: once the spool passes
+    BYDB_WORKER_JOURNAL_MB the write SHEDS (retryable ServerBusy, the
+    wqueue high-watermark contract) instead of acking into unbounded
+    parent memory that a parent OOM would lose."""
+    from banyandb_tpu.admin.protector import ServerBusy
+
+    srv = StandaloneServer(tmp_path / "shed", port=0, workers=1)
+    try:
+        srv.start()
+        _schema(srv)
+        assert _write_cols(srv, 0, 50)["written"] == 50
+        # freeze the supervisor so the dead window is deterministic
+        srv.pool._stopping.set()
+        srv.pool._supervisor.join(timeout=30)
+        srv.pool.kill_worker(0)
+        deadline = time.monotonic() + 30
+        while srv.pool._clients[0].alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not srv.pool._clients[0].alive
+        srv.pool._journal_cap = 4096
+        with pytest.raises(ServerBusy):
+            for i in range(256):
+                _write_cols(srv, 1000 + i * 10, 10)
+        # unfreeze stop()'s view: already-set event, workers reaped below
+    finally:
+        srv.stop()
+
+
+def _freeze_and_kill(srv):
+    """Freeze the supervisor (no restart/flush ticks) and SIGKILL the
+    only worker so subsequent writes take the journal-spooled path."""
+    srv.pool._stopping.set()
+    srv.pool._supervisor.join(timeout=30)
+    srv.pool.kill_worker(0)
+    deadline = time.monotonic() + 30
+    while srv.pool._clients[0].alive and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not srv.pool._clients[0].alive
+
+
+def test_replay_keeps_transient_shed_rejections(tmp_path):
+    """Journal replay drops only DETERMINISTIC rejections (kind="error"
+    — validation that would fail live too).  A shed (DiskFull/ServerBusy
+    from a healthy worker) is transient, and the entry was already
+    ACKED: it must survive for the supervisor's next restart+replay
+    attempt, or acked writes vanish whenever a worker dies while its
+    disk is at the high watermark."""
+    from banyandb_tpu.cluster.rpc import TransportError
+
+    srv = StandaloneServer(tmp_path / "shed-replay", port=0, workers=1)
+    try:
+        srv.start()
+        _schema(srv)
+        _freeze_and_kill(srv)
+        for base in (1000, 2000, 3000):
+            assert _write_cols(srv, base, 5)["written"] == 5
+        seqs = [e[0] for e in srv.pool._journal[0]]
+        assert len(seqs) == 3
+
+        class _Client:
+            flush_wm = 0
+
+            def __init__(self, reject_seq, kind):
+                self.reject_seq, self.kind = reject_seq, kind
+
+            def call(self, topic, env, timeout=None, env_json=None):
+                if json.loads(env_json)["_seq"] == self.reject_seq:
+                    e = TransportError("rejected", kind=self.kind)
+                    e.remote = True
+                    raise e
+                return {}
+
+        # transient shed on the middle entry: replay raises (the
+        # supervisor retries the whole restart later) and the journal
+        # keeps the shed entry and everything after it
+        with pytest.raises(TransportError):
+            srv.pool._replay_locked(0, _Client(seqs[1], "shed"))
+        assert [e[0] for e in srv.pool._journal[0]] == seqs, (
+            "a shed-kind rejection must not drop acked journal entries"
+        )
+        # deterministic rejection: dropped, the rest replays through
+        assert srv.pool._replay_locked(0, _Client(seqs[1], "error")) == 2
+        assert [e[0] for e in srv.pool._journal[0]] == [seqs[0], seqs[2]]
+        assert srv.pool._jbytes[0] == sum(
+            e[3] for e in srv.pool._journal[0]
+        )
+    finally:
+        srv.stop()
+
+
+def test_columnar_validation_parity_when_worker_down(tmp_path):
+    """A columnar envelope the ENGINE would reject must error in the
+    parent BEFORE the ack even when the owning worker is down (the
+    journal-spooled ack path): acked-then-rejected-at-replay means rows
+    the client was told were written silently vanish, where 0-mode
+    fails the identical request immediately."""
+    srv = StandaloneServer(tmp_path / "val", port=0, workers=1)
+    try:
+        srv.start()
+        _schema(srv)
+        _freeze_and_kill(srv)
+        n = 8
+
+        def env(tags=None, fields=None):
+            return {
+                "group": "g",
+                "name": "m",
+                "ts": base64.b64encode(
+                    (T0 + np.arange(n) * 10).astype("<i8").tobytes()
+                ).decode(),
+                "tags": tags
+                or {
+                    "svc": [f"s{i}" for i in range(n)],
+                    "region": [f"r{i % 3}" for i in range(n)],
+                },
+                "fields": fields
+                or {
+                    "v": base64.b64encode(
+                        np.ones(n, dtype="<f8").tobytes()
+                    ).decode()
+                },
+            }
+
+        before = len(srv.pool._journal[0])
+        # ragged NON-entity tag column (entity routing never touches it)
+        with pytest.raises(ValueError):
+            srv.bus.handle(
+                Topic.MEASURE_WRITE_COLUMNS.value,
+                env(tags={
+                    "svc": [f"s{i}" for i in range(n)],
+                    "region": ["r0"] * (n - 1),
+                }),
+            )
+        # out-of-range dict codes on a non-entity tag
+        with pytest.raises(ValueError):
+            srv.bus.handle(
+                Topic.MEASURE_WRITE_COLUMNS.value,
+                env(tags={
+                    "svc": [f"s{i}" for i in range(n)],
+                    "region": {
+                        "dict": ["r0"],
+                        "codes": base64.b64encode(
+                            np.full(n, 7, dtype="<i4").tobytes()
+                        ).decode(),
+                    },
+                }),
+            )
+        # ragged field column
+        with pytest.raises(ValueError):
+            srv.bus.handle(
+                Topic.MEASURE_WRITE_COLUMNS.value,
+                env(fields={
+                    "v": base64.b64encode(
+                        np.ones(n - 3, dtype="<f8").tobytes()
+                    ).decode()
+                }),
+            )
+        assert len(srv.pool._journal[0]) == before, (
+            "a rejected envelope must never reach the journal — it "
+            "would be acked, then dropped at replay"
+        )
+    finally:
+        srv.stop()
+
+
+def test_live_rejection_removed_from_journal_by_seq(tmp_path):
+    """A live worker's deterministic rejection removes exactly the
+    rejected entry — by seq, not pop(): the reply wait happens outside
+    the journal lock, so a later write can journal behind the in-flight
+    one while the rejection is on the wire."""
+    from banyandb_tpu.cluster.rpc import TransportError
+
+    srv = StandaloneServer(tmp_path / "rej", port=0, workers=1)
+    try:
+        srv.start()
+        _schema(srv)
+        srv.pool._stopping.set()
+        srv.pool._supervisor.join(timeout=30)
+        pool = srv.pool
+
+        class _Rejecting:
+            alive = True
+
+            def begin_call(self, topic, envelope, env_json=None):
+                return ("h",)
+
+            def wait_reply(self, handle, topic, timeout):
+                # a concurrent write lands behind ours mid-flight
+                pool._journal[0].append((10**9, "t", "{}", 2))
+                pool._jbytes[0] += 2
+                e = TransportError("bad write", kind="error")
+                e.remote = True
+                raise e
+
+        real = pool._clients[0]
+        pool._clients[0] = _Rejecting()
+        try:
+            with pytest.raises(TransportError):
+                pool._forward_write(
+                    0, Topic.MEASURE_WRITE_COLUMNS.value, {"group": "g"}
+                )
+            assert [e[0] for e in pool._journal[0]] == [10**9], (
+                "rejection must remove its own entry and ONLY its own"
+            )
+            assert pool._jbytes[0] == 2
+        finally:
+            pool._journal[0].clear()
+            pool._jbytes[0] = 0
+            pool._clients[0] = real
+    finally:
+        srv.stop()
+
+
+def test_worker_processes_registered_and_reaped(tmp_path):
+    from banyandb_tpu.utils import procreg
+
+    before = procreg.snapshot()
+    srv = StandaloneServer(tmp_path / "reap", port=0, workers=2)
+    try:
+        spawned = procreg.snapshot() - before
+        assert len(spawned) == 2, "workers must register in utils.procreg"
+    finally:
+        srv.stop()
+    assert procreg.snapshot() - before == frozenset(), (
+        "stop() must reap + unregister every worker process"
+    )
+    from banyandb_tpu.sanitize import leaks
+
+    assert leaks.leaked_processes(before, grace_s=0.1) == []
+
+
+def _stream_count(srv, base_ts, expect, deadline_s=60):
+    from banyandb_tpu.api.model import QueryRequest, TimeRange
+
+    req = QueryRequest(
+        groups=("g",), name="logs",
+        time_range=TimeRange(base_ts, base_ts + 1_000_000), limit=10_000,
+    )
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        res = srv.pool.query_stream(req)
+        if not res.degraded and len(res.data_points) == expect:
+            return len(res.data_points)
+        time.sleep(0.25)
+    res = srv.pool.query_stream(req)
+    assert not res.degraded
+    return len(res.data_points)
+
+
+def test_no_worker_local_flush_duplicates_after_kill(tmp_path, monkeypatch):
+    """Workers must never drain memtables on their own lifecycle tick
+    (local_flush=False): stream appends have no version dedup, so a
+    loop-driven drain the parent never trimmed would come back as
+    DUPLICATES when the replay re-sends the journal after a SIGKILL.
+
+    The supervisor's periodic flush is frozen so the journal stays
+    untrimmed across the crash — only a (forbidden) worker-local drain
+    could persist the rows the replay then re-appends.  Timestamps are
+    RECENT: module-T0 rows are past the group's 7-day TTL, and the
+    worker's retention sweep deleting the flushed segment mid-test
+    would erase exactly the duplicate evidence this test looks for."""
+    monkeypatch.setenv("BYDB_WORKER_FLUSH_S", "3600")
+    t1 = int(time.time() * 1000) - 60_000
+    srv = StandaloneServer(tmp_path / "dup", port=0, workers=2)
+    try:
+        srv.start()
+        _schema(srv)
+        n = 120
+        srv.pool.write_stream(
+            "g", "logs",
+            [
+                {"ts": t1 + i, "element_id": f"e{i}",
+                 "tags": {"svc": f"s{i % 16}", "level": f"l{i % 3}"}}
+                for i in range(n)
+            ],
+        )
+        # the old in-worker flush loop drained every second: give any
+        # such drain more than one interval to fire before the crash
+        time.sleep(1.8)
+        assert srv.pool.stats()["journal_entries"][0] > 0, (
+            "journal must still hold w000's entries for this test to "
+            "discriminate (supervisor flush should be frozen)"
+        )
+        srv.pool.kill_worker(0)
+        got = _stream_count(srv, t1, n)
+        assert got == n, (
+            f"{got} stream elements after SIGKILL+replay, wrote {n} "
+            "(> means a worker-local flush turned the replay into "
+            "duplicates; < means acked-write loss)"
+        )
+    finally:
+        srv.stop()
+
+
+def test_flush_wm_skips_replay_of_flushed_rows(tmp_path, monkeypatch):
+    """Crash in the window between the worker persisting a flush and
+    the parent trimming its journal: the worker's flush.wm file proves
+    which seqs are in parts, and replay skips exactly those — without
+    it every journaled stream row already flushed would re-append.
+    Recent timestamps, like the test above: retention must not delete
+    the flushed part whose journal entries the replay would duplicate."""
+    # freeze the supervisor's flush tick so the journal is guaranteed
+    # untrimmed when the worker dies (the race window, held open)
+    monkeypatch.setenv("BYDB_WORKER_FLUSH_S", "3600")
+    t1 = int(time.time() * 1000) - 60_000
+    srv = StandaloneServer(tmp_path / "wm", port=0, workers=2)
+    try:
+        srv.start()
+        _schema(srv)
+        n = 96
+        srv.pool.write_stream(
+            "g", "logs",
+            [
+                {"ts": t1 + i, "element_id": f"e{i}",
+                 "tags": {"svc": f"s{i % 16}", "level": f"l{i % 3}"}}
+                for i in range(n)
+            ],
+        )
+        # worker-side flush WITHOUT the parent trim = the crash window
+        srv.pool._ctl(0, {"op": "flush"})
+        assert srv.pool.stats()["journal_entries"][0] > 0, (
+            "journal must still hold w000's entries for this test to "
+            "exercise the replay-skip path"
+        )
+        srv.pool.kill_worker(0)
+        got = _stream_count(srv, t1, n)
+        assert got == n, (
+            f"{got} stream elements after flush+SIGKILL+replay, wrote {n} "
+            "(> means replay re-appended rows the flush.wm already covers)"
+        )
+        client = srv.pool._clients[0]
+        assert client is not None and client.flush_wm > 0, (
+            "restarted worker reported no persisted watermark — the "
+            "replay-skip path never engaged and this test is vacuous"
+        )
+    finally:
+        srv.stop()
+
+
+def test_schema_and_liveness_reconcile_without_restart(tmp_path):
+    """A schema push that fails against a LIVE worker must not strand
+    it: the supervisor resyncs the full object set and re-probes the
+    worker back into liaison.alive — crash-restart is not the only
+    catch-up path."""
+    from banyandb_tpu.cluster.rpc import TransportError
+
+    srv = StandaloneServer(tmp_path / "stale", port=0, workers=2)
+    try:
+        srv.start()
+        _schema(srv)
+        orig = srv.pool.liaison.sync_schema
+        state = {"failed": False}
+
+        def flaky(kind, obj):
+            if not state["failed"]:
+                state["failed"] = True
+                # what a real transport failure does before raising
+                srv.pool.liaison._mark_dead("w001")
+                raise TransportError("injected schema push failure")
+            return orig(kind, obj)
+
+        srv.pool.liaison.sync_schema = flaky
+        try:
+            srv.registry.create_measure(
+                Measure(
+                    group="g", name="m2",
+                    tags=(TagSpec("svc", TagType.STRING),),
+                    fields=(FieldSpec("v", FieldType.FLOAT),),
+                    entity=Entity(("svc",)),
+                )
+            )
+        finally:
+            srv.pool.liaison.sync_schema = orig
+        assert state["failed"], "injection never fired"
+        pts = [
+            {"ts": T0 + i, "tags": {"svc": f"s{i % 8}"},
+             "fields": {"v": float(i)}, "version": 1}
+            for i in range(64)
+        ]
+        deadline = time.monotonic() + 60
+        written = 0
+        while time.monotonic() < deadline:
+            try:
+                r = srv.bus.handle(
+                    Topic.MEASURE_WRITE.value,
+                    {"request": {"group": "g", "name": "m2", "points": pts}},
+                )
+                written = r["written"]
+                break
+            except Exception:
+                time.sleep(0.25)
+        assert written == 64, (
+            "worker never caught up on the missed schema push"
+        )
+        while time.monotonic() < deadline:
+            if "w001" in srv.pool.liaison.alive:
+                break
+            time.sleep(0.25)
+        assert "w001" in srv.pool.liaison.alive, (
+            "evicted-but-healthy worker was never re-probed into alive"
+        )
+        ql = (
+            f"SELECT count(v) FROM MEASURE m2 IN g "
+            f"TIME BETWEEN {T0} AND {HI}"
+        )
+        while time.monotonic() < deadline:
+            res = srv.bus.handle(TOPIC_QL, {"ql": ql})["result"]
+            if not res.get("degraded") and int(
+                sum(res["values"].get("count", []))
+            ) == 64:
+                break
+            time.sleep(0.25)
+        res = srv.bus.handle(TOPIC_QL, {"ql": ql})["result"]
+        assert not res.get("degraded")
+        assert int(sum(res["values"].get("count", []))) == 64
+        assert srv.pool.restarts == 0, (
+            "reconcile must not have needed a crash-restart"
+        )
+    finally:
+        srv.stop()
+
+
+# -- process-free unit coverage ----------------------------------------------
+
+
+def test_write_columns_env_codec_round_trip():
+    from banyandb_tpu.cluster import serde
+    from banyandb_tpu.models.measure import DictColumn
+
+    n = 10
+    env = {
+        "group": "g",
+        "name": "m",
+        "ts": base64.b64encode(
+            (T0 + np.arange(n) * 10).astype("<i8").tobytes()
+        ).decode(),
+        "versions": base64.b64encode(
+            np.ones(n, dtype="<i8").tobytes()
+        ).decode(),
+        "tags": {
+            "svc": {
+                "dict": ["a", "b"],
+                "codes": base64.b64encode(
+                    (np.arange(n) % 2).astype("<i4").tobytes()
+                ).decode(),
+            },
+            "plain": [f"p{i}" for i in range(n)],
+        },
+        "fields": {
+            "v": base64.b64encode(
+                np.arange(n, dtype="<f8").tobytes()
+            ).decode()
+        },
+    }
+    cols = serde.write_columns_env_decode(env)
+    assert cols["ts_millis"].tolist() == (T0 + np.arange(n) * 10).tolist()
+    assert isinstance(cols["tags"]["svc"], DictColumn)
+    idx = np.array([1, 3, 4, 8])
+    sliced = serde.write_columns_env_slice(cols, idx)
+    back = serde.write_columns_env_decode(sliced)
+    assert back["ts_millis"].tolist() == cols["ts_millis"][idx].tolist()
+    assert back["versions"].tolist() == [1, 1, 1, 1]
+    assert np.asarray(back["tags"]["svc"].codes).tolist() == (
+        idx % 2
+    ).tolist()
+    assert back["tags"]["plain"] == ["p1", "p3", "p4", "p8"]
+    assert back["fields"]["v"].tolist() == idx.astype(float).tolist()
+
+
+def test_row_and_columnar_routing_agree():
+    """The pool's vectorized router must place every row on the same
+    shard the engine's own write paths use."""
+    from banyandb_tpu.models.measure import (
+        DictColumn,
+        series_ids_for_columns,
+    )
+    from banyandb_tpu.utils import hashing
+
+    name = "m"
+    values = [b"a", b"bb", b"ccc"]
+    codes = np.array([0, 1, 2, 1, 0, 2, 2, 1], dtype=np.int64)
+    sids, _ = series_ids_for_columns(
+        name, [DictColumn(values, codes)], len(codes)
+    )
+    for i, c in enumerate(codes.tolist()):
+        expect = hashing.series_id([name.encode(), values[c]])
+        assert int(sids[i]) == expect
+
+
+def test_relabel_exposition():
+    from banyandb_tpu.cluster.workers import relabel_exposition
+
+    text = (
+        "# HELP x y\n"
+        "banyandb_write_ms_count{model=\"measure\"} 3\n"
+        "banyandb_rss_bytes 12.5\n"
+    )
+    out = relabel_exposition(text, {"worker": "w007"})
+    assert (
+        'banyandb_write_ms_count{model="measure",worker="w007"} 3' in out
+    )
+    assert 'banyandb_rss_bytes{worker="w007"} 12.5' in out
+    assert "# HELP" not in out
+
+
+def test_stage_breakdown_merges_worker_labels():
+    from banyandb_tpu.obs import prom
+
+    text = (
+        'banyandb_query_stage_ms_bucket{stage="gather",worker="w000",le="1"} 2\n'
+        'banyandb_query_stage_ms_bucket{stage="gather",worker="w000",le="+Inf"} 2\n'
+        'banyandb_query_stage_ms_count{stage="gather",worker="w000"} 2\n'
+        'banyandb_query_stage_ms_sum{stage="gather",worker="w000"} 1.0\n'
+        'banyandb_query_stage_ms_bucket{stage="gather",worker="w001",le="1"} 4\n'
+        'banyandb_query_stage_ms_bucket{stage="gather",worker="w001",le="+Inf"} 4\n'
+        'banyandb_query_stage_ms_count{stage="gather",worker="w001"} 4\n'
+        'banyandb_query_stage_ms_sum{stage="gather",worker="w001"} 2.0\n'
+    )
+    out = prom.stage_breakdown(text)
+    assert out["gather"]["count"] == 6, out
