@@ -454,13 +454,29 @@ class WireServices:
             from banyandb_tpu.api.model import TimeRange
             from banyandb_tpu.models import topn as topn_mod
 
-            group = self._one_group(req)
-            rule = next(
-                (r for r in self.registry.list_topn(group) if r.name == req.name),
-                None,
-            )
-            if rule is None:
-                raise KeyError(f"topn rule {req.name} not found")
+            if not req.groups:
+                raise ValueError("groups must be non-empty")
+            # multi-group TopN (reference cross-group rank merge): the
+            # rule must exist in EVERY named group; per-group ranked
+            # lists merge distinct-best per entity below
+            groups = list(req.groups)
+            group = groups[0]
+            rules_by_group = {}
+            for g in groups:
+                r = next(
+                    (
+                        r
+                        for r in self.registry.list_topn(g)
+                        if r.name == req.name
+                    ),
+                    None,
+                )
+                if r is None:
+                    raise KeyError(
+                        f"topn rule {req.name} not found in group {g}"
+                    )
+                rules_by_group[g] = r
+            rule = rules_by_group[group]
             # ranked entities display the SOURCE measure's entity tuple
             # (reference TopNList item shape); conditions filter over
             # entity + rule group-by dims inside query_topn
@@ -485,35 +501,69 @@ class WireServices:
             end = wire.ts_to_millis(req.time_range.end)
             direction = "asc" if req.field_value_sort == 2 else "desc"
             agg = wire._AGG_FN.get(req.agg, "sum")
-            if hasattr(self.measure, "topn_scatter"):
-                # worker-pool facade: result-measure rows are worker-
-                # local, so TopN scatters per-node ranked lists and
-                # concat re-ranks (never a shard-routed query_measure,
-                # which would silently miss rows)
-                scatter = self.measure.topn_scatter({
-                    "group": group,
-                    "name": req.name,
-                    "time_range": [begin, end],
-                    "n": req.top_n or 10,
-                    "direction": direction,
-                    "agg": agg,
-                    "conditions": [list(c) for c in conds],
-                })
-                ranked = [
-                    (tuple(it["entity"]), it["value"])
-                    for it in scatter["items"]
-                ]
-            else:
-                ranked = topn_mod.query_topn(
+            n_top = req.top_n or 10
+
+            # degraded markers accumulate across EVERY group's scatter
+            # (a down worker in any leg makes the merged ranking partial)
+            degraded_nodes: set = set()
+            any_degraded = [False]
+
+            def ranked_for(g: str) -> list:
+                if hasattr(self.measure, "topn_scatter"):
+                    # worker-pool facade: result-measure rows are worker-
+                    # local, so TopN scatters per-node ranked lists and
+                    # concat re-ranks (never a shard-routed query_measure,
+                    # which would silently miss rows)
+                    scatter = self.measure.topn_scatter({
+                        "group": g,
+                        "name": req.name,
+                        "time_range": [begin, end],
+                        "n": n_top,
+                        "direction": direction,
+                        "agg": agg,
+                        "conditions": [list(c) for c in conds],
+                    })
+                    if scatter.get("degraded"):
+                        any_degraded[0] = True
+                        degraded_nodes.update(
+                            scatter.get("unavailable_nodes", [])
+                        )
+                    return [
+                        (tuple(it["entity"]), it["value"])
+                        for it in scatter["items"]
+                    ]
+                return topn_mod.query_topn(
                     self.measure,
-                    group,
+                    g,
                     req.name,
                     TimeRange(begin, end),
-                    n=req.top_n or 10,
+                    n=n_top,
                     direction=direction,
                     agg=agg,
                     conditions=tuple(conds),
                 )
+
+            if len(groups) == 1:
+                ranked = ranked_for(group)
+            else:
+                # cross-group rank merge: distinct-best per displayed
+                # entity across groups, then one re-rank with the same
+                # (value, entity) tie-break the per-group path uses
+                best: dict[tuple, float] = {}
+                for g in groups:
+                    for entity, value in ranked_for(g):
+                        cur = best.get(entity)
+                        if cur is None or (
+                            value > cur
+                            if direction == "desc"
+                            else value < cur
+                        ):
+                            best[entity] = value
+                ranked = sorted(
+                    best.items(),
+                    key=lambda kv: (kv[1], kv[0]),
+                    reverse=(direction == "desc"),
+                )[:n_top]
             # the output value is typed like the SOURCE measure's field
             # (int64 aggregation stays integral, mean truncates)
             as_int = False
@@ -535,16 +585,14 @@ class WireServices:
                         int(value) if as_int else float(value)
                     )
                 )
-            if hasattr(self.measure, "topn_scatter") and scatter.get(
-                "degraded"
-            ):
-                # a down worker leg makes the ranking partial: surface
-                # it in-band like every degraded query (wire contract)
+            if any_degraded[0]:
+                # a down worker leg in ANY group makes the ranking
+                # partial: surface it in-band like every degraded query
                 from types import SimpleNamespace
 
                 wire.fill_degraded(out, SimpleNamespace(
                     degraded=True,
-                    unavailable_nodes=scatter.get("unavailable_nodes", []),
+                    unavailable_nodes=sorted(degraded_nodes),
                 ))
             return out
         except Exception as e:  # noqa: BLE001

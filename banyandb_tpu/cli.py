@@ -41,6 +41,60 @@ def _parse_specs(spec: str) -> list[dict]:
     return out
 
 
+def render_explain(reply: dict) -> str:
+    """Deterministic text rendering of one traced query reply: the
+    logical plan tree, the serve path, and the adaptive planner's
+    decision with estimated vs actual rows (query/planner).  No
+    durations — the output is pinned by goldens
+    (tests/test_planner.py)."""
+    from banyandb_tpu.obs.tracer import find_span
+
+    trace = (reply.get("result") or {}).get("trace") or {}
+    tree = trace.get("span_tree") or {}
+    served = reply.get("served", "scan")
+    lines = ["plan:"]
+    plan_text = trace.get("plan") or "(no plan text)"
+    lines.extend("  " + ln for ln in plan_text.splitlines())
+    pspan = find_span(tree, "planner")
+    ptags = (pspan or {}).get("tags") or {}
+    rspan = find_span(tree, "reduce")
+    rtags = (rspan or {}).get("tags") or {}
+    # executed path: the reduce span's ground truth when a scan ran,
+    # else the serve class (materialized fold / cache replay)
+    path = rtags.get("path") if served == "scan" else served
+    lines.append(f"path: {path or served} (served: {served})")
+    if pspan is not None:
+        est = ptags.get("est_rows", "-")
+        actual = ptags.get("actual_rows", "-")
+        lines.append("planner:")
+        lines.append(f"  estimated rows: {est}  actual rows: {actual}")
+        lines.append(
+            f"  estimated groups: {ptags.get('est_groups', '-')}"
+            f"  group method: {ptags.get('group_method', 'auto')}"
+        )
+        lines.append(
+            f"  selectivity: {ptags.get('selectivity', '-')}"
+            f"  zone pre-pass: "
+            f"{'on' if ptags.get('zone_prepass') else 'off'}"
+            f"  parts: {ptags.get('parts', '-')}"
+        )
+    else:
+        lines.append(
+            "planner: (no scan planned — materialized fold, cache "
+            "replay, raw rows, or BYDB_PLANNER=0)"
+        )
+    sspan = find_span(tree, "streamagg")
+    if sspan is not None and (sspan.get("tags") or {}).get("signature"):
+        st = sspan["tags"]
+        lines.append("materialized:")
+        lines.append(f"  signature: {st.get('signature')}")
+        lines.append(
+            f"  coverage: {st.get('coverage')}"
+            f"  windows: {st.get('windows', '-')}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("bydbctl (banyandb-tpu)")
     ap.add_argument("--addr", default="127.0.0.1:17912")
@@ -82,6 +136,14 @@ def main(argv=None) -> int:
 
     q = sub.add_parser("query")
     q.add_argument("ql", help="BydbQL text")
+
+    ex = sub.add_parser(
+        "explain",
+        help="run a BydbQL query traced and render the adaptive "
+        "planner's decision: chosen path, estimated vs actual rows, "
+        "plan tree (docs/performance.md 'Adaptive planner')",
+    )
+    ex.add_argument("ql", help="BydbQL text")
 
     sl = sub.add_parser(
         "slowlog",
@@ -216,6 +278,9 @@ def main(argv=None) -> int:
         print(json.dumps(_call(args, Topic.MEASURE_WRITE.value, env)))
     elif args.cmd == "query":
         print(json.dumps(_call(args, TOPIC_QL, {"ql": args.ql}), indent=1))
+    elif args.cmd == "explain":
+        reply = _call(args, TOPIC_QL, {"ql": args.ql, "trace": True})
+        print(render_explain(reply))
     elif args.cmd == "slowlog":
         env = {"limit": args.limit}
         if args.clear:

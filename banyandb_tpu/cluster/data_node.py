@@ -168,8 +168,18 @@ class DataNode:
                 fields=tuple(env.get("fields", ())),
                 window_millis=env.get("window_millis"),
                 max_windows=env.get("max_windows"),
+                origin=env.get("origin", "manual"),
             )
             return {"registered": info, "node": self.name}
+        if op == "unregister":
+            removed = self.measure.streamagg.unregister(
+                env["group"],
+                env["measure"],
+                key_tags=tuple(env.get("key_tags", ())),
+                fields=tuple(env.get("fields", ())),
+                window_millis=env.get("window_millis"),
+            )
+            return {"unregistered": removed, "node": self.name}
         if op == "stats":
             return {
                 "streamagg": self.measure.streamagg.stats(),

@@ -344,6 +344,7 @@ class Liaison:
         fields,
         window_millis: Optional[int] = None,
         max_windows: Optional[int] = None,
+        origin: str = "manual",
     ) -> dict[str, dict]:
         """Broadcast one materialized dashboard signature to every alive
         data node (windows are node-local per shard; each node backfills
@@ -358,6 +359,7 @@ class Liaison:
             "fields": list(fields),
             "window_millis": window_millis,
             "max_windows": max_windows,
+            "origin": origin,
         }
         key = (
             group, measure, tuple(sorted(key_tags)),
@@ -380,6 +382,48 @@ class Liaison:
             )
             with self._streamagg_lock:
                 self._streamagg_sent.setdefault(n.name, set()).add(key)
+        return acks
+
+    def unregister_streamagg(
+        self,
+        group: str,
+        measure: str,
+        key_tags,
+        fields,
+        window_millis: Optional[int] = None,
+    ) -> dict[str, dict]:
+        """Broadcast a signature drop (the autoreg eviction path) and
+        FORGET the remembered registration so probe() stops re-sending
+        it to rejoining nodes.  -> {node: ack}."""
+        env = {
+            "op": "unregister",
+            "group": group,
+            "measure": measure,
+            "key_tags": list(key_tags),
+            "fields": list(fields),
+            "window_millis": window_millis,
+        }
+        with self._streamagg_lock:
+            drop = [
+                key
+                for key in self._streamagg_regs
+                if key[0] == group
+                and key[1] == measure
+                and key[2] == tuple(sorted(key_tags))
+                and key[3] == tuple(sorted(fields))
+                and (window_millis is None or key[4] == window_millis)
+            ]
+            for key in drop:
+                self._streamagg_regs.pop(key, None)
+                for sent in self._streamagg_sent.values():
+                    sent.discard(key)
+        acks: dict[str, dict] = {}
+        for n in self.selector.nodes:
+            if n.name not in self.alive:
+                continue
+            acks[n.name] = self.transport.call(
+                n.addr, "streamagg", env, timeout=_RPC_SYNC_S
+            )
         return acks
 
     # -- liaison write queue (wqueue.go:75 analog) --------------------------

@@ -834,8 +834,23 @@ class WorkerPool:
                 fields=tuple(env.get("fields", ())),
                 window_millis=env.get("window_millis"),
                 max_windows=env.get("max_windows"),
+                origin=env.get("origin", "manual"),
             )
             return {"registered": acks}
+        if op == "unregister":
+            acks = self.liaison.unregister_streamagg(
+                env["group"],
+                env["measure"],
+                key_tags=tuple(env.get("key_tags", ())),
+                fields=tuple(env.get("fields", ())),
+                window_millis=env.get("window_millis"),
+            )
+            return {
+                "unregistered": any(
+                    a.get("unregistered") for a in acks.values()
+                ),
+                "acks": acks,
+            }
         if op == "stats":
             out = {}
             for i in range(self.n):

@@ -347,7 +347,7 @@ class MeasureEngine:
                         (p.ts_millis, sid, version, shard, tag_bytes, field_vals)
                     )
                 if not _internal:
-                    self.topn.observe(m, p)
+                    self.topn.observe(m, p, sid=sid, version=version)
             if sa_rows:
                 self._observe_streamagg_rows(m, sa_rows)
         finally:
@@ -613,7 +613,10 @@ class MeasureEngine:
                             sel_fields,
                         )
                     )
-            self.topn.observe_columns(m, ts_millis, tags, num_fields)
+            self.topn.observe_columns(
+                m, ts_millis, tags, num_fields,
+                sids=sids, versions=versions,
+            )
             if self.streamagg.active(group, name):
 
                 def _sa_tag(t: str) -> np.ndarray:
@@ -682,7 +685,7 @@ class MeasureEngine:
         is the single owner of index-mode short-circuit and aggregate-vs-
         raw selection; this method lowers the tree onto the fused
         executors."""
-        from banyandb_tpu.query import logical
+        from banyandb_tpu.query import logical, planner
 
         own_tracer = tracer is None and req.trace
         if own_tracer:
@@ -699,7 +702,8 @@ class MeasureEngine:
         # whose (signature, time range, group-by) is covered by rolling
         # windows folds states instead of rescanning parts; partial
         # head/tail windows rescan ONLY the uncovered sub-ranges.
-        if plan.find("GroupByAggregate") is not None and not m.index_mode:
+        is_agg = plan.find("GroupByAggregate") is not None
+        if is_agg and not m.index_mode:
             cover = self.streamagg.plan_cover(m, req)
             if cover is not None:
                 res = self._query_materialized(
@@ -707,8 +711,40 @@ class MeasureEngine:
                     t_start, own_tracer,
                 )
                 if res is not None:
+                    if planner.enabled():
+                        planner.record_decision("materialized")
                     return res
                 # coverage lost (window evicted mid-plan): full rescan
+        # Cost-based scan planning (query/planner, BYDB_PLANNER): the
+        # pre-gather estimate decides group-by strategy, the fused chunk
+        # schedule, and whether the zone-map pre-pass is worth running.
+        # All decisions are result-preserving — BYDB_PLANNER=0 restores
+        # the fixed thresholds with byte-identical output.
+        decision = None
+        pspan = None
+        if (
+            is_agg
+            and not m.index_mode
+            and plan.leaf().kind != "IndexModeScan"
+            and planner.enabled()
+        ):
+            with t.span("planner") as pspan:
+                decision = planner.plan_scan(
+                    self, db, m, req,
+                    span=pspan if tracer is not None else None,
+                )
+        # hidden (indexed non-entity) tags resolve BEFORE the gather:
+        # their per-row stored values are superseded by the latest-
+        # write-wins series join (_join_hidden_tags), so block pruning
+        # must never use them — a block whose stored values all fail a
+        # hidden-tag predicate may still hold rows whose JOINED value
+        # matches (and vice versa its rows may carry the series' newest
+        # value that other blocks need)
+        hidden = (
+            self._hidden_index_tags(group, req.name, m)
+            if not is_agg and not m.index_mode
+            else set()
+        )
         t_pg = time.perf_counter()  # stage metric covers ONLY part gather
         with t.span("part_gather") as gs:
             if plan.leaf().kind == "IndexModeScan":
@@ -723,7 +759,13 @@ class MeasureEngine:
                 for attempt in range(3):
                     try:
                         sources = self._gather_sources(
-                            db, m, req, shard_ids=shard_ids
+                            db, m, req, shard_ids=shard_ids,
+                            zone_prepass=(
+                                decision.zone_prepass
+                                if decision is not None
+                                else True
+                            ),
+                            zone_exclude=hidden,
                         )
                         break
                     except FileNotFoundError:
@@ -736,17 +778,26 @@ class MeasureEngine:
         _H_PART_GATHER.observe((t_gather - t_pg) * 1000)
         analyzers = self._tag_analyzers(group, req.name)
         try:
-            if plan.find("GroupByAggregate") is not None:
+            if is_agg:
                 with t.span("execute") as es:
                     res = measure_exec.execute_aggregate(
                         m, req, sources,
                         dict_state=self._dict_state(group, req.name),
                         analyzers=analyzers,
                         span=es if tracer is not None else None,
+                        plan_hints=decision,
                     )
+                if decision is not None:
+                    # est-vs-actual on the (already closed) planner span:
+                    # tags serialize when the tree renders, at query end
+                    if decision.actual_rows is not None and pspan is not None:
+                        pspan.tag("actual_rows", decision.actual_rows)
+                    planner.record_decision(decision.path)
             else:
                 with t.span("execute") as es:
                     es.tag("path", "raw_rows")
+                    if hidden:
+                        sources = _join_hidden_tags(sources, hidden)
                     res = _raw_rows(m, req, sources, analyzers=analyzers)
         finally:
             # observed on error paths too (stream/trace/property parity:
@@ -846,6 +897,8 @@ class MeasureEngine:
 
         `tracer`: the data node's own span sink — its finished tree rides
         the RPC reply back to the liaison for the cluster-wide merge."""
+        from banyandb_tpu.query import planner
+
         t = tracer if tracer is not None else NOOP_TRACER
         t0 = time.perf_counter()
         group = req.groups[0]
@@ -881,11 +934,28 @@ class MeasureEngine:
                             _H_QUERY.observe(
                                 (time.perf_counter() - t0) * 1000
                             )
+                        if planner.enabled():
+                            planner.record_decision("materialized")
                         return out
                 # coverage lost mid-plan: fall through to the rescan
+        # the data-node side of cost-based planning: same estimate, same
+        # result-preserving hints, per-node planner span in the graft
+        decision = None
+        pspan = None
+        if not m.index_mode and planner.enabled():
+            with t.span("planner") as pspan:
+                decision = planner.plan_scan(
+                    self, self._tsdb(group), m, req,
+                    span=pspan if tracer is not None else None,
+                )
         t_pg = time.perf_counter()  # stage metric covers ONLY part gather
         with t.span("part_gather") as gs:
-            sources = self.gather_query_sources(req, shard_ids=shard_ids)
+            sources = self.gather_query_sources(
+                req, shard_ids=shard_ids,
+                zone_prepass=(
+                    decision.zone_prepass if decision is not None else True
+                ),
+            )
             gs.tag("sources", len(sources)).tag(
                 "rows", sum(int(s.ts.size) for s in sources)
             ).tag("shards", sorted(shard_ids) if shard_ids else "all")
@@ -908,10 +978,36 @@ class MeasureEngine:
                         dict_state=self._dict_state(group, req.name),
                         analyzers=analyzers,
                         span=span,
+                        plan_hints=decision,
                     )
+            if decision is not None:
+                if decision.actual_rows is not None and pspan is not None:
+                    pspan.tag("actual_rows", decision.actual_rows)
+                planner.record_decision(decision.path)
         finally:
             _H_QUERY.observe((time.perf_counter() - t0) * 1000)
         return out
+
+    def _hidden_index_tags(self, group: str, name: str, m: Measure) -> set:
+        """Indexed NON-ENTITY tags (the reference's 'hidden' tags): the
+        reference stores them as series-level metadata docs where the
+        latest-ts write wins and joins them onto every row of the
+        series (write_standalone.go metadataDocs).  This engine stores
+        tags per row, so the raw retrieval path applies the same
+        latest-write-wins join explicitly (_join_hidden_tags)."""
+        out: set = set()
+        try:
+            rules = {r.name: r for r in self.registry.list_index_rules(group)}
+            for b in self.registry.list_index_rule_bindings(group):
+                if b.subject_name != name:
+                    continue
+                for rn in b.rules:
+                    r = rules.get(rn)
+                    if r is not None:
+                        out.update(r.tags)
+        except Exception:  # noqa: BLE001 — registries without bindings
+            return set()
+        return out - set(m.entity.tag_names)
 
     def _tag_analyzers(self, group: str, name: str) -> dict[str, str]:
         """tag -> analyzer from index rules BOUND to this measure (the
@@ -933,12 +1029,16 @@ class MeasureEngine:
             pass
         return out
 
-    def gather_query_sources(self, req, shard_ids=None, serial=False):
+    def gather_query_sources(
+        self, req, shard_ids=None, serial=False, zone_prepass=True
+    ):
         """Source selection for the map phase, shared by the host partial
         path, the mesh fast path (parallel/mesh_query.py) and the
         streamagg bounded rescans (`serial=True` skips the part
         prefetch thread): same segment/series pruning, same retry on
-        concurrently-merged parts."""
+        concurrently-merged parts.  ``zone_prepass=False`` (planner
+        decision: estimated selectivity ~1) skips the zone-map block
+        pre-pass — identical rows, no per-part predicate lowering."""
         group = req.groups[0]
         m = self.registry.get_measure(group, req.name)
         db = self._tsdb(group)
@@ -947,7 +1047,8 @@ class MeasureEngine:
         for attempt in range(3):
             try:
                 return self._gather_sources(
-                    db, m, req, shard_ids=shard_ids, serial=serial
+                    db, m, req, shard_ids=shard_ids, serial=serial,
+                    zone_prepass=zone_prepass,
                 )
             except FileNotFoundError:
                 if attempt == 2:
@@ -984,6 +1085,8 @@ class MeasureEngine:
         req: QueryRequest,
         shard_ids=None,
         serial: bool = False,
+        zone_prepass: bool = True,
+        zone_exclude: set = frozenset(),
     ) -> list[ColumnData]:
         """Collect per-source decode thunks (metadata-only work: segment
         selection, series-index pruning, block selection), then evaluate
@@ -1005,9 +1108,24 @@ class MeasureEngine:
         # conjunctive eq/in tag predicates prune at BLOCK granularity
         # against the per-block code zone maps written at flush/merge —
         # a skipped block is never read, let alone decoded.
+        # ``zone_prepass=False`` is the planner's ~1-selectivity call:
+        # nothing would skip, so the per-part dict lowering + per-block
+        # interval checks are pure overhead (results identical — zone
+        # skipping only ever removes reads of non-matching blocks)
         zone_conds = (
-            _conjunctive_eq_conditions(req) if enc_mod.zone_skip_enabled() else []
+            _conjunctive_eq_conditions(req)
+            if (enc_mod.zone_skip_enabled() and zone_prepass)
+            else []
         )
+        if zone_exclude:
+            # hidden-tag predicates evaluate against the JOINED series
+            # value, never the stored per-row one — block pruning on
+            # them would drop rows the join makes match
+            zone_conds = [
+                (name, vals)
+                for name, vals in zone_conds
+                if name not in zone_exclude
+            ]
         for seg in db.select_segments(
             req.time_range.begin_millis, req.time_range.end_millis
         ):
@@ -1212,6 +1330,65 @@ class _MultiMeasureMemtable:
         return dict(self._tables)
 
 
+def _join_hidden_tags(
+    sources: list[ColumnData], hidden: set
+) -> list[ColumnData]:
+    """Latest-write-wins join for hidden (indexed non-entity) tags:
+    compute each series' newest value per hidden tag across the
+    gathered sources — (ts, version)-max, the write path's own
+    ordering — and rewrite every row of that series to carry it, so
+    filters AND projections see the joined value exactly like the
+    reference's series-metadata docs.  Scoped to the gathered (time-
+    pruned) sources: a rewrite outside the queried range is invisible
+    here, which matches block pruning's visibility everywhere else."""
+    import dataclasses as _dc
+
+    latest: dict[str, dict[int, tuple]] = {t: {} for t in hidden}
+    for src in sources:
+        for t in hidden:
+            col = src.tags.get(t)
+            if col is None:
+                continue
+            d = src.dicts[t]
+            for i in range(src.ts.shape[0]):
+                sid = int(src.series[i])
+                stamp = (int(src.ts[i]), int(src.version[i]))
+                cur = latest[t].get(sid)
+                if cur is None or stamp > cur[0]:
+                    latest[t][sid] = (stamp, d[int(col[i])])
+    if not any(latest[t] for t in hidden):
+        return sources
+    out = []
+    for src in sources:
+        tags = dict(src.tags)
+        dicts = dict(src.dicts)
+        changed = False
+        for t in hidden:
+            by_sid = latest[t]
+            if not by_sid and t not in tags:
+                continue
+            vals = sorted({v for _, v in by_sid.values()} | {b""})
+            vidx = {v: i for i, v in enumerate(vals)}
+            codes = np.fromiter(
+                (
+                    vidx[by_sid[int(s)][1]] if int(s) in by_sid else 0
+                    for s in src.series
+                ),
+                dtype=np.int32,
+                count=src.series.shape[0],
+            )
+            tags[t] = codes
+            dicts[t] = vals
+            changed = True
+        if not changed:
+            out.append(src)
+            continue
+        out.append(
+            _dc.replace(src, tags=tags, dicts=dicts, cache_key=None)
+        )
+    return out
+
+
 def _raw_rows(
     m: Measure,
     req: QueryRequest,
@@ -1360,58 +1537,22 @@ def _entity_eq_conditions(m: Measure, req: QueryRequest):
     return out
 
 
+# Moved into the query layer (the cost-based planner estimates from the
+# same lowering); lazily re-exported here for the gather path + existing
+# tests (function-local import per the layering policy — models sits
+# BELOW query in the layer map).
+
+
 def _conjunctive_eq_conditions(req: QueryRequest):
-    """[(tag, [byte values])] from eq/in conditions that are REQUIRED
-    (pure-AND criteria tree).  Any OR anywhere disables zone pruning —
-    a disjunct must never skip blocks its sibling could match."""
-    try:
-        conds = measure_exec._collect_conditions(req.criteria)
-    except NotImplementedError:
-        return []
-    out = []
-    for c in conds:
-        try:
-            if c.op == "eq":
-                out.append((c.name, [measure_exec._tag_value_bytes(c.value)]))
-            elif c.op == "in":
-                out.append(
-                    (c.name, [measure_exec._tag_value_bytes(v) for v in c.value])
-                )
-        except TypeError:
-            continue  # unsupported literal type: no pruning on this cond
-    return out
+    from banyandb_tpu.query.planner import conjunctive_eq_conditions
+
+    return conjunctive_eq_conditions(req)
 
 
 def _part_zone_preds(part, zone_conds) -> list:
-    """Lower conjunctive eq/in tag conditions onto ONE part's local
-    dictionary -> zone_preds for select_blocks.
+    from banyandb_tpu.query.planner import part_zone_preds
 
-    The zone maps store per-block LOCAL code ranges, so each predicate
-    value resolves to this part's local code first.  A part whose
-    dictionary holds NONE of a required predicate's values cannot match
-    at all — expressed as an EMPTY allowed set, which marks every block
-    (select_blocks still applies the dedup-safety overlap check before
-    any block actually skips).  A tag column absent from the part
-    entirely means every row carries the implicit empty value, so only
-    an explicit empty-value predicate can match.
-    """
-    if not zone_conds:
-        return []
-    none_match = [("*", np.zeros(0, dtype=np.int64))]
-    preds: list = []
-    part_tags = set(part.meta.get("tags", ()))
-    for name, values in zone_conds:
-        if name not in part_tags:
-            # schema evolution: rows carry the empty value for this tag
-            if b"" not in values:
-                return none_match
-            continue
-        lut = part.dict_index(name)  # cached reverse map
-        codes = sorted({lut[v] for v in values if v in lut})
-        if not codes:
-            return none_match
-        preds.append((f"tag_{name}", np.asarray(codes, dtype=np.int64)))
-    return preds
+    return part_zone_preds(part, zone_conds)
 
 
 # -- index-mode measures (doc-per-point in the series index) ---------------

@@ -156,11 +156,38 @@ def result_measure_schema(group: str) -> Measure:
     )
 
 
+_VERSION_ROWS_CAP_DEFAULT = 1 << 18
+
+
+def _version_rows_cap() -> int:
+    """Per-window bound on the (series, ts) -> last-version tracking
+    table (version-merge exactness, see _Window.rows).  0 disables."""
+    import os
+
+    try:
+        return int(
+            os.environ.get(
+                "BYDB_TOPN_VERSION_ROWS", _VERSION_ROWS_CAP_DEFAULT
+            )
+        )
+    except ValueError:
+        return _VERSION_ROWS_CAP_DEFAULT
+
+
 @dataclass
 class _Window:
     start: int
     sums: dict  # entity tuple -> [sum, count]
     dirty: bool = True  # has un-emitted accumulation
+    # (series, ts) -> (version, entity tuple, value): last-version
+    # tracking so a REWRITE of the same (series, ts) REPLACES its
+    # earlier contribution instead of adding (the reference
+    # version-merges rows before feeding counters).  Bounded by
+    # BYDB_TOPN_VERSION_ROWS per window; past the cap the table drops
+    # (rows=None) and accumulation degrades to additive — exactness
+    # for the dashboard-scale windows goldens exercise, bounded memory
+    # under firehose ingest.
+    rows: "Optional[dict]" = None
 
 
 class TopNProcessorManager:
@@ -203,6 +230,9 @@ class TopNProcessorManager:
         # storage/registry lock family for no benefit — result-measure
         # (series, window) version dedup makes drain order irrelevant
         self._pending_emits: list[tuple[str, tuple]] = []
+        # read ONCE: _accumulate runs per ingested row under _obs_lock —
+        # an env parse there would be pure hot-loop overhead
+        self._version_rows_cap = _version_rows_cap()
 
     def _cached_criteria(self, key: tuple, rule: TopNAggregation):
         hit = self._crit_cache.get(key)
@@ -212,13 +242,72 @@ class TopNProcessorManager:
         self._crit_cache[key] = (rule.criteria, parsed)
         return parsed
 
-    def observe(self, m: Measure, p: DataPointValue) -> None:
+    def _accumulate(
+        self, win: _Window, rule: TopNAggregation, ent: tuple,
+        value: float, sid, ts_millis: int, version,
+    ) -> bool:
+        """Version-merged window accumulation: a REWRITE of the same
+        (series, ts) with a higher version REPLACES its earlier
+        contribution (the reference version-merges rows before feeding
+        counters); an older/equal version loses, matching the storage
+        plane's max-version dedup.  The superseded contribution is
+        retracted even when the NEW entity cannot claim a counter slot
+        (bounded counters) — the dead version must never keep ranking;
+        the row record then carries ent=None so a later rewrite has
+        nothing further to retract.  Tracking is per-window bounded
+        (BYDB_TOPN_VERSION_ROWS) — past the cap the table drops and
+        accumulation degrades to additive.  -> True when window state
+        changed."""
+        rkey = prev = None
+        if sid is not None and win.rows is not None:
+            rkey = (sid, ts_millis)
+            prev = win.rows.get(rkey)
+            if (
+                prev is not None
+                and version is not None
+                and version <= prev[0]
+            ):
+                return False  # stale rewrite loses
+        if prev is not None and prev[1] is not None:
+            pacc = win.sums.get(prev[1])
+            if pacc is not None:
+                # retract the superseded version's contribution (the
+                # acc may reach count 0: _emit skips empty counters)
+                pacc[0] -= prev[2]
+                pacc[1] -= 1
+        acc = win.sums.get(ent)
+        if acc is None:
+            if len(win.sums) >= rule.counters_number:
+                # bounded counters (heap-capacity analog): the new
+                # version is uncounted, but the retraction above stands
+                if rkey is not None:
+                    win.rows[rkey] = (version or 0, None, 0.0)
+                return prev is not None
+            acc = win.sums[ent] = [0.0, 0]
+        acc[0] += value
+        acc[1] += 1
+        if rkey is not None:
+            win.rows[rkey] = (version or 0, ent, value)
+            if len(win.rows) > self._version_rows_cap:
+                win.rows = None  # cap: additive from here on
+        return True
+
+    def _new_window(self, start: int) -> _Window:
+        return _Window(
+            start, {}, rows={} if self._version_rows_cap > 0 else None
+        )
+
+    def observe(
+        self, m: Measure, p: DataPointValue, sid=None, version=None
+    ) -> None:
         """Feed one written point through all TopN rules of its measure."""
         with self._obs_lock:
-            self._observe_locked(m, p)
+            self._observe_locked(m, p, sid, version)
         self._drain_emits()
 
-    def _observe_locked(self, m: Measure, p: DataPointValue) -> None:
+    def _observe_locked(
+        self, m: Measure, p: DataPointValue, sid=None, version=None
+    ) -> None:
         for rule in self.engine.registry.list_topn(m.group):
             if rule.source_measure != m.name:
                 continue
@@ -239,21 +328,19 @@ class TopNProcessorManager:
             wins = self._windows[key]
             win = wins.get(start)
             if win is None:
-                win = wins[start] = _Window(start, {})
+                win = wins[start] = self._new_window(start)
                 self._evict_over_lru(key, rule)
             # counters key = entity tags + extra group-by dims (results
             # display the entity prefix; extras serve conditions)
             ent = tuple(
                 _key_str(p.tags.get(t)) for t in rule_key_tags(rule, m)
             )
-            acc = win.sums.get(ent)
-            if acc is None:
-                if len(win.sums) >= rule.counters_number:
-                    continue  # bounded counters (heap-capacity analog)
-                acc = win.sums[ent] = [0.0, 0]
-            acc[0] += float(p.fields.get(rule.field_name, 0))
-            acc[1] += 1
-            win.dirty = True
+            if self._accumulate(
+                win, rule, ent,
+                float(p.fields.get(rule.field_name, 0)),
+                sid, p.ts_millis, version,
+            ):
+                win.dirty = True
             wm = self._watermark.get(key, 0)
             if p.ts_millis > wm:
                 self._watermark[key] = p.ts_millis
@@ -268,19 +355,27 @@ class TopNProcessorManager:
             if win.dirty:
                 self._emit(key[0], rule, win)
 
-    def observe_columns(self, m: Measure, ts_millis, tags, fields) -> None:
+    def observe_columns(
+        self, m: Measure, ts_millis, tags, fields, sids=None, versions=None
+    ) -> None:
         """Columnar twin of observe(): feed a bulk write's columns through
         all TopN rules of its measure (closes the row-vs-bulk semantic
         split, ref one-write-path banyand/measure/write_standalone.go:348).
 
         Measures with no rules pay one registry scan and return; rule
         accumulation matches observe() row-for-row (same window routing,
-        late-drop, counters bound, watermark and flush behavior)."""
+        late-drop, counters bound, watermark and flush behavior).
+        ``sids``/``versions`` enable version-merged accumulation
+        (_accumulate): rewrites of the same (series, ts) replace."""
         with self._obs_lock:
-            self._observe_columns_locked(m, ts_millis, tags, fields)
+            self._observe_columns_locked(
+                m, ts_millis, tags, fields, sids, versions
+            )
         self._drain_emits()
 
-    def _observe_columns_locked(self, m: Measure, ts_millis, tags, fields) -> None:
+    def _observe_columns_locked(
+        self, m: Measure, ts_millis, tags, fields, sids=None, versions=None
+    ) -> None:
         import numpy as np
 
         rules = [
@@ -301,6 +396,16 @@ class TopNProcessorManager:
         # string columns memoized per tag)
         starts_all = (ts - (ts % self.window_millis)).tolist()
         tsl = ts.tolist()
+        sidl = (
+            np.asarray(sids, dtype=np.int64).tolist()
+            if sids is not None
+            else None
+        )
+        verl = (
+            np.asarray(versions, dtype=np.int64).tolist()
+            if versions is not None
+            else None
+        )
         str_cols: dict[str, list] = {}
 
         def col_of(t: str) -> list:
@@ -347,17 +452,16 @@ class TopNProcessorManager:
                 start = starts[i]
                 win = wins.get(start)
                 if win is None:
-                    win = wins[start] = _Window(start, {})
+                    win = wins[start] = self._new_window(start)
                     self._evict_over_lru(key, rule)
                 ent = tuple(c[i] for c in cols)
-                acc = win.sums.get(ent)
-                if acc is None:
-                    if len(win.sums) >= rule.counters_number:
-                        continue  # bounded counters (heap-capacity analog)
-                    acc = win.sums[ent] = [0.0, 0]
-                acc[0] += fvals[i]
-                acc[1] += 1
-                win.dirty = True
+                if self._accumulate(
+                    win, rule, ent, fvals[i],
+                    sidl[i] if sidl is not None else None,
+                    tsl[i],
+                    verl[i] if verl is not None else None,
+                ):
+                    win.dirty = True
                 if tsl[i] > wm:
                     wm = tsl[i]
             self._watermark[key] = wm
@@ -405,7 +509,14 @@ class TopNProcessorManager:
             else (rule.field_value_sort,)
         )
         points = []
-        ranked = sorted(win.sums.items(), key=lambda kv: kv[1][0])
+        # count-0 counters are fully-retracted version-merge residue:
+        # an entity with no surviving rows must not rank (its earlier
+        # emission, if any, is replaced by nothing — acceptable residue,
+        # the re-emit path only covers entities that still exist)
+        ranked = sorted(
+            (kv for kv in win.sums.items() if kv[1][1] > 0),
+            key=lambda kv: kv[1][0],
+        )
         for direction in directions:
             chosen = (
                 ranked[-rule.counters_number :][::-1]

@@ -7,8 +7,17 @@ newest entries are retrievable live via ``cli.py slowlog``, the
 (the reference's slow-query log, banyand/dquery/measure.go:169, grown
 into a flight recorder).
 
+``SignatureStats`` is the recorder plane's second table: a bounded
+per-query-signature hit counter fed by the server query epilogue for
+EVERY measure query (slow queries count double — they are the ones
+materialization helps most).  The auto-registration loop
+(query/planner.AutoRegistrar) mines it each tick to find hot
+streamagg-eligible signatures; it holds no span trees, just
+(group, measure, key_tags, fields) -> hits.
+
 Bounded by construction (``BYDB_SLOWLOG_CAPACITY`` entries, oldest
-evicted) so a pathological workload cannot grow it without limit.
+evicted; ``SignatureStats`` caps distinct signatures and drops the
+coldest) so a pathological workload cannot grow either without limit.
 """
 
 from __future__ import annotations
@@ -66,6 +75,41 @@ class SlowQueryRecorder:
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)
+
+
+class SignatureStats:
+    """Bounded per-signature query counter (the autoreg evidence
+    table).  Keys are the planner's signature tuples
+    ``(group, measure, key_tags, fields)``; values are cumulative hit
+    counts (monotonic — the miner diffs against its last snapshot).
+
+    Capacity-bounded: past ``cap`` distinct signatures the coldest
+    (lowest-count) entry is dropped, so churn-heavy ad-hoc query
+    populations cannot grow the table without limit while a steady
+    dashboard signature keeps accumulating."""
+
+    def __init__(self, cap: int = 512):
+        self.cap = max(int(cap), 8)
+        self._lock = threading.Lock()
+        self._hits: dict[tuple, int] = {}
+
+    def observe(self, key: Optional[tuple], weight: int = 1) -> None:
+        if key is None:
+            return
+        with self._lock:
+            n = self._hits.get(key)
+            if n is None and len(self._hits) >= self.cap:
+                coldest = min(self._hits, key=self._hits.get)
+                del self._hits[coldest]
+            self._hits[key] = (n or 0) + weight
+
+    def snapshot(self) -> dict[tuple, int]:
+        with self._lock:
+            return dict(self._hits)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._hits)
 
 
 # one per process by default (all server roles in a process share it,

@@ -153,11 +153,26 @@ def estimate_bytes(spec: PlanSpec, num_chunks: int) -> int:
     return num_chunks * (per_row * spec.nrows + 4 * per_chunk_out)
 
 
-def eligible(spec: PlanSpec, n_chunks: int) -> bool:
+def _resolve_bucket(n_chunks: int, min_bucket: int | None) -> int:
+    """The chunk-count bucket for a part-batch, honoring the planner's
+    minimum-bucket hint.  The hint only ever rounds UP (padding chunks
+    are fully invalid, the host absorbs only real ones — byte-identical)
+    and is capped at one doubling of the actual bucket: the hint exists
+    for part populations oscillating around a bucket boundary, not to
+    pad a 1-chunk batch into a 64-chunk program."""
+    bucket = chunk_count_bucket(n_chunks)
+    if min_bucket is not None and bucket < min_bucket <= bucket * 2:
+        return min_bucket
+    return bucket
+
+
+def eligible(
+    spec: PlanSpec, n_chunks: int, min_bucket: int | None = None
+) -> bool:
     """Fused path taken for this part-batch?  Flag + footprint budget."""
     if n_chunks < 1 or not fused_enabled():
         return False
-    bucket = chunk_count_bucket(n_chunks)
+    bucket = _resolve_bucket(n_chunks, min_bucket)
     return estimate_bytes(spec, bucket) <= max_fused_mb() * (1 << 20)
 
 
@@ -324,15 +339,17 @@ def run_fused(
     dev_cache=None,
     pad_ship_s: list | None = None,
     ship_stats: list | None = None,
+    min_bucket: int | None = None,
 ) -> tuple[list[dict], float, str]:
     """Execute one part-batch through the fused program.
 
     -> (per-chunk host partials in scan order for the staged f64 absorb
     loop, seconds spent at the two accelerator boundaries, input-cache
     outcome tag).  Exactly one kernel dispatch and one batched
-    device_get regardless of chunk count.
+    device_get regardless of chunk count.  ``min_bucket`` (planner
+    hint) rounds the chunk-count bucket up — see ``_resolve_bucket``.
     """
-    num_chunks = chunk_count_bucket(len(chunk_spans))
+    num_chunks = _resolve_bucket(len(chunk_spans), min_bucket)
     fspec = FusedSpec(plan=spec, num_chunks=num_chunks)
     kernel = _KERNEL_CACHE.get(fspec)
     if kernel is None:

@@ -99,13 +99,102 @@ def _criteria_summary(criteria) -> str:
 # -- measure ----------------------------------------------------------------
 
 
+class _Conflict:
+    """Sentinel: a criteria subtree whose entity-literal combinations
+    are unsatisfiable (parseEntities nil in the reference)."""
+
+
+_CONFLICT = _Conflict()
+
+
+def check_entity_combinations(measure, criteria) -> None:
+    """Reject criteria whose ENTITY-tag literal algebra is
+    unsatisfiable (pkg/query/logical/parser.go parseEntities analog:
+    the reference returns nil for conflicting AND-of-OR entity
+    literals and fails the query; evaluating such a tree as plain mask
+    algebra would instead scan and return rows).
+
+    The algebra per subtree is a map {entity tag -> possible value
+    set} (absent = unconstrained):
+
+    - a leaf ``eq``/``in`` on an entity tag constrains that tag to its
+      literal set; every other leaf is unconstrained;
+    - AND intersects per-tag sets — an EMPTY intersection makes the
+      subtree a conflict;
+    - OR unions per-tag sets when both branches constrain a tag and
+      drops the constraint otherwise; a conflicting branch poisons the
+      OR (the reference's nil propagates up).
+
+    Raises ValueError (→ INVALID_ARGUMENT on the wire) on conflict.
+    """
+    from banyandb_tpu.api.model import Condition, LogicalExpression
+
+    entity = set(
+        getattr(getattr(measure, "entity", None), "tag_names", ()) or ()
+    )
+    if criteria is None or not entity:
+        return
+
+    def lit_bytes(v):
+        from banyandb_tpu.query.measure_exec import _tag_value_bytes
+
+        try:
+            return _tag_value_bytes(v)
+        except TypeError:
+            return None
+
+    def walk(node):
+        if node is None:
+            return {}
+        if isinstance(node, Condition):
+            if node.name in entity and node.op == "eq":
+                b = lit_bytes(node.value)
+                return {} if b is None else {node.name: {b}}
+            if node.name in entity and node.op == "in":
+                vals = {lit_bytes(v) for v in node.value}
+                vals.discard(None)
+                return {node.name: vals} if vals else {}
+            return {}
+        assert isinstance(node, LogicalExpression), node
+        left, right = walk(node.left), walk(node.right)
+        if node.op == "and":
+            if left is _CONFLICT or right is _CONFLICT:
+                return _CONFLICT
+            out = dict(left)
+            for tag, vals in right.items():
+                if tag in out:
+                    inter = out[tag] & vals
+                    if not inter:
+                        return _CONFLICT
+                    out[tag] = inter
+                else:
+                    out[tag] = vals
+            return out
+        # or
+        if left is _CONFLICT or right is _CONFLICT:
+            return _CONFLICT
+        out = {}
+        for tag in set(left) & set(right):
+            out[tag] = left[tag] | right[tag]
+        return out
+
+    if walk(criteria) is _CONFLICT:
+        raise ValueError(
+            "unsatisfiable entity criteria: conflicting entity-tag "
+            "literals under AND (no entity combination can match)"
+        )
+
+
 def analyze_measure(measure, req: QueryRequest, *, execute=None) -> PlanNode:
     """Local measure plan (measure_analyzer.go:70 Analyze analog).
 
     Owns the routing decisions: index-mode short-circuit (query.go:506),
-    aggregate pipeline vs raw projection scan, TopN re-rank.
+    aggregate pipeline vs raw projection scan, TopN re-rank — and the
+    reference's entity-combination rejection (conflicting entity
+    literals raise before anything executes).
     execute: closure the leaf lowers onto (engine-provided).
     """
+    check_entity_combinations(measure, req.criteria)
     if getattr(measure, "index_mode", False):
         scan = PlanNode(
             "IndexModeScan",

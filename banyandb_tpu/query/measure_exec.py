@@ -551,11 +551,12 @@ def execute_aggregate(
     dict_state: Optional[DictState] = None,
     analyzers: Optional[dict] = None,
     span=None,
+    plan_hints=None,
 ) -> QueryResult:
     """Run a group-by/aggregate/top-N/percentile query over decoded sources."""
     partial = compute_partials(
         measure, request, sources, dict_state=dict_state, analyzers=analyzers,
-        span=span,
+        span=span, plan_hints=plan_hints,
     )
     return finalize_partials(
         measure, request, [partial], dict_state=dict_state, span=span
@@ -570,6 +571,7 @@ def compute_partials(
     dict_state: Optional[DictState] = None,
     analyzers: Optional[dict] = None,
     span=None,
+    plan_hints=None,
 ) -> Partials:
     """The 'map' phase: device scan+reduce over local sources.
 
@@ -585,6 +587,15 @@ def compute_partials(
     `span` (obs.tracer.Span or None): tracing sink — gather/reduce child
     spans with cache hit/miss tags and device/host attribution.  None
     keeps the path span-free; the stage histograms observe either way.
+
+    `plan_hints` (query/planner.PlanDecision or None): the cost-based
+    planner's result-preserving refinements — a group-method override
+    when the estimated distinct group count crosses the hash/sort
+    crossover on the other side of the static radix product, a minimum
+    fused chunk-count bucket (signature stability), and a
+    prefer-staged routing when the estimated footprint exceeds the
+    fused budget.  ``actual_rows`` is written back for the planner
+    span's est-vs-actual tag.
     """
     import time as _time
     conds, expr = _lower_criteria(request.criteria)
@@ -790,6 +801,18 @@ def compute_partials(
     want_minmax = not agg or agg.function in ("min", "max") or want_percentile
 
     nrows = SCAN_CHUNK if n > SCAN_CHUNK else _scan_bucket(max(n, 1))
+    # planner group-method override (query/planner): applied ONLY when
+    # the estimate lands on the other side of the hash/sort crossover
+    # from the static radix product — the common case keeps "auto" so
+    # the plan signature (jit cache, precompile store, kernel budgets)
+    # is exactly the pre-planner one.  Methods are bit-identical within
+    # the span bound (ops/groupby contract), so BYDB_PLANNER=0/1 result
+    # JSON stays byte-identical.
+    group_method = "auto"
+    if plan_hints is not None and plan_hints.group_method:
+        group_method = plan_hints.group_method
+    if plan_hints is not None:
+        plan_hints.actual_rows = int(n)
     spec = PlanSpec(
         tags_code=tuple(sorted(tags_code)),
         fields=tuple(sorted(fields)),
@@ -800,6 +823,7 @@ def compute_partials(
         want_minmax=want_minmax,
         hist_field=hist_field,
         nrows=nrows,
+        group_method=group_method,
         expr=expr,
         want_rep=want_rep,
         rep_desc=rep_desc,
@@ -810,7 +834,11 @@ def compute_partials(
     # function-local import: precompile imports this module's builders
     from banyandb_tpu.query.precompile import default_registry
 
-    default_registry().record("measure", spec)
+    # the (group, measure) context turns this anonymous signature into
+    # autoreg evidence (query/planner.signature_from_spec)
+    default_registry().record(
+        "measure", spec, context=(measure.group, measure.name)
+    )
 
     # --- histogram range from host stats (two-pass percentile) ------------
     if hist_range is not None:
@@ -859,7 +887,7 @@ def compute_partials(
             measure, chunks_np, conds, expr, pred_vals, spec, kernel,
             group_values, rep_tags, rep_desc, want_rep, gd, dict_state,
             hist_lo, hist_span, want_percentile, epoch, gather_key, agg,
-            span=rspan,
+            span=rspan, plan_hints=plan_hints,
         )
 
     try:
@@ -904,6 +932,7 @@ def _reduce_partials(
     gather_key,
     agg,
     span=None,
+    plan_hints=None,
 ):
     """The reduction tail of compute_partials (cacheable unit).
 
@@ -1068,7 +1097,19 @@ def _reduce_partials(
     device_s = 0.0  # time at the accelerator boundaries (dispatch + get)
     dispatches = 0
     fused_cache_tag = None
-    if fused_exec.eligible(spec, len(chunk_spans)):
+    # planner hints (query/planner): prefer_staged routes an estimated-
+    # over-budget batch straight to the staged loop; min_bucket rounds
+    # the chunk-count bucket UP to the estimate's bucket (padding chunks
+    # are fully invalid — byte-identical, one compiled program for a
+    # part population oscillating around a bucket boundary)
+    min_bucket = None
+    hinted_staged = False
+    if plan_hints is not None:
+        min_bucket = plan_hints.chunk_bucket
+        hinted_staged = plan_hints.prefer_staged
+    if not hinted_staged and fused_exec.eligible(
+        spec, len(chunk_spans), min_bucket=min_bucket
+    ):
         path = "fused"
         moved_chunks, device_s, fused_cache_tag = fused_exec.run_fused(
             chunks_np,
@@ -1082,6 +1123,7 @@ def _reduce_partials(
             dev_cache=dev_cache,
             pad_ship_s=pad_ship_s,
             ship_stats=ship_stats,
+            min_bucket=min_bucket,
         )
         dispatches = 1
         for moved in moved_chunks:

@@ -468,6 +468,13 @@ class PrecompileRegistry:
         self._lock = threading.Lock()
         # (kind, spec) -> use count; insertion order = first-seen order
         self._recorded: dict[tuple, int] = {}
+        # (kind, spec) -> epoch-ms of the latest record() — persisted so
+        # warming (and the autoreg miner) can rank by freshness too
+        self._last_hit: dict[tuple, int] = {}
+        # (kind, spec) -> (group, measure) the executor resolved the
+        # plan for: the context that turns an anonymous PlanSpec into a
+        # registrable streamagg signature (query/planner mining)
+        self._contexts: dict[tuple, tuple] = {}
         self._store_path: Optional[Path] = None
         self._warm_thread: Optional[threading.Thread] = None
         self._warm_pending = False
@@ -478,19 +485,42 @@ class PrecompileRegistry:
         self.errors = 0
 
     # -- recording / persistence --------------------------------------------
-    def record(self, kind: str, spec) -> None:
+    def record(self, kind: str, spec, context: Optional[tuple] = None) -> None:
         """Called by executors on every plan resolution.  Never blocks
         the query hot path: a first-seen signature schedules a debounced
-        background save instead of rewriting the store inline."""
+        background save instead of rewriting the store inline.
+
+        ``context`` ((group, measure), measure plans only) attaches the
+        schema identity the plan resolved against — the evidence the
+        auto-registration miner needs to turn a hot PlanSpec into a
+        streamagg registration."""
         if not enabled():
             return
         new = False
         with self._lock:
-            n = self._recorded.get((kind, spec))
-            self._recorded[(kind, spec)] = (n or 0) + 1
+            key = (kind, spec)
+            n = self._recorded.get(key)
+            self._recorded[key] = (n or 0) + 1
+            import time as _time
+
+            self._last_hit[key] = int(_time.time() * 1000)
+            if context is not None:
+                self._contexts[key] = tuple(context)
             new = n is None and self._store_path is not None
         if new:
             self._schedule_save()
+
+    def evidence(self) -> list[tuple]:
+        """[(kind, spec, count, context-or-None)] for the autoreg
+        miner, hottest first."""
+        with self._lock:
+            return [
+                (k, s, count, self._contexts.get((k, s)))
+                for (k, s), count in sorted(
+                    self._recorded.items(),
+                    key=lambda kv: (-kv[1], -self._last_hit.get(kv[0], 0)),
+                )
+            ]
 
     def _schedule_save(self, delay: float = 1.0) -> None:
         with self._lock:
@@ -510,21 +540,33 @@ class PrecompileRegistry:
     def attach_store(self, path) -> None:
         """Bind (and load) the persistent signature store."""
         p = Path(path)
-        loaded: list[tuple[tuple, int]] = []
+        loaded: list[tuple[tuple, int, int, Optional[tuple]]] = []
         try:
             if p.exists():
                 for rec in json.loads(p.read_text()).get("signatures", []):
                     try:
                         kind, spec = spec_from_json(rec)
-                        loaded.append(((kind, spec), int(rec.get("count", 1))))
+                        ctx = rec.get("context")
+                        loaded.append((
+                            (kind, spec),
+                            int(rec.get("count", 1)),
+                            int(rec.get("last_hit_ms", 0)),
+                            tuple(ctx) if ctx else None,
+                        ))
                     except Exception:  # noqa: BLE001 — skip stale entries
                         continue
         except (OSError, ValueError):
             loaded = []
         with self._lock:
             self._store_path = p
-            for key, count in loaded:
+            for key, count, last_ms, ctx in loaded:
                 self._recorded[key] = max(self._recorded.get(key, 0), count)
+                if last_ms:
+                    self._last_hit[key] = max(
+                        self._last_hit.get(key, 0), last_ms
+                    )
+                if ctx is not None and key not in self._contexts:
+                    self._contexts[key] = ctx
             have_unsaved = len(self._recorded) > len(loaded)
         if have_unsaved:
             # signatures recorded before the store was bound (embedded
@@ -536,12 +578,25 @@ class PrecompileRegistry:
             p = self._store_path
             if p is None:
                 return
+            # frequency-weighted persistence, recency as the tiebreak:
+            # the top-MAX_STORED ACTUALLY-HOT signatures survive a
+            # restart (and warm first), not the most recently seen ones
             top = sorted(
-                self._recorded.items(), key=lambda kv: -kv[1]
+                self._recorded.items(),
+                key=lambda kv: (-kv[1], -self._last_hit.get(kv[0], 0)),
             )[:MAX_STORED]
             doc = {
                 "signatures": [
-                    {**spec_to_json(kind, spec), "count": count}
+                    {
+                        **spec_to_json(kind, spec),
+                        "count": count,
+                        "last_hit_ms": self._last_hit.get((kind, spec), 0),
+                        **(
+                            {"context": list(self._contexts[(kind, spec)])}
+                            if (kind, spec) in self._contexts
+                            else {}
+                        ),
+                    }
                     for (kind, spec), count in top
                 ]
             }
@@ -554,11 +609,14 @@ class PrecompileRegistry:
             pass  # persistence is an optimization, never a query failure
 
     def signatures(self) -> list[tuple[str, object]]:
+        """Hottest first (count, then recency): warm_async compiles the
+        actually-hot population before the long tail."""
         with self._lock:
             return [
                 (k, s)
                 for (k, s), _ in sorted(
-                    self._recorded.items(), key=lambda kv: -kv[1]
+                    self._recorded.items(),
+                    key=lambda kv: (-kv[1], -self._last_hit.get(kv[0], 0)),
                 )
             ]
 

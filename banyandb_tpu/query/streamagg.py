@@ -72,6 +72,12 @@ _NEG_INF_TS = -(2**62)
 _POS_INF_TS = 2**62
 
 
+def _now_ms() -> int:
+    import time as _time
+
+    return int(_time.time() * 1000)
+
+
 def enabled() -> bool:
     """The read-path A/B flag.  Ingest-side window maintenance always
     runs for registered signatures (a live flag flip must not leave
@@ -131,7 +137,7 @@ class _Sig:
         "spec", "windows", "covered_from", "watermark", "building",
         "pending", "max_windows", "rows", "late", "evicted",
         "key_index", "keys_rev", "snapshots", "cond_luts", "proj_luts",
-        "backfill_parts",
+        "backfill_parts", "origin", "hits", "last_hit_ms",
     )
 
     def __init__(self, spec: SigSpec, max_windows: int):
@@ -160,6 +166,12 @@ class _Sig:
         # introduced before the source snapshot whose install hook only
         # fires AFTER building flips off must not apply twice
         self.backfill_parts: frozenset = frozenset()
+        # provenance + serve-hit stats (the autoreg eviction evidence:
+        # least-recently-HIT auto signatures evict first, manual
+        # registrations are never auto-evicted)
+        self.origin = "manual"
+        self.hits = 0
+        self.last_hit_ms = 0
 
 
 @dataclass
@@ -298,6 +310,7 @@ class StreamAggRegistry:
         fields,
         window_millis: Optional[int] = None,
         max_windows: Optional[int] = None,
+        origin: str = "manual",
     ) -> dict:
         """Register (idempotent) one materialized signature and backfill
         its windows from the engine's current parts + memtables.
@@ -345,8 +358,29 @@ class StreamAggRegistry:
             )
         spec = SigSpec(group, measure, key_tags, fields, w)
         sig = _Sig(spec, int(max_windows or default_max_windows()))
+        sig.origin = origin if origin in ("manual", "auto") else "manual"
+        # registration grace stamp: the autoreg LRU evictor compares a
+        # candidate's evidence time against this — a just-registered
+        # signature must not be displaced by the NEXT candidate of the
+        # same mining cycle before it ever had a chance to serve
+        sig.last_hit_ms = _now_ms()
+        existing_out = None
+        promoted = False
         with self._lock:
-            if spec in self._sigs:
+            existing = self._sigs.get(spec)
+            if existing is not None:
+                if origin == "manual" and existing.origin == "auto":
+                    # an operator re-registering an auto signature
+                    # PROMOTES it: manual registrations never auto-evict
+                    existing.origin = "manual"
+                    promoted = True
+                existing_out = self._stats_one_locked(existing)
+        if existing_out is not None:
+            if promoted:
+                self._persist()
+            return existing_out
+        with self._lock:
+            if spec in self._sigs:  # raced a concurrent register
                 return self._stats_one_locked(self._sigs[spec])
             self._sigs[spec] = sig
             self._rebind_snapshots_locked()
@@ -404,8 +438,9 @@ class StreamAggRegistry:
                         "key_tags": list(s.key_tags),
                         "fields": list(s.fields),
                         "window_millis": s.window_millis,
+                        "origin": sig.origin,
                     }
-                    for s in self._sigs
+                    for s, sig in self._sigs.items()
                 ]
             }
         try:
@@ -413,6 +448,45 @@ class StreamAggRegistry:
             fs.atomic_write_json(self._store, doc)
         except OSError:
             log.exception("streamagg registry persist failed (state kept)")
+
+    def unregister(
+        self,
+        group: str,
+        measure: str,
+        key_tags,
+        fields,
+        window_millis: Optional[int] = None,
+    ) -> bool:
+        """Drop one materialized signature (the autoreg eviction path;
+        also an operator surface via the ``streamagg`` topic).  All
+        window state is released; queries it covered fall back to the
+        scan path on their next plan_cover.  -> True when a signature
+        was actually removed."""
+        key_tags = tuple(sorted(dict.fromkeys(key_tags)))
+        fields = tuple(sorted(dict.fromkeys(fields)))
+        w = int(window_millis or 0)
+        with self._lock:
+            match = None
+            for spec in self._sigs:
+                if (
+                    spec.group == group
+                    and spec.measure == measure
+                    and spec.key_tags == key_tags
+                    and spec.fields == fields
+                    and (w == 0 or spec.window_millis == w)
+                ):
+                    match = spec
+                    break
+            if match is None:
+                return False
+            self._sigs.pop(match)
+            self._rebind_snapshots_locked()
+        self._persist()
+        log.info(
+            "streamagg: unregistered %s/%s[%s]",
+            group, measure, ",".join(key_tags),
+        )
+        return True
 
     def load_persisted(self) -> int:
         """Explicit persisted-registry reload for deferred-autoload
@@ -441,6 +515,7 @@ class StreamAggRegistry:
                     key_tags=rec.get("key_tags", ()),
                     fields=rec.get("fields", ()),
                     window_millis=rec.get("window_millis"),
+                    origin=rec.get("origin", "manual"),
                 )
             except Exception:  # noqa: BLE001 — a stale entry (dropped
                 # measure, renamed tag) must not take the engine down
@@ -1046,6 +1121,10 @@ class StreamAggRegistry:
                 # the planned range was evicted/reset since plan_cover:
                 # folding now would silently drop the missing windows
                 raise CoverageLost(cover.sig.spec.label())
+            # serve-hit bookkeeping: the autoreg budget evicts the
+            # least-recently-HIT auto signature first
+            sig.hits += 1
+            sig.last_hit_ms = int(_now_ms())
             snaps = []
             for w in sig.windows:
                 if not (cover.cov_lo <= w < cover.cov_hi):
@@ -1147,9 +1226,14 @@ class StreamAggRegistry:
     def _stats_one_locked(self, sig: _Sig) -> dict:
         return {
             "signature": sig.spec.label(),
+            "group": sig.spec.group,
+            "measure": sig.spec.measure,
             "key_tags": list(sig.spec.key_tags),
             "fields": list(sig.spec.fields),
             "window_millis": sig.spec.window_millis,
+            "origin": sig.origin,
+            "hits": sig.hits,
+            "last_hit_ms": sig.last_hit_ms or None,
             "windows": len(sig.windows),
             "states": sum(
                 len(s)

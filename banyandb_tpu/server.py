@@ -200,6 +200,32 @@ class StandaloneServer:
         self.access_log = AccessLog(
             self.root / "logs" / "access.log", slow_query_ms=slow_query_ms
         )
+        # self-driving materialization (query/planner): the query
+        # epilogue feeds per-signature hit counts (slow queries weighted
+        # double) and the bydb-autoreg loop registers hot eligible
+        # signatures through the same streamagg surface operators use
+        from banyandb_tpu.obs.recorder import SignatureStats
+        from banyandb_tpu.query import planner as planner_mod
+        from banyandb_tpu.query.precompile import default_registry as _pre_reg
+
+        self.sig_stats = SignatureStats()
+        self.autoreg = planner_mod.AutoRegistrar(
+            self.root / "autoreg.json",
+            sig_stats=self.sig_stats,
+            register_fn=lambda g, m, kt, f: self._streamagg({
+                "op": "register", "group": g, "measure": m,
+                "key_tags": list(kt), "fields": list(f),
+                "origin": "auto",
+            }),
+            unregister_fn=lambda g, m, kt, f: bool(
+                self._streamagg({
+                    "op": "unregister", "group": g, "measure": m,
+                    "key_tags": list(kt), "fields": list(f),
+                }).get("unregistered")
+            ),
+            stats_fn=self._streamagg_signature_rows,
+            plan_registry=_pre_reg(),
+        )
         # schema docs dogfood the property engine (schemaserver analog);
         # the registry's own JSON files remain as a migration-safe mirror
         from banyandb_tpu.cluster.schema_plane import PropertySchemaStore
@@ -428,6 +454,16 @@ class StandaloneServer:
 
         group = req.groups[0] if req.groups else ""
         self.access_log.log_query(group, req.name, ms, ql=ql, rows=rows)
+        if engine == "measure":
+            # autoreg evidence: every measure query's streamagg-eligible
+            # signature counts; slow ones count double (materialization
+            # helps them most)
+            from banyandb_tpu.query import planner as planner_mod
+
+            self.sig_stats.observe(
+                planner_mod.signature_of(req),
+                weight=2 if ms >= self.slow_query_ms else 1,
+            )
 
         def render_plan():
             # post-hoc plan render: slow queries only, never hot
@@ -483,6 +519,9 @@ class StandaloneServer:
         pr = default_registry().stats()
         for k in ("recorded", "compiled", "errors"):
             self.meter.gauge_set(f"precompile_{k}", float(pr[k]))
+        ar = self.autoreg.stats()
+        for k in ("known_signatures", "registered_total", "evicted_total"):
+            self.meter.gauge_set(f"autoreg_{k}", float(ar[k]))
         if self.pool is not None:
             # pool gauges set BEFORE the render so the scrape that
             # matters most — every worker down, empty worker_text —
@@ -500,8 +539,9 @@ class StandaloneServer:
 
     def _streamagg(self, env):
         """Streaming-aggregation control surface (query/streamagg.py):
-        register materialized dashboard signatures / read window
-        state."""
+        register/unregister materialized dashboard signatures / read
+        window state.  ``origin: "auto"`` marks autoreg registrations
+        (budget-evictable; manual ones never are)."""
         op = env.get("op", "stats")
         if self.pool is not None:
             # windows are worker-local per shard: registrations
@@ -515,11 +555,51 @@ class StandaloneServer:
                 fields=tuple(env.get("fields", ())),
                 window_millis=env.get("window_millis"),
                 max_windows=env.get("max_windows"),
+                origin=env.get("origin", "manual"),
             )
             return {"registered": info}
+        if op == "unregister":
+            removed = self.measure.streamagg.unregister(
+                env["group"],
+                env["measure"],
+                key_tags=tuple(env.get("key_tags", ())),
+                fields=tuple(env.get("fields", ())),
+                window_millis=env.get("window_millis"),
+            )
+            return {"unregistered": removed}
         if op == "stats":
             return {"streamagg": self.measure.streamagg.stats()}
         raise KeyError(f"bad streamagg op {op!r}")
+
+    def _streamagg_signature_rows(self) -> list:
+        """Flat signature-stat rows for the autoreg budget (pool mode
+        merges per-worker rows: states/hits sum, last-hit maxes)."""
+        st = self._streamagg({"op": "stats"}).get("streamagg") or {}
+        if self.pool is None:
+            return st.get("signatures", [])
+        merged: dict = {}
+        for wstats in st.values():
+            for row in (wstats or {}).get("signatures", ()):
+                key = (
+                    row.get("group"), row.get("measure"),
+                    tuple(row.get("key_tags", ())),
+                    tuple(row.get("fields", ())),
+                )
+                cur = merged.get(key)
+                if cur is None:
+                    merged[key] = dict(row)
+                else:
+                    cur["states"] = int(cur.get("states", 0)) + int(
+                        row.get("states", 0)
+                    )
+                    cur["hits"] = int(cur.get("hits", 0)) + int(
+                        row.get("hits", 0)
+                    )
+                    cur["last_hit_ms"] = max(
+                        cur.get("last_hit_ms") or 0,
+                        row.get("last_hit_ms") or 0,
+                    ) or None
+        return list(merged.values())
 
     def _topn(self, env):
         """TopN query over pre-aggregated windows (TopNService analog)."""
@@ -658,6 +738,13 @@ class StandaloneServer:
         catalog, req = bydbql.parse_with_catalog(
             env["ql"], env.get("params", ())
         )
+        if env.get("trace"):
+            # cli.py explain (and any caller wanting the in-band tree):
+            # force request-level tracing so the reply carries plan text
+            # + span tree without a QL syntax extension
+            import dataclasses as _dc
+
+            req = _dc.replace(req, trace=True)
         tracer = Tracer(f"standalone:{catalog}")
         t0 = time.perf_counter()
         if catalog == "stream":
@@ -780,6 +867,12 @@ class StandaloneServer:
         reg = default_registry()
         reg.attach_store(self.root / "plan-registry.json")
         reg.warm_async()
+        # the bydb-autoreg loop (query/planner): self-driving streamagg
+        # registration under an eviction budget (BYDB_AUTOREG=0 disables)
+        from banyandb_tpu.query import planner as planner_mod
+
+        if planner_mod.autoreg_enabled():
+            self.autoreg.start()
         # one lifecycle group drives storage loops for ALL engines' TSDBs
         # AND property-lease GC
         self.measure.start_lifecycle(
@@ -824,6 +917,7 @@ class StandaloneServer:
         from banyandb_tpu.query.precompile import default_registry
 
         default_registry().shutdown()
+        self.autoreg.stop()
         self.measure.stop_lifecycle()
         self.self_metrics.stop()
         self.watchdog.stop()

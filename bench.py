@@ -354,6 +354,10 @@ def e2e_main() -> None:
         # main(); BYDB_COMPILE_CACHE_DIR (e.g. a dir that outlives this
         # run) overrides and makes even the first plan compile a hit
         compile_cache.enable(root / "compile-cache")
+        # the autoreg LOOP stays off at boot so earlier phases measure
+        # the pure scan path; the planner A/B phase below drives
+        # srv.autoreg.tick() explicitly (deterministic registration)
+        os.environ["BYDB_AUTOREG"] = "0"
         srv = StandaloneServer(root, port=0)
         srv.start()
         # server start kicked off the plan precompile warm thread; the
@@ -533,6 +537,175 @@ def e2e_main() -> None:
                     os.environ.pop("BYDB_FUSED", None)
                 else:
                     os.environ["BYDB_FUSED"] = ambient_fused
+            # ---- self-driving planner A/B (ISSUE 12) -----------------
+            # ON = BYDB_PLANNER=1 + auto-registration (ticked inline on
+            # the in-process server: hot signatures materialize with no
+            # operator); OFF = BYDB_PLANNER=0 + BYDB_STREAMAGG=0, the
+            # pre-planner flag-priority engine.  Mixed-selectivity
+            # distinct set: eq (1/8), half in-set, no-predicate
+            # (selectivity ~1 -> zone pre-pass skipped), and a
+            # high-radix TopN (group-method decision).  Same-shape
+            # signatures repeat across the set, which is exactly the
+            # evidence autoreg mines.  Result JSON is asserted
+            # byte-identical between modes (the acceptance contract).
+            def mixed_queries(count: int, seed: int) -> list[str]:
+                rq = np.random.default_rng(seed)
+                span = n_rows * step
+                out = []
+                for i in range(count):
+                    b = T0 + int(rq.integers(0, span // 3))
+                    e = b + int(rq.integers(span // 4, span // 2))
+                    kind = i % 4
+                    if kind == 0:
+                        out.append(
+                            f"SELECT sum(hits) FROM MEASURE m IN g TIME "
+                            f"BETWEEN {b} AND {e} WHERE region = "
+                            f"'r{i % 8}' GROUP BY region"
+                        )
+                    elif kind == 1:
+                        out.append(
+                            f"SELECT mean(hits) FROM MEASURE m IN g TIME "
+                            f"BETWEEN {b} AND {e} WHERE region IN "
+                            f"('r0','r1','r2','r3') GROUP BY region"
+                        )
+                    elif kind == 2:
+                        out.append(
+                            f"SELECT sum(hits) FROM MEASURE m IN g TIME "
+                            f"BETWEEN {b} AND {e} GROUP BY region"
+                        )
+                    else:
+                        out.append(
+                            f"SELECT sum(hits) FROM MEASURE m IN g TIME "
+                            f"BETWEEN {b} AND {e} WHERE region = "
+                            f"'r{i % 8}' GROUP BY svc TOP 10 BY hits"
+                        )
+                return out
+
+            def run_served(ql: str) -> tuple:
+                t0 = time.perf_counter()
+                reply = tr.call(
+                    srv.addr, TOPIC_QL, {"ql": ql}, timeout=600.0
+                )
+                return (
+                    (time.perf_counter() - t0) * 1000,
+                    reply.get("served", "scan"),
+                )
+
+            def planner_counts(txt0: str, txt1: str) -> dict:
+                out = {}
+                for p in ("materialized", "fused", "staged", "raw"):
+                    c0 = obs_prom.gauge_value(
+                        txt0, "banyandb_planner_decisions_total",
+                        {"path": p},
+                    ) or 0.0
+                    c1 = obs_prom.gauge_value(
+                        txt1, "banyandb_planner_decisions_total",
+                        {"path": p},
+                    ) or 0.0
+                    if c1 - c0:
+                        out[p] = int(c1 - c0)
+                return out
+
+            ambient_pl = {
+                k: os.environ.get(k)
+                for k in (
+                    "BYDB_PLANNER",
+                    "BYDB_STREAMAGG",
+                    "BYDB_AUTOREG_MAX_STATE_MB",
+                )
+            }
+            try:
+                # the synthetic day's (region, svc) cardinality blows
+                # the production-default 64MB state estimate by design
+                # (budget behavior is covered by tests/test_planner.py);
+                # this phase measures the self-driving WIN, so give the
+                # loop room to keep its windows
+                os.environ.setdefault("BYDB_AUTOREG_MAX_STATE_MB", "4096")
+                # untimed SHAPE warmup under the baseline config: every
+                # plan-spec x row-bucket combo the mixed set resolves
+                # compiles before EITHER timed leg, so leg order cannot
+                # charge XLA compiles to the A/B
+                os.environ["BYDB_PLANNER"] = "0"
+                os.environ["BYDB_STREAMAGG"] = "0"
+                for q in mixed_queries(16, seed=101):
+                    run(q)
+                os.environ["BYDB_PLANNER"] = "1"
+                os.environ["BYDB_STREAMAGG"] = "1"
+                # evidence warmup + deterministic autoreg registration
+                for q in mixed_queries(12, seed=53):
+                    run(q)
+                auto_sigs = 0
+                for _ in range(10):
+                    srv.autoreg.tick()
+                    auto_sigs = len(srv._streamagg_signature_rows())
+                    if auto_sigs >= 2:
+                        break
+                for q in mixed_queries(4, seed=59):
+                    run(q)  # untimed: materialized path warms
+                text_pl0 = metrics_text()
+                on_runs = [
+                    run_served(q) for q in mixed_queries(n_ab, seed=61)
+                ]
+                text_pl1 = metrics_text()
+                os.environ["BYDB_PLANNER"] = "0"
+                os.environ["BYDB_STREAMAGG"] = "0"
+                for q in mixed_queries(4, seed=67):
+                    run(q)
+                off_runs = [
+                    run_served(q) for q in mixed_queries(n_ab, seed=71)
+                ]
+                # byte parity between modes on the SAME queries
+                parity_ok = True
+                for q in mixed_queries(6, seed=73):
+                    os.environ["BYDB_PLANNER"] = "1"
+                    os.environ["BYDB_STREAMAGG"] = "1"
+                    r_on = tr.call(
+                        srv.addr, TOPIC_QL, {"ql": q}, timeout=600.0
+                    )["result"]
+                    os.environ["BYDB_PLANNER"] = "0"
+                    os.environ["BYDB_STREAMAGG"] = "0"
+                    r_off = tr.call(
+                        srv.addr, TOPIC_QL, {"ql": q}, timeout=600.0
+                    )["result"]
+                    if json.dumps(r_on, sort_keys=True) != json.dumps(
+                        r_off, sort_keys=True
+                    ):
+                        parity_ok = False
+            finally:
+                for k, v in ambient_pl.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+            on_ms = [r[0] for r in on_runs]
+            off_ms = [r[0] for r in off_runs]
+            served_counts: dict = {}
+            for _, s in on_runs:
+                served_counts[s] = served_counts.get(s, 0) + 1
+            served_counts_off: dict = {}
+            for _, s in off_runs:
+                served_counts_off[s] = served_counts_off.get(s, 0) + 1
+            on_p50 = float(np.percentile(on_ms, 50))
+            off_p50 = float(np.percentile(off_ms, 50))
+            planner_ab = {
+                "queries_per_mode": n_ab,
+                "auto_signatures": auto_sigs,
+                "autoreg_stats": srv.autoreg.stats(),
+                "planner_on_p50_ms": round(on_p50, 1),
+                "planner_on_p99_ms": round(
+                    float(np.percentile(on_ms, 99)), 1
+                ),
+                "planner_off_p50_ms": round(off_p50, 1),
+                "planner_off_p99_ms": round(
+                    float(np.percentile(off_ms, 99)), 1
+                ),
+                "planner_speedup": round(off_p50 / max(on_p50, 1e-9), 2),
+                "decision_counts": planner_counts(text_pl0, text_pl1),
+                "served_counts_on": served_counts,
+                "served_counts_off": served_counts_off,
+                "result_parity": parity_ok,
+            }
+
             fused_p50 = float(np.percentile(fused_ms, 50))
             staged_p50 = float(np.percentile(staged_ms, 50))
             fused_ab = {
@@ -551,6 +724,9 @@ def e2e_main() -> None:
                     text_ab1, text_ab2
                 ),
             }
+            # scraped while the server is still UP — the artifact print
+            # below runs after srv.stop()
+            decode_counters_snapshot = decode_counters()
         finally:
             tr.close()
             srv.stop()
@@ -597,10 +773,12 @@ def e2e_main() -> None:
                     "fused": os.environ.get("BYDB_FUSED", "1"),
                     "fused_speedup": fused_ab["fused_speedup"],
                     "fused_ab": fused_ab,
+                    "planner_speedup": planner_ab["planner_speedup"],
+                    "planner_ab": planner_ab,
                     "device_decode": os.environ.get(
                         "BYDB_DEVICE_DECODE", "1"
                     ),
-                    "decode_counters": decode_counters(),
+                    "decode_counters": decode_counters_snapshot,
                 }
             )
         )

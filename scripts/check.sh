@@ -57,6 +57,13 @@ echo "== streamagg smoke =="
 # round-trip (docs/performance.md "Continuous streaming aggregation")
 env JAX_PLATFORMS=cpu python scripts/streamagg_smoke.py || fail=1
 
+echo "== planner smoke =="
+# self-driving materialization: hot QL pattern -> bydb-autoreg
+# registers a window -> served=materialized; explain renders est-vs-
+# actual; BYDB_PLANNER=0/1 byte parity; planner/autoreg instruments
+# (docs/performance.md "Adaptive planner")
+env JAX_PLATFORMS=cpu python scripts/planner_smoke.py || fail=1
+
 echo "== sanitize smoke (bdsan) =="
 # live-engine stress slice under BYDB_SANITIZE=1: lock-order witnesses
 # consistent with the declared graph, zero leaked threads/fds, seeded

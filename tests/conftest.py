@@ -14,6 +14,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # warming in the general suite (tests/test_cold_path.py re-enables it
 # explicitly to exercise the precompile registry)
 os.environ.setdefault("BYDB_PRECOMPILE", "0")
+# no background auto-registration in the general suite: a bydb-autoreg
+# loop registering streamagg signatures mid-test would make window
+# population timing-dependent (tests/test_planner.py builds explicit
+# AutoRegistrar instances and drives ticks deterministically)
+os.environ.setdefault("BYDB_AUTOREG", "0")
 # no shard-worker subprocesses in the general suite (the BYDB_FUSED-
 # style A/B contract is pinned explicitly by tests/test_workers.py,
 # which passes workers=N to the server; everything else runs the

@@ -1,0 +1,375 @@
+"""Pin tests for the four formerly-skipped measure/TopN golden
+behaviors (ROADMAP item 6d), closed by this PR:
+
+1. hidden-tag projection: indexed non-entity tags join the series'
+   LATEST write onto every row (reference metadataDocs semantics);
+2. conflicting AND-of-OR entity literals are rejected
+   (query/logical.check_entity_combinations, parseEntities-nil analog);
+3. TopNRequests spanning multiple groups merge distinct-best and
+   re-rank across groups;
+4. TopN pre-aggregation windows version-merge rewrites of the same
+   (series, ts) before feeding counters.
+
+The golden corpora themselves replay only where /root/reference is
+mounted (tests/test_goldens_*); these pins keep the semantics covered
+everywhere.
+"""
+
+import pytest
+
+from banyandb_tpu.api.model import (
+    Aggregation,
+    Condition,
+    DataPointValue,
+    GroupBy,
+    LogicalExpression,
+    QueryRequest,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.api.schema import (
+    Catalog,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    IndexRule,
+    IndexRuleBinding,
+    Measure,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TopNAggregation,
+)
+from banyandb_tpu.models.measure import MeasureEngine
+
+T0 = 1_700_000_000_000
+
+
+def _engine(tmp_path, groups=("g",)):
+    reg = SchemaRegistry(tmp_path / "schema")
+    for g in groups:
+        reg.create_group(Group(g, Catalog.MEASURE, ResourceOpts(shard_num=1)))
+        reg.create_measure(Measure(
+            group=g, name="m",
+            tags=(
+                TagSpec("svc", TagType.STRING),
+                TagSpec("id", TagType.STRING),
+            ),
+            fields=(FieldSpec("v", FieldType.INT),),
+            entity=Entity(("svc",)),
+        ))
+    return reg, MeasureEngine(reg, tmp_path / "data")
+
+
+def _pt(ts, svc, id_, v, version=0):
+    return DataPointValue(
+        ts_millis=ts, tags={"svc": svc, "id": id_}, fields={"v": v},
+        version=version,
+    )
+
+
+# -- 1: hidden-tag latest-write-wins join -----------------------------------
+
+
+def test_hidden_tag_projection_joins_latest_write(tmp_path):
+    reg, eng = _engine(tmp_path)
+    reg.create_index_rule(IndexRule("g", "id_rule", ("id",)))
+    reg.create_index_rule_binding(IndexRuleBinding(
+        "g", "bind_m", ("id_rule",), "measure", "m",
+    ))
+    # same series (svc=a): the id REWRITE at t+2 wins for EVERY row
+    eng.write(WriteRequest("g", "m", (
+        _pt(T0, "a", "one", 1),
+        _pt(T0 + 1, "a", "one", 2),
+        _pt(T0 + 2, "a", "two", 3),
+        _pt(T0, "b", "bee", 9),  # other series untouched
+    )))
+    eng.flush()
+    res = eng.query(QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+        tag_projection=("svc", "id"),
+    ))
+    by_row = {
+        (dp["tags"]["svc"], dp["timestamp"]): dp["tags"]["id"]
+        for dp in res.data_points
+    }
+    assert by_row[("a", T0)] == "two"  # joined, not the stored "one"
+    assert by_row[("a", T0 + 1)] == "two"
+    assert by_row[("a", T0 + 2)] == "two"
+    assert by_row[("b", T0)] == "bee"
+
+    # FILTER on the hidden tag also sees the joined value: id = 'one'
+    # matches nothing (no series' latest id is 'one')
+    res = eng.query(QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+        criteria=Condition("id", "eq", "one"),
+        tag_projection=("svc", "id"),
+    ))
+    assert res.data_points == []
+    res = eng.query(QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+        criteria=Condition("id", "eq", "two"),
+        tag_projection=("svc", "id"),
+    ))
+    assert len(res.data_points) == 3  # every row of series a
+
+
+def test_hidden_tag_filter_not_zone_pruned_across_parts(tmp_path):
+    """Review pin: a hidden-tag predicate must not BLOCK-PRUNE on the
+    stored per-row values — a part written before the rewrite lacks the
+    new value in its dictionary, yet its rows match after the join."""
+    reg, eng = _engine(tmp_path)
+    reg.create_index_rule(IndexRule("g", "id_rule", ("id",)))
+    reg.create_index_rule_binding(IndexRuleBinding(
+        "g", "bind_m", ("id_rule",), "measure", "m",
+    ))
+    # part 1 holds only id='old'; part 2 rewrites the series to 'new'
+    eng.write(WriteRequest("g", "m", (_pt(T0, "a", "old", 1),)))
+    eng.flush()
+    eng.write(WriteRequest("g", "m", (_pt(T0 + 5, "a", "new", 2),)))
+    eng.flush()
+    res = eng.query(QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+        criteria=Condition("id", "eq", "new"),
+        tag_projection=("svc", "id"),
+    ))
+    # BOTH rows of series a match under the joined value — the part-1
+    # block (whose dict lacks 'new') must not have been skipped
+    assert sorted(dp["timestamp"] for dp in res.data_points) == [
+        T0, T0 + 5,
+    ]
+    assert all(dp["tags"]["id"] == "new" for dp in res.data_points)
+
+
+def test_unindexed_tags_stay_per_row(tmp_path):
+    """No index binding -> no join: the per-row storage semantics are
+    untouched for ordinary tags."""
+    _reg, eng = _engine(tmp_path)
+    eng.write(WriteRequest("g", "m", (
+        _pt(T0, "a", "one", 1),
+        _pt(T0 + 1, "a", "two", 2),
+    )))
+    res = eng.query(QueryRequest(
+        groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+        tag_projection=("svc", "id"),
+    ))
+    ids = sorted(dp["tags"]["id"] for dp in res.data_points)
+    assert ids == ["one", "two"]
+
+
+# -- 2: entity-combination algebra ------------------------------------------
+
+
+def test_conflicting_entity_and_rejected(tmp_path):
+    _reg, eng = _engine(tmp_path)
+    eng.write(WriteRequest("g", "m", (_pt(T0, "a", "x", 1),)))
+    conflict = LogicalExpression(
+        "and",
+        Condition("svc", "eq", "a"),
+        Condition("svc", "eq", "b"),
+    )
+    with pytest.raises(ValueError, match="entity"):
+        eng.query(QueryRequest(
+            groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+            criteria=conflict,
+        ))
+
+
+def test_conflicting_and_of_or_entity_rejected(tmp_path):
+    """The deep-OR golden shape: OR branches build entity value sets,
+    the AND intersects them to empty -> reject (parseEntities nil)."""
+    _reg, eng = _engine(tmp_path)
+    eng.write(WriteRequest("g", "m", (_pt(T0, "a", "x", 1),)))
+    crit = LogicalExpression(
+        "and",
+        LogicalExpression(
+            "or",
+            Condition("svc", "eq", "a"),
+            Condition("svc", "eq", "b"),
+        ),
+        LogicalExpression(
+            "or",
+            Condition("svc", "eq", "c"),
+            Condition("svc", "eq", "d"),
+        ),
+    )
+    with pytest.raises(ValueError, match="entity"):
+        eng.query(QueryRequest(
+            groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+            criteria=crit,
+        ))
+
+
+def test_satisfiable_entity_algebra_passes(tmp_path):
+    _reg, eng = _engine(tmp_path)
+    eng.write(WriteRequest("g", "m", (
+        _pt(T0, "a", "x", 1), _pt(T0, "b", "y", 2),
+    )))
+    # overlapping OR sets intersect non-empty; non-entity tags never
+    # participate; OR of disjoint entity values alone is fine
+    ok = [
+        LogicalExpression(
+            "and",
+            LogicalExpression(
+                "or",
+                Condition("svc", "eq", "a"),
+                Condition("svc", "eq", "b"),
+            ),
+            LogicalExpression(
+                "or",
+                Condition("svc", "eq", "a"),
+                Condition("svc", "eq", "c"),
+            ),
+        ),
+        LogicalExpression(
+            "and",
+            Condition("id", "eq", "x"),
+            Condition("id", "eq", "y"),  # NON-entity conflict: allowed
+        ),
+        LogicalExpression(
+            "or",
+            Condition("svc", "eq", "a"),
+            Condition("svc", "eq", "zzz"),
+        ),
+    ]
+    for crit in ok:
+        res = eng.query(QueryRequest(
+            groups=("g",), name="m", time_range=TimeRange(T0, T0 + 10),
+            criteria=crit,
+        ))
+        assert res is not None
+
+
+# -- 3: multi-group TopN -----------------------------------------------------
+
+
+def test_multi_group_topn_rank_merge(tmp_path):
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+
+    from banyandb_tpu.api import pb
+    from banyandb_tpu.api.grpc_server import WireServices
+    from banyandb_tpu.models.stream import StreamEngine
+
+    reg, eng = _engine(tmp_path, groups=("g1", "g2"))
+    for g in ("g1", "g2"):
+        reg.create_topn(TopNAggregation(
+            group=g, name="top_m", source_measure="m", field_name="v",
+        ))
+    # g1 entities a=10, b=5; g2 entities c=8, a=3 -> merged distinct
+    # best desc: a=10, c=8, b=5
+    eng.write(WriteRequest("g1", "m", (
+        _pt(T0, "a", "x", 10), _pt(T0 + 1, "b", "x", 5),
+    )))
+    eng.write(WriteRequest("g2", "m", (
+        _pt(T0, "c", "x", 8), _pt(T0 + 1, "a", "x", 3),
+    )))
+    eng.topn.flush_all_windows()
+    eng.flush()
+    svc = WireServices(
+        reg, eng, StreamEngine(reg, tmp_path / "data")
+    )
+
+    class _Ctx:
+        def abort(self, code, details):
+            raise AssertionError(f"{code}: {details}")
+
+    req = pb.measure_topn_pb2.TopNRequest(
+        groups=["g1", "g2"], name="top_m", top_n=3,
+    )
+    req.time_range.begin.seconds = (T0 - 120_000) // 1000
+    req.time_range.end.seconds = (T0 + 120_000) // 1000
+    out = svc.measure_topn(req, _Ctx())
+    got = [
+        (
+            it.entity[0].value.str.value,
+            it.value.int.value or it.value.float.value,
+        )
+        for it in out.lists[0].items
+    ]
+    assert got == [("a", 10), ("c", 8), ("b", 5)]
+
+
+# -- 4: TopN window version merge -------------------------------------------
+
+
+def test_topn_window_version_merge_replaces(tmp_path):
+    reg, eng = _engine(tmp_path)
+    reg.create_topn(TopNAggregation(
+        group="g", name="top_m", source_measure="m", field_name="v",
+    ))
+    # same (series, ts) rewritten with increasing versions: only the
+    # LAST version's value may feed the counters
+    eng.write(WriteRequest("g", "m", (_pt(T0, "a", "x", 100, version=1),)))
+    eng.write(WriteRequest("g", "m", (_pt(T0, "a", "x", 7, version=2),)))
+    # a STALE version arriving late must lose
+    eng.write(WriteRequest("g", "m", (_pt(T0, "a", "x", 999, version=1),)))
+    eng.write(WriteRequest("g", "m", (_pt(T0 + 1, "b", "x", 5, version=1),)))
+    eng.topn.flush_all_windows()
+    eng.flush()
+    from banyandb_tpu.models.topn import query_topn
+
+    ranked = query_topn(
+        eng, "g", "top_m",
+        TimeRange(T0 - 120_000, T0 + 120_000), n=5,
+    )
+    assert ranked == [(("a",), 7.0), (("b",), 5.0)]
+
+
+def test_topn_version_merge_retracts_at_counter_capacity(tmp_path):
+    """Review pin: a rewrite that moves a (series, ts) row to an UNSEEN
+    entity while counters are full must still retract the superseded
+    contribution (the dead version must never keep ranking)."""
+    reg, eng = _engine(tmp_path)
+    reg.create_topn(TopNAggregation(
+        group="g", name="top_m", source_measure="m", field_name="v",
+        group_by_tag_names=("id",),  # id extends the counter key
+        counters_number=2,
+    ))
+    # fill both counter slots: (a, x) and (b, y)
+    eng.write(WriteRequest("g", "m", (
+        _pt(T0, "a", "x", 100, version=1),
+        _pt(T0 + 1, "b", "y", 50, version=1),
+    )))
+    # rewrite (a, T0) onto a NEW counter key (a, z): no slot free —
+    # the new value is uncounted (bounded counters), but the old +100
+    # must be retracted, leaving only b=50 ranked
+    eng.write(WriteRequest("g", "m", (_pt(T0, "a", "z", 7, version=2),)))
+    eng.topn.flush_all_windows()
+    eng.flush()
+    from banyandb_tpu.models.topn import query_topn
+
+    ranked = query_topn(
+        eng, "g", "top_m",
+        TimeRange(T0 - 120_000, T0 + 120_000), n=5,
+    )
+    assert ranked == [(("b",), 50.0)]
+
+
+def test_topn_version_merge_columnar_path(tmp_path):
+    import numpy as np
+
+    reg, eng = _engine(tmp_path)
+    reg.create_topn(TopNAggregation(
+        group="g", name="top_m", source_measure="m", field_name="v",
+    ))
+    def cols(vals, versions):
+        eng.write_columns(
+            "g", "m",
+            ts_millis=np.asarray([T0, T0 + 1], dtype=np.int64),
+            tags={"svc": ["a", "b"], "id": ["x", "x"]},
+            fields={"v": np.asarray(vals, dtype=np.float64)},
+            versions=np.asarray(versions, dtype=np.int64),
+        )
+    cols([100.0, 50.0], [1, 1])
+    cols([7.0, 5.0], [2, 2])  # rewrite both rows
+    eng.topn.flush_all_windows()
+    eng.flush()
+    from banyandb_tpu.models.topn import query_topn
+
+    ranked = query_topn(
+        eng, "g", "top_m",
+        TimeRange(T0 - 120_000, T0 + 120_000), n=5,
+    )
+    assert ranked == [(("a",), 7.0), (("b",), 5.0)]

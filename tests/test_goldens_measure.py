@@ -38,22 +38,12 @@ WANT_DIR = CASES / "measure/data/want"
 ENTRIES = parse_entries(GO_REGISTRY) if GO_REGISTRY.exists() else []
 
 # Cases this harness cannot replay, each with the concrete reason.
-SKIP: dict[str, str] = {
-    "filter hidden tag projection": (
-        "the reference stores indexed non-entity tags ('hidden' tags, "
-        "e.g. id) as series-level metadata docs where the latest-ts "
-        "write wins and joins them onto every row of the series "
-        "(write_standalone.go metadataDocs); this engine stores them "
-        "per row — rewrites of the same series at other timestamps "
-        "keep their own id values"
-    ),
-    "gen: tree depth 5 deep OR": (
-        "reference rejects this shape via its entity-combination algebra "
-        "(parseEntities nil on conflicting AND-of-OR entity literals, "
-        "pkg/query/logical/parser.go:157); this engine evaluates the "
-        "tree as plain mask algebra and returns rows instead"
-    ),
-}
+# (Former entries closed by ROADMAP item 6d: hidden-tag projection now
+# applies the reference's latest-write-wins series join
+# (models/measure._join_hidden_tags) and conflicting AND-of-OR entity
+# literals are rejected by the entity-combination algebra
+# (query/logical.check_entity_combinations).)
+SKIP: dict[str, str] = {}
 for _e in ENTRIES:
     if _e.get("stages"):
         SKIP[_e["name"]] = (

@@ -36,18 +36,12 @@ WANT_DIR = CASES / "topn/data/want"
 
 ENTRIES = parse_entries(GO_REGISTRY) if GO_REGISTRY.exists() else []
 
-SKIP: dict[str, str] = {
-    "multi-group: max top3 order by desc": (
-        "TopNRequest spanning multiple groups (cross-group rank merge) "
-        "is not implemented; single-group TopN covers the rule surface"
-    ),
-    "max top3 with version merged order by desc": (
-        "pre-aggregation windows ADD source rows; the reference "
-        "version-merges rewrites of the same (series, ts) before "
-        "feeding counters — needs per-(series, ts) last-version "
-        "tracking inside windows"
-    ),
-}
+# (Former entries closed by ROADMAP item 6d: TopNRequests spanning
+# multiple groups distinct-best merge + re-rank across groups
+# (grpc_server.measure_topn), and pre-aggregation windows version-merge
+# rewrites of the same (series, ts) before feeding counters
+# (models/topn.TopNProcessorManager._accumulate).)
+SKIP: dict[str, str] = {}
 
 
 @pytest.fixture(scope="module")

@@ -170,6 +170,15 @@ def test_server_cli_end_to_end(server):
     got = dict(zip(tuple(tuple(g) for g in res["groups"]), res["values"]["sum(value)"]))
     assert got[("s0",)] == sum(i for i in range(30) if i % 3 == 0)
 
+    # the lifecycle loop flushes every second: on a slow box it can
+    # drain the memtable between the write above and this snapshot,
+    # making `flushed` legitimately empty.  A fresh point immediately
+    # before the snapshot shrinks that race window to the two CLI
+    # round-trips (milliseconds).
+    _cli(server, "write", "sw", "cpm", "--point", json.dumps(
+        {"ts": T0 + 999, "tags": {"svc": "s0", "region": "us"},
+         "fields": {"value": 1}, "version": 1}
+    ))
     snap = _cli(server, "snapshot")
     assert snap["flushed"]
 
