@@ -145,6 +145,26 @@ def main(argv=None) -> int:
     )
     ex.add_argument("ql", help="BydbQL text")
 
+    rb = sub.add_parser(
+        "rebalance",
+        help="elastic-cluster shard placement (liaison role; "
+        "docs/robustness.md 'Elastic cluster'): plan a minimal part-move "
+        "list toward a target topology, apply it live (dual-route "
+        "catch-up window, epoch-bumping cutover), or show placement/"
+        "repair status",
+    )
+    rb.add_argument("action", choices=["plan", "apply", "status", "repair"])
+    rb.add_argument(
+        "--nodes", default="",
+        help="comma-separated target node names (default: the liaison's "
+        "current discovery addr book — i.e. 'make placement match "
+        "membership')",
+    )
+    rb.add_argument(
+        "--replicas", type=int, default=None,
+        help="override the replica count in the new placement",
+    )
+
     sl = sub.add_parser(
         "slowlog",
         help="slow-query flight recorder: span trees + plan text of "
@@ -281,6 +301,13 @@ def main(argv=None) -> int:
     elif args.cmd == "explain":
         reply = _call(args, TOPIC_QL, {"ql": args.ql, "trace": True})
         print(render_explain(reply))
+    elif args.cmd == "rebalance":
+        env = {"op": args.action}
+        if args.nodes:
+            env["nodes"] = [n for n in args.nodes.split(",") if n]
+        if args.replicas is not None:
+            env["replicas"] = args.replicas
+        print(json.dumps(_call(args, "rebalance", env), indent=1))
     elif args.cmd == "slowlog":
         env = {"limit": args.limit}
         if args.clear:

@@ -10,5 +10,10 @@ the reference's in-process multi-node test trick) and gRPC sockets.
 
 from banyandb_tpu.cluster.bus import Topic, LocalBus
 from banyandb_tpu.cluster.node import NodeInfo, RoundRobinSelector
+from banyandb_tpu.cluster.placement import (
+    PlacementMap,
+    PlacementSelector,
+    StaleEpoch,
+)
 from banyandb_tpu.cluster.data_node import DataNode
 from banyandb_tpu.cluster.liaison import Liaison

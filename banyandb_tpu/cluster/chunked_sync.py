@@ -219,6 +219,20 @@ def _part_layout(part_dir: Path) -> tuple[list, list[Path], int]:
     return files, paths, off
 
 
+def parse_epoch_topic(topic: str) -> "tuple[str, int | None]":
+    """Split a ``<topic>@epoch=N`` wire topic -> (bare topic, epoch or
+    None).  The placement-epoch fence rides the topic string because
+    the SyncMetadata proto has no spare field; receivers parse it here
+    and feed their EpochRecord (docs/robustness.md "Elastic cluster")."""
+    base, sep, tail = topic.partition("@epoch=")
+    if not sep:
+        return topic, None
+    try:
+        return base, int(tail)
+    except ValueError:
+        return base, None
+
+
 def sync_part_dirs(
     channel: grpc.Channel,
     part_dirs: Iterable[str | Path],
@@ -229,8 +243,13 @@ def sync_part_dirs(
     sender_node: str = "liaison",
     chunk_size: int = CHUNK_SIZE,
     timeout: float = 120.0,
+    placement_epoch: "int | None" = None,
 ):
     """Ship sealed part dirs over one SyncPart stream; -> SyncResult.
+
+    placement_epoch: optional epoch fence — stamped as a ``@epoch=N``
+    topic suffix so the receiver can reject sessions from a sender
+    routing on a superseded placement map.
 
     Raises TransportError on any non-OK chunk status or stream failure.
     """
@@ -293,7 +312,11 @@ def sync_part_dirs(
             if idx == 0:
                 req.metadata.group = group
                 req.metadata.shard_id = shard_id
-                req.metadata.topic = topic
+                req.metadata.topic = (
+                    f"{topic}@epoch={placement_epoch}"
+                    if placement_epoch is not None
+                    else topic
+                )
                 req.metadata.total_parts = len(parts_info)
                 req.metadata.sender_node = sender_node
             idx += 1

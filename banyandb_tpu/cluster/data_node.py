@@ -58,6 +58,17 @@ class DataNode:
         except (OSError, ValueError):
             self._installed = {}
         self._installed_lock = threading.Lock()
+        # placement-epoch write fence (cluster/placement.py): the
+        # highest epoch this node has seen, persisted so a restart
+        # keeps rejecting writers from before the last witnessed
+        # cutover (docs/robustness.md "Elastic cluster")
+        from banyandb_tpu.cluster.placement import EpochRecord
+
+        self.epoch_record = EpochRecord(self.root / ".placement-epoch.json")
+        # content-digest cache for rebalance/repair manifests (parts
+        # are immutable, so a digest computed once is good forever)
+        self._manifest_digests: dict[str, str] = {}
+        self._manifest_lock = threading.Lock()
         self._sync_sessions: dict[str, dict] = {}
         # abandoned chunked-sync sessions from a previous process die here
         shutil.rmtree(self.root / ".sync-staging", ignore_errors=True)
@@ -138,6 +149,12 @@ class DataNode:
         # liaisons broadcast dashboard signature registrations here;
         # stats expose window/watermark state per node
         self.bus.subscribe("streamagg", self._on_streamagg)
+        # elastic-cluster control surface (docs/robustness.md):
+        # placement-epoch get/adopt + the rebalance/repair data plane
+        # (per-shard part manifests, chunked part pulls, all-model
+        # flush before a manifest snapshot)
+        self.bus.subscribe("placement", self._on_placement)
+        self.bus.subscribe("rebalance", self._on_rebalance)
         # node-local TopN ranking over pre-aggregated windows — scatter
         # callers (the worker pool, a future liaison TopN plane) merge
         # per-node ranked lists
@@ -187,6 +204,201 @@ class DataNode:
             }
         raise ValueError(f"bad streamagg op {op!r}")
 
+    # -- elastic-cluster control surface (docs/robustness.md) ---------------
+    def _on_placement(self, env: dict) -> dict:
+        """Placement-epoch surface: ``get`` reads the fence, ``set``
+        adopts a cutover broadcast (ratchet-up; adopting never
+        rejects — only WRITE envelopes can be stale)."""
+        op = env.get("op", "get")
+        if op == "set":
+            e = int(env["epoch"])
+            if e > self.epoch_record.epoch:
+                self.epoch_record.observe(e, source="placement-set")
+            return {"epoch": self.epoch_record.epoch, "node": self.name}
+        if op == "get":
+            return {"epoch": self.epoch_record.epoch, "node": self.name}
+        raise ValueError(f"bad placement op {op!r}")
+
+    def _on_rebalance(self, env: dict) -> dict:
+        """Rebalance/repair data plane (cluster/rebalance.py mover):
+
+        - ``flush``: drain every engine's memtables so the next
+          manifest snapshot covers all acked rows as parts;
+        - ``manifest``: per-shard part inventory with install-dedup
+          digest keys (the sealer's part uuid when stamped, content
+          sha256 otherwise — the SAME keys the sync-install dedup
+          uses, so a re-ship of a listed part is always a no-op);
+        - ``pull``: one CRC-able chunk of one part file (the mover
+          re-ships it to the new owner through Topic.SYNC_PART)."""
+        op = env.get("op")
+        if op == "flush":
+            return {
+                "flushed": {
+                    "measure": self.measure.flush(),
+                    "stream": self.stream.flush(),
+                    "trace": self.trace.flush(),
+                }
+            }
+        if op == "manifest":
+            parts, skipped = self._shard_manifest(int(env["shard"]))
+            return {"parts": parts, "skipped": skipped}
+        if op == "pull":
+            return self._pull_part_chunk(env)
+        if op == "pull_all":
+            return self._pull_part_all(env)
+        raise ValueError(f"bad rebalance op {op!r}")
+
+    def _engine_groups(self, engine, catalog: str) -> list[str]:
+        """Groups with on-disk data for one catalog: already-open TSDBs
+        plus directories from a previous process life (a restarted node
+        must manifest parts it has not re-opened yet)."""
+        names = set(engine._tsdbs)
+        cat_root = self.root / catalog
+        try:
+            names.update(d.name for d in cat_root.iterdir() if d.is_dir())
+        except OSError:
+            pass
+        return sorted(names)
+
+    def _part_digest_key(self, group: str, shard_idx: int, part) -> str:
+        """Manifest identity == install-dedup identity (`_synced_part_key`
+        semantics): sealer part uuid when present, else a cached content
+        sha256 over the part's files."""
+        sess = part.meta.get("seal_session")
+        if sess:
+            return f"{group}/{shard_idx}/uuid:{sess}"
+        cache_key = str(part.dir)
+        with self._manifest_lock:
+            hit = self._manifest_digests.get(cache_key)
+        if hit is None:
+            files = {
+                f.name: f.read_bytes()
+                for f in sorted(part.dir.iterdir())
+                if f.is_file()
+            }
+            hit = self._synced_part_digest(files)
+            with self._manifest_lock:
+                self._manifest_digests[cache_key] = hit
+                # parts come and go with merges/retention: bound the cache
+                while len(self._manifest_digests) > 4096:
+                    self._manifest_digests.pop(
+                        next(iter(self._manifest_digests))
+                    )
+        return f"{group}/{shard_idx}/{hit}"
+
+    def _shard_manifest(self, shard_idx: int) -> "tuple[list[dict], int]":
+        """-> (entries, skipped): `skipped` counts parts that vanished
+        under the merge loop mid-listing — the mover treats them like
+        gone pulls (another round with a fresh manifest)."""
+        skipped = 0
+        out: list[dict] = []
+        for engine, catalog in (
+            (self.measure, "measure"),
+            (self.stream, "stream"),
+            (self.trace, "trace"),
+        ):
+            for group in self._engine_groups(engine, catalog):
+                try:
+                    db = engine._tsdb(group)
+                except Exception:  # noqa: BLE001 - foreign dir under the
+                    continue  # catalog root is not a group tree
+                for seg in db.segments:
+                    if shard_idx >= len(seg.shards):
+                        continue
+                    for part in seg.shards[shard_idx].parts:
+                        try:
+                            files = {
+                                f.name: f.stat().st_size
+                                for f in sorted(part.dir.iterdir())
+                                if f.is_file()
+                            }
+                            key = self._part_digest_key(
+                                group, shard_idx, part
+                            )
+                        except FileNotFoundError:
+                            # merged away between the parts snapshot and
+                            # the stat/read: its rows live on in the
+                            # merged part, visible to the NEXT manifest
+                            # — skip instead of failing the whole
+                            # manifest (which would read as a dead node)
+                            skipped += 1
+                            continue
+                        out.append({
+                            "key": key,
+                            "catalog": catalog,
+                            "group": group,
+                            "segment": seg.root.name,
+                            "segment_start": int(seg.start),
+                            "shard": shard_idx,
+                            "part": part.dir.name,
+                            "files": files,
+                            "min_ts": int(part.meta.get("min_ts", seg.start)),
+                        })
+        return out, skipped
+
+    def _pull_part_chunk(self, env: dict) -> dict:
+        import base64
+
+        engine = {
+            "stream": self.stream,
+            "trace": self.trace,
+        }.get(env.get("catalog", "measure"), self.measure)
+        db = engine._tsdb(env["group"])
+        seg = db.segment_for(int(env["segment_start"]))
+        pdir = seg.shards[int(env["shard"])].root / env["part"]
+        fpath = pdir / env["file"]
+        # containment: the wire names a file inside THIS part dir only
+        if fpath.parent != pdir or "/" in env["file"] or ".." in env["file"]:
+            raise ValueError(f"bad pull file {env['file']!r}")
+        offset = int(env.get("offset", 0))
+        length = int(env.get("length", 1 << 20))
+        try:
+            with open(fpath, "rb") as fh:
+                fh.seek(offset)
+                blob = fh.read(length)
+                eof = fh.read(1) == b""
+            size = fpath.stat().st_size
+        except FileNotFoundError:
+            # the lifecycle merge loop rewrote this part between the
+            # manifest snapshot and the pull: its rows live on in the
+            # merged part, which the NEXT manifest round ships
+            return {"gone": True, "data": "", "eof": True, "size": 0}
+        return {
+            "data": base64.b64encode(blob).decode(),
+            "eof": eof,
+            "size": size,
+        }
+
+    def _pull_part_all(self, env: dict) -> dict:
+        """Whole-part pull in ONE reply when the part fits the bundle
+        cap (per-RPC latency dominates small-part moves on slow
+        loopbacks); oversize parts return truncated=True and the mover
+        falls back to per-file chunk pulls."""
+        import base64
+
+        engine = {
+            "stream": self.stream,
+            "trace": self.trace,
+        }.get(env.get("catalog", "measure"), self.measure)
+        db = engine._tsdb(env["group"])
+        seg = db.segment_for(int(env["segment_start"]))
+        pdir = seg.shards[int(env["shard"])].root / env["part"]
+        cap = int(env.get("cap_bytes", 24 << 20))
+        try:
+            files = sorted(f for f in pdir.iterdir() if f.is_file())
+            if sum(f.stat().st_size for f in files) > cap:
+                return {"truncated": True, "files": {}}
+            return {
+                "truncated": False,
+                "files": {
+                    f.name: base64.b64encode(f.read_bytes()).decode()
+                    for f in files
+                },
+            }
+        except FileNotFoundError:
+            # merged away between manifest and pull (see _pull_part_chunk)
+            return {"gone": True, "truncated": False, "files": {}}
+
     def _on_diagnostics(self, env: dict) -> dict:
         from banyandb_tpu.admin.diagnostics import DiagnosticsCollector
 
@@ -195,7 +407,20 @@ class DataNode:
         )
 
     # -- stream plane (stream svc_data analog) ------------------------------
+    def _fence_epoch(self, env: dict, site: str) -> None:
+        """Stale-epoch write fence: envelopes stamped with an older
+        placement epoch than this node has witnessed are REJECTED
+        (retryable kind="stale_epoch" on the wire) — a mover and a
+        straggling liaison can never double-apply a write across a
+        rebalance cutover.  Fresher epochs are adopted (and persisted):
+        epoch knowledge gossips with ordinary traffic, so a node that
+        missed the cutover broadcast still converges."""
+        e = env.get("placement_epoch")
+        if e is not None:
+            self.epoch_record.observe(int(e), source=site)
+
     def _on_stream_write(self, env: dict) -> dict:
+        self._fence_epoch(env, "stream-write")
         # schema piggybacked on first contact (streams live outside the
         # core registry kinds; liaison ships the spec with writes)
         if "schema" in env:
@@ -247,6 +472,7 @@ class DataNode:
 
     # -- trace plane (trace svc_data analog) --------------------------------
     def _on_trace_write(self, env: dict) -> dict:
+        self._fence_epoch(env, "trace-write")
         if "schema" in env:
             item = env["schema"]
             try:
@@ -312,6 +538,7 @@ class DataNode:
     def _on_measure_write(self, env: dict) -> dict:
         import time as _time
 
+        self._fence_epoch(env, "measure-write")
         self.disk.check_write()
         req = serde.write_request_from_json(env["request"])
         t0 = _time.perf_counter()
@@ -327,6 +554,7 @@ class DataNode:
         this topic."""
         import time as _time
 
+        self._fence_epoch(env, "measure-write-cols")
         self.disk.check_write()
         t0 = _time.perf_counter()
         n = self.measure.write_columns(**serde.write_columns_env_decode(env))
@@ -450,6 +678,10 @@ class DataNode:
         phase = env["phase"]
         session = env["session"]
         if phase == "begin":
+            # the part-ship plane is fenced too: a straggling sender's
+            # sealed part from before a cutover must not install on an
+            # owner the new placement no longer routes reads to
+            self._fence_epoch(env, "sync-part")
             # Stage OUTSIDE the shard dir: opening the shard GCs unlisted
             # entries, which would eat an in-flight session.
             dest = self.root / ".sync-staging" / session
@@ -462,6 +694,15 @@ class DataNode:
                 "shard": env["shard"],
             }
             return {"accepted": True}
+        if phase == "abort":
+            # sender gave up mid-session (e.g. the pulled part vanished
+            # under a merge): drop the staged state
+            import shutil as _shutil
+
+            state = self._sync_sessions.pop(session, None)
+            if state is not None:
+                _shutil.rmtree(state["dir"], ignore_errors=True)
+            return {"aborted": True}
         state = self._sync_sessions.get(session)
         if state is None:
             raise KeyError(f"unknown sync session {session}")
@@ -473,6 +714,19 @@ class DataNode:
             assert len(buf) == env["offset"], "out-of-order chunk"
             buf.extend(blob)
             return {"received": len(blob)}
+        if phase == "files":
+            # batched small-part form (the rebalance mover): every file
+            # of the part in one envelope, CRC'd per file — cuts the
+            # per-RPC latency tax a chunk-per-call stream pays on small
+            # parts
+            total = 0
+            for fname, data in env["files"].items():
+                blob = base64.b64decode(data)
+                if zlib.crc32(blob) != env["crc32s"][fname]:
+                    raise ValueError(f"file CRC mismatch for {fname}")
+                state["files"][fname] = bytearray(blob)
+                total += len(blob)
+            return {"received": total}
         if phase == "finish":
             # materialize the part dir, then introduce it into the shard
             # (FinishSync -> introduce, §3.2 of SURVEY.md)
@@ -602,6 +856,16 @@ class DataNode:
         import json as _json
         import uuid as _uuid
 
+        # streaming-path epoch fence: the sender's placement epoch rides
+        # a @epoch=N suffix on the metadata topic (the proto has no
+        # spare field) — a straggling liaison's sealed part from before
+        # a cutover must not install on an owner the new placement no
+        # longer routes reads to
+        from banyandb_tpu.cluster.chunked_sync import parse_epoch_topic
+
+        _bare, epoch = parse_epoch_topic(getattr(meta, "topic", "") or "")
+        if epoch is not None:
+            self.epoch_record.observe(epoch, source="part-sync")
         self.disk.check_write()
         installed_any = False
         try:

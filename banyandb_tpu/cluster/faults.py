@@ -16,6 +16,22 @@ schedule-driven plane covering four boundaries:
 - ``kill``  — harness-driven process kills: the plane carries the
               schedule (which node dies at which chaos cycle), the
               harness (scripts/chaos.py) performs the kill.
+- ``partition`` — an ASYMMETRIC rpc blackhole between named node pairs
+              (``partition=blackhole:src=n0:dst=n1`` drops every call
+              n0 makes TO n1; the n1->n0 direction stays up).  The
+              process's own identity comes from ``set_local_node``
+              (servers set it from --name at boot); ``dst`` matches a
+              node name against the dialed address via the LocalTransport
+              ``local:<name>`` form or an explicit
+              ``register_node_addr(name, addr)`` mapping.  A standing
+              filter: it fires on every matching call (bounded only by
+              ``count``/``after``), unlike the seeded one-shot sites.
+- ``join`` / ``leave`` — membership-change schedules carried exactly
+              like ``kill`` (``join=n3:at=2;leave=n0:at=3``): the
+              harness reads them via ``kills_for_cycle(cycle,
+              site="join")`` / ``events_for_cycle`` and performs the
+              discovery edit + rebalance itself, so elastic-cluster
+              moves are chaos-testable under the same determinism.
 
 Spec grammar (``BYDB_FAULTS`` env var or an explicit ``configure()``):
 
@@ -63,6 +79,44 @@ from typing import Optional
 RPC_KINDS = ("error", "unavailable", "shed", "delay")
 SYNC_KINDS = ("cut", "truncate", "corrupt")
 DISK_KINDS = ("enospc", "short")
+PARTITION_KINDS = ("blackhole",)
+
+# -- node identity (the partition site's "who am I" + addr book) -------------
+# Process-global by design: production runs one node per process, and
+# the harness/tests set the identity explicitly per scenario.
+_LOCAL_NODE = ""
+_NODE_ADDRS: dict[str, set[str]] = {}
+_IDENT_LOCK = threading.Lock()
+
+
+def set_local_node(name: str) -> None:
+    """Declare this process's node identity for the ``partition`` site
+    (servers call it with --name at boot; "" clears)."""
+    global _LOCAL_NODE
+    _LOCAL_NODE = name or ""
+
+
+def local_node() -> str:
+    return _LOCAL_NODE
+
+
+def register_node_addr(name: str, addr: str) -> None:
+    """Teach the partition matcher a node's transport address (the
+    LocalTransport ``local:<name>`` form needs no registration)."""
+    with _IDENT_LOCK:
+        _NODE_ADDRS.setdefault(name, set()).add(addr)
+
+
+def clear_node_addrs() -> None:
+    with _IDENT_LOCK:
+        _NODE_ADDRS.clear()
+
+
+def _addr_is_node(name: str, addr: str) -> bool:
+    if addr == name or addr == f"local:{name}":
+        return True
+    with _IDENT_LOCK:
+        return addr in _NODE_ADDRS.get(name, ())
 
 
 class DeadlineExceeded(RuntimeError):
@@ -202,8 +256,55 @@ class FaultPlane:
             return dict(self._counters)
 
     # -- boundary hooks -----------------------------------------------------
+    def check_partition(self, local: str, addr: str, topic: str) -> None:
+        """partition boundary: drop the call when an active rule names
+        (local -> addr's node) — BEFORE the rpc site draws, so a
+        blackholed call never consumes an rpc decision.  Asymmetric by
+        construction: only the src->dst direction ever matches."""
+        rules = self._by_site.get("partition")
+        if not rules:
+            return
+        hit: Optional[_Rule] = None
+        with self._lock:
+            n = self._counters.get("partition", 0)
+            for rule in rules:
+                src = rule.params.get("src", "")
+                dst = rule.params.get("dst", "")
+                if src and src != local:
+                    continue
+                if dst and not _addr_is_node(dst, addr):
+                    continue
+                if rule.count is not None and rule.fired >= rule.count:
+                    continue
+                if n < rule.after:
+                    continue
+                hit = rule
+                break
+            # the decision counter advances only on MATCHING pairs: an
+            # un-partitioned peer's traffic never perturbs the site
+            if hit is not None:
+                self._counters["partition"] = n + 1
+                hit.fired += 1
+                if len(self.history) < self.HISTORY_CAP:
+                    self.history.append(("partition", n, hit.kind))
+        if hit is None:
+            return
+        from banyandb_tpu.cluster.rpc import TransportError
+
+        from banyandb_tpu.obs.metrics import global_meter
+
+        global_meter().counter_add(
+            "fault_injected", 1.0, {"site": "partition", "kind": hit.kind}
+        )
+        raise TransportError(
+            f"rpc to {addr} blackholed: partition {local or '?'}->"
+            f"{hit.params.get('dst', addr)} "
+            f"[fault site=partition kind={hit.kind}]"
+        )
+
     def fail_rpc(self, addr: str, topic: str) -> None:
         """rpc boundary: raise/delay per the schedule, before dispatch."""
+        self.check_partition(_LOCAL_NODE, addr, topic)
         act = self.decide("rpc", topic)
         if act is None:
             return
@@ -255,6 +356,19 @@ class FaultPlane:
             if int(rule.params.get("at", 0)) == cycle:
                 out.append(rule.kind)
         return out
+
+    def events_for_cycle(
+        self,
+        cycle: int,
+        sites: tuple[str, ...] = ("kill", "worker", "join", "leave"),
+    ) -> dict[str, list[str]]:
+        """Every scheduled membership/kill event for one chaos cycle:
+        {site: [node, ...]}.  ``join``/``leave`` ride the same
+        ``<site>=<node>:at=<cycle>`` grammar as kills — the harness
+        performs the discovery edit and the rebalance plan/apply, the
+        plane only carries the schedule (docs/robustness.md "Elastic
+        cluster")."""
+        return {site: self.kills_for_cycle(cycle, site=site) for site in sites}
 
 
 class _PlaneSyncInjector:

@@ -24,7 +24,8 @@ from banyandb_tpu.api.model import Aggregation, QueryRequest, QueryResult, Write
 from banyandb_tpu.api.schema import SchemaRegistry
 from banyandb_tpu.cluster import serde
 from banyandb_tpu.cluster.bus import Topic
-from banyandb_tpu.cluster.node import NodeInfo, RoundRobinSelector
+from banyandb_tpu.cluster.node import NodeInfo
+from banyandb_tpu.cluster.placement import PlacementMap, PlacementSelector
 from banyandb_tpu.cluster.rpc import TransportError
 from banyandb_tpu.obs.tracer import NOOP_TRACER, Tracer
 from banyandb_tpu.query import measure_exec
@@ -123,6 +124,7 @@ class Liaison:
         discovery=None,
         handoff_root: Optional[str] = None,
         query_budget_s: Optional[float] = None,
+        placement_store: "Optional[str]" = None,
     ):
         self.registry = registry
         self.transport = transport
@@ -138,7 +140,40 @@ class Liaison:
         )
         if discovery is not None:
             nodes = discovery.nodes()
-        self.selector = RoundRobinSelector(list(nodes), replicas)
+        # Explicit epoch-versioned placement (cluster/placement.py,
+        # docs/robustness.md "Elastic cluster").  The initial map has
+        # no explicit chains, so routing equals the historical
+        # round-robin byte-for-byte; a persisted store restores the
+        # last cutover's map (epochs survive liaison restarts).
+        # `placement`/`selector`/`_dual` follow the same concurrency
+        # contract as `alive`: immutable snapshots REBOUND under
+        # _placement_lock, read lock-free everywhere else.
+        self._placement_lock = threading.Lock()
+        from pathlib import Path as _Path
+
+        self._placement_store = (
+            _Path(placement_store) if placement_store else None
+        )
+        stored = (
+            PlacementMap.load(self._placement_store)
+            if self._placement_store is not None
+            else None
+        )
+        self.placement = stored or PlacementMap.initial(
+            [n.name for n in nodes], replicas
+        )
+        self.selector = PlacementSelector(list(nodes), self.placement)
+        # dual-route window (rebalance catch-up): shard -> extra owner
+        # names that receive every write ALONGSIDE the current chain
+        self._dual: dict[int, tuple[str, ...]] = {}
+        # membership change observed by refresh_nodes() but NOT applied
+        # to the chains (an explicit rebalance plan owns data movement)
+        self.pending_topology: Optional[tuple[str, ...]] = None
+        from banyandb_tpu.obs.metrics import global_meter
+
+        global_meter().gauge_set(
+            "placement_epoch", float(self.placement.epoch)
+        )
         # `alive` is read lock-free all over the query/write planes and
         # written from the probe thread AND every RPC worker that sees a
         # dead peer: it is therefore treated as an immutable snapshot —
@@ -164,14 +199,130 @@ class Liaison:
             self.handoff = HandoffController(handoff_root)
 
     def refresh_nodes(self) -> bool:
-        """Re-read discovery; rebuild placement when the node set changed
-        (discovery/{file,dns} polling loop analog)."""
+        """Re-read discovery on membership change — WITHOUT re-placing
+        shards (discovery/{file,dns} polling loop analog).
+
+        The addr book updates so joined nodes are reachable (schema
+        sync, rebalance part shipping) and departed nodes stop being
+        dialable, but the placement chains keep serving at the current
+        epoch: silently rebuilding the shard->node mapping on a node-set
+        change would reroute reads onto nodes that hold NO data (the
+        pre-placement hazard this method used to have).  A membership
+        change only PROPOSES — ``pending_topology`` records the new node
+        set; an explicit rebalance plan+apply (cluster/rebalance.py)
+        moves the parts and cuts the epoch over."""
         if self.discovery is None or not self.discovery.refresh():
             return False
         nodes = self.discovery.nodes()
-        self.selector = RoundRobinSelector(nodes, self.replicas)
+        with self._placement_lock:
+            self.selector = PlacementSelector(nodes, self.placement)
+            names = tuple(sorted(n.name for n in nodes))
+            self.pending_topology = (
+                names if names != self.placement.nodes else None
+            )
         self.probe()
         return True
+
+    # -- placement lifecycle (cluster/rebalance.py drives these) -------------
+    def begin_dual_route(self, adds: "dict[int, tuple[str, ...]]") -> None:
+        """Open the rebalance catch-up window: writes for each listed
+        shard fan to the current chain AND the named new owners, so no
+        row acked during a move exists only on the losing side."""
+        with self._placement_lock:
+            self._dual = {int(s): tuple(a) for s, a in adds.items() if a}
+
+    def end_dual_route(self) -> None:
+        with self._placement_lock:
+            self._dual = {}
+
+    def dual_route_shards(self) -> list[int]:
+        return list(self._dual)
+
+    def _write_replica_set(self, shard: int) -> list[NodeInfo]:
+        """Write-plane replica set: the chain plus any dual-route adds
+        for this shard (reads keep using the chain alone until
+        cutover — old owners hold everything mid-move)."""
+        out = self.selector.replica_set(shard)
+        extra = self._dual.get(shard, ())
+        if extra:
+            have = {n.name for n in out}
+            for nm in extra:
+                node = self.selector.node_by_name(nm)
+                if node is not None and node.name not in have:
+                    out.append(node)
+                    have.add(nm)
+        return out
+
+    def cutover(self, plan) -> int:
+        """Atomically switch to the plan's placement map: epoch bump,
+        persisted store, dual-route window closed.  The caller
+        (Rebalancer.apply) broadcasts the new epoch AFTER this returns
+        — RPC fan-out never happens under the placement lock."""
+        with self._placement_lock:
+            if plan.base_epoch != self.placement.epoch:
+                raise RuntimeError(
+                    f"cutover refused: plan base epoch {plan.base_epoch} "
+                    f"!= current {self.placement.epoch}"
+                )
+            new = plan.placement()
+            self.placement = new
+            self.selector = PlacementSelector(list(self.selector.nodes), new)
+            self._dual = {}
+            names = tuple(sorted(n.name for n in self.selector.nodes))
+            self.pending_topology = names if names != new.nodes else None
+            if self._placement_store is not None:
+                new.save(self._placement_store)
+        from banyandb_tpu.obs.metrics import global_meter
+
+        global_meter().gauge_set("placement_epoch", float(new.epoch))
+        return new.epoch
+
+    def broadcast_placement(self) -> dict[str, int]:
+        """Push the current epoch to every alive node (the cutover
+        fence).  Nodes missed here still learn the epoch from the next
+        fenced envelope — the broadcast only tightens the window."""
+        p = self.placement
+        acks: dict[str, int] = {}
+        for n in self.selector.nodes:
+            if n.name not in self.alive:
+                continue
+            try:
+                r = self.transport.call(
+                    n.addr, "placement",
+                    {"op": "set", "epoch": p.epoch},
+                    timeout=_RPC_CONTROL_S,
+                )
+                acks[n.name] = int(r.get("epoch", 0))
+            except TransportError:
+                continue
+        return acks
+
+    def _reload_placement(self) -> bool:
+        """A stale-epoch rejection means THIS liaison routes on a
+        superseded map (another liaison cut over).  Re-read the shared
+        placement store; -> True when a fresher map was adopted."""
+        if self._placement_store is None:
+            return False
+        fresh = PlacementMap.load(self._placement_store)
+        if fresh is None:
+            return False
+        with self._placement_lock:
+            if fresh.epoch <= self.placement.epoch:
+                return False
+            self.placement = fresh
+            self.selector = PlacementSelector(
+                list(self.selector.nodes), fresh
+            )
+            self._dual = {}
+        from banyandb_tpu.obs.metrics import global_meter
+
+        global_meter().gauge_set("placement_epoch", float(fresh.epoch))
+        return True
+
+    def _stamp_epoch(self, env: dict) -> dict:
+        """Fenced envelope: every write/scatter RPC carries the sender's
+        placement epoch so data nodes can reject superseded writers."""
+        return dict(env, placement_epoch=self.placement.epoch)
 
     def _mark_dead(self, name: str) -> None:
         """Drop a peer from the alive snapshot (rebind, never mutate:
@@ -230,9 +381,15 @@ class Liaison:
                         # the _replicate failure path: give replay the
                         # write budget, or a heavy spooled write that
                         # would succeed live strands the whole spool
-                        # (replay stops at the first failure)
+                        # (replay stops at the first failure).  The
+                        # epoch is re-stamped at REPLAY time: a spooled
+                        # repair copy from before a rebalance cutover
+                        # must not wedge the spool on the stale-epoch
+                        # fence (the delivery is an idempotent repair,
+                        # not a new acked write)
                         lambda topic, env, addr=node.addr: self.transport.call(
-                            addr, topic, env, timeout=_RPC_WRITE_S
+                            addr, topic, self._stamp_epoch(env),
+                            timeout=_RPC_WRITE_S,
                         ),
                     )
         return alive
@@ -451,7 +608,10 @@ class Liaison:
                 except (OSError, ValueError):
                     delivered = set()
             errors = []
-            for node in self.selector.replica_set(shard):
+            # write-plane set: dual-route adds receive sealed parts too
+            # (re-reading it per attempt means a retry AFTER a cutover
+            # ships to the new owners)
+            for node in self._write_replica_set(shard):
                 if node.name in delivered:
                     continue
                 if node.name not in self.alive:
@@ -460,13 +620,28 @@ class Liaison:
                 try:
                     chan = self.transport.channel(node.addr)
                     chunked_sync.sync_part_dirs(
-                        chan, [part_dir], group=group, shard_id=shard
+                        chan, [part_dir], group=group, shard_id=shard,
+                        # the epoch fence rides the stream topic: a
+                        # straggling shipper's sealed part from before
+                        # a cutover is rejected instead of installed on
+                        # an owner post-cutover reads never route to
+                        placement_epoch=self.placement.epoch,
                     )
                     delivered.add(node.name)
                     from banyandb_tpu.utils import fs as _fs
 
                     _fs.atomic_write_json(record, sorted(delivered))
                 except TransportError as e:
+                    # the streaming wire has no structured kind channel:
+                    # the fence's message marker identifies a stale-
+                    # epoch rejection (cluster/placement.py EpochRecord)
+                    if "refresh the placement map" in str(e):
+                        # fenced: refresh the map; the part stays
+                        # spooled and the retry re-reads the CURRENT
+                        # replica set (post-cutover owners)
+                        self._reload_placement()
+                        errors.append(f"{node.name}: {e}")
+                        continue
                     self._mark_dead(node.name)
                     # drop the stream's channel: a wedged one would
                     # otherwise poison every retry after the node
@@ -554,29 +729,55 @@ class Liaison:
           the caller when no replica accepted;
         - zero successful wire deliveries -> raise (a spool alone is a
           bounded cache, not durable storage);
+        - ANY stale-epoch rejection (kind="stale_epoch") FAILS the whole
+          write, even when another replica already accepted it: the
+          targets were all computed from a superseded placement map, so
+          an ack here could cover a row no post-cutover read would ever
+          route to.  The copy is NOT spooled (replaying a fenced write
+          is exactly the double-apply the fence exists to stop), the
+          placement store is re-read, and the retryable rejection
+          propagates — the caller's retry re-routes on the fresh map,
+          and the stray accepted copy collapses in version dedup (or
+          sits unrouted on a node the new map no longer reads);
         - known-down replica copies (spool_env) land in the spool so a
           recovered node replays the whole outage window."""
         delivered_to: set[str] = set()
         failed: dict[str, dict] = {}
-        shed_names: set[str] = set()
-        first_shed: Optional[TransportError] = None
+        rejected_names: set[str] = set()  # shed/stale: healthy nodes
+        first_stale: Optional[TransportError] = None
+        first_rejection: Optional[TransportError] = None
         for name, env in by_node_env.items():
             try:
                 self.transport.call(
-                    addr_of[name], topic, env, timeout=_RPC_WRITE_S
+                    addr_of[name], topic, self._stamp_epoch(env),
+                    timeout=_RPC_WRITE_S,
                 )
                 delivered_to.add(name)
             except TransportError as e:
+                kind = getattr(e, "kind", "error")
+                if kind == "stale_epoch":
+                    rejected_names.add(name)
+                    first_stale = first_stale or e
+                    first_rejection = first_rejection or e
+                    continue  # never spooled: the copy is fenced
                 failed[name] = env  # spooled below (shed AND dead alike)
-                if getattr(e, "kind", "error") == "shed":
-                    shed_names.add(name)
-                    first_shed = first_shed or e
+                if kind == "shed":
+                    rejected_names.add(name)
+                    first_rejection = first_rejection or e
                 else:
                     self._mark_dead(name)
-        if not delivered_to and failed and set(failed) == shed_names:
-            # every replica shed load: surface the retryable rejection
-            # itself rather than a generic unreachable error
-            raise first_shed
+        if first_stale is not None:
+            # catch up to the cutover that fenced us, then fail the
+            # write retryably EVEN IF a (equally stale-routed) replica
+            # accepted it — only a retry on the fresh map reaches the
+            # owners post-cutover reads actually route to
+            self._reload_placement()
+            raise first_stale
+        if not delivered_to and rejected_names and set(failed) <= rejected_names:
+            # every replica rejected retryably (shed load / stale
+            # epoch): surface the structured rejection itself rather
+            # than a generic unreachable error
+            raise first_rejection
         if not delivered_to and failed:
             raise TransportError(
                 f"write reached no replica (failed: {sorted(failed)})"
@@ -694,47 +895,31 @@ class Liaison:
         return {node: shards for node, shards in assignment.values()}
 
     # -- degraded-tolerant scatter (docs/robustness.md) ---------------------
-    def _reassign(
-        self, shards: list[int], exclude: set[str]
-    ) -> tuple[dict[NodeInfo, list[int]], list[int]]:
-        """Failover placement for shards whose assigned node failed
-        mid-query: each shard goes to its first alive replica outside
-        `exclude`; shards with none left come back uncovered."""
-        out: dict[NodeInfo, list[int]] = {}
-        uncovered: list[int] = []
-        alive = self.alive - exclude
-        for shard in shards:
-            try:
-                node = self.selector.primary(shard, alive)
-            except RuntimeError:
-                uncovered.append(shard)
-                continue
-            out.setdefault(node, []).append(shard)
-        return out, uncovered
-
     def _scatter_one(
         self, topic, node, shards, env_of, guard, t, on_reply, retry,
-        timeout_cap_s: float | None = None,
+        timeout_cap_s: float | None = None, attempt: int = 0,
     ) -> None:
         """One scatter leg under the query guard: deadline-clamped
         timeout, deadline_ms stamped on the envelope, structured failure
         handling.  `retry` (list or None) collects hard-failed legs for
-        the caller's one failover round; shed/deadline rejections mark
-        the node unavailable without eviction (it is healthy).
+        the caller's failover rounds; shed/deadline rejections mark the
+        node unavailable without eviction (it is healthy).
         `timeout_cap_s` further clamps the RPC timeout — the last-chance
         same-node retry uses it so a genuinely dead node costs seconds,
-        not the whole remaining budget."""
+        not the whole remaining budget.  `attempt` is the failover round
+        index, tagged on the span so a trace shows exactly which
+        replicas a leg walked."""
         if guard.expired():
             guard.mark(node.name, "deadline")
             return
         # remaining budget (deadline_ms) AND the absolute wall deadline:
         # the absolute form still fires after the request sat in the
         # receiver's executor queue (same-DC clock skew caveat applies)
-        env = dict(
+        env = self._stamp_epoch(dict(
             env_of(shards),
             deadline_ms=guard.deadline_ms(),
             deadline_unix_ms=time.time() * 1000.0 + guard.deadline_ms(),
-        )
+        ))
         if t is not NOOP_TRACER:
             # the caller holds a REAL tracer (serving surfaces always
             # do): ask the node for its span subtree even when the user
@@ -744,6 +929,8 @@ class Liaison:
             env["want_subtree"] = True
         with t.span(f"scatter:{node.name}") as sp:
             sp.tag("shards", list(shards))
+            if attempt:
+                sp.tag("attempt", attempt)
             timeout = guard.rpc_timeout()
             if timeout_cap_s is not None:
                 timeout = min(timeout, timeout_cap_s)
@@ -772,13 +959,20 @@ class Liaison:
         self, topic, assignment, env_of, guard, tracer, on_reply,
         *, failover: bool = True,
     ) -> None:
-        """Scatter with ONE failover round: legs that hard-fail get
-        their shards re-placed on surviving replicas; shards with no
-        survivor degrade the response instead of failing it.
+        """Scatter with EXHAUSTIVE failover: a leg that hard-fails gets
+        its shards re-placed on the next surviving replica, round after
+        round, until every replica in each shard's chain has been tried
+        or the query's deadline budget runs out — never just one round.
+        Each shard's tried-and-failed set grows monotonically, so the
+        walk terminates; per-attempt span tags (`attempt`) record the
+        path.  A shard whose whole chain failed gets one LAST-CHANCE
+        capped retry against its original node (a wedged-channel dial
+        heals on the fresh dial `call()`'s eviction forces) and then
+        degrades the response instead of failing it.
 
-        `failover=False` for TIERED groups: _reassign walks the
-        untiered replica chain, which for a failed warm-tier leg could
-        re-place shards onto a hot node that already answered —
+        `failover=False` for TIERED groups: the failover walk follows
+        the untiered replica chain, which for a failed warm-tier leg
+        could re-place shards onto a hot node that already answered —
         double-counting rows.  Tiered legs degrade directly instead."""
         t = tracer if tracer is not None else NOOP_TRACER
         retry: list[tuple[NodeInfo, list[int]]] = (
@@ -790,28 +984,70 @@ class Liaison:
             )
         if not retry:
             return
-        failed = {n.name for n, _s in retry}
+        from banyandb_tpu.obs.metrics import global_meter
+
+        meter = global_meter()
+        tried: dict[int, set[str]] = {}  # shard -> failed node names
+        origin: dict[int, NodeInfo] = {}  # shard -> first-assigned node
         for node, shards in retry:
-            placed, uncovered = self._reassign(shards, exclude=failed)
-            if uncovered:
-                # no surviving replica: before degrading, the ORIGINAL
-                # node gets the one failover attempt instead — a
-                # transient transport failure (the wedged-channel dial
-                # this kernel occasionally hands out; call() already
-                # evicted it) heals on a fresh dial, and a query leg is
-                # idempotent.  A genuinely dead node fails the terminal
-                # retry and the leg degrades exactly as before; the
-                # capped timeout keeps that cost to seconds even when
-                # the fresh dial itself wedges in connect.
+            for s in shards:
+                origin.setdefault(s, node)
+        attempt = 0
+        pending = retry
+        while pending:
+            attempt += 1
+            meter.counter_add("failover_attempts", 1.0)
+            for node, shards in pending:
+                for s in shards:
+                    tried.setdefault(s, set()).add(node.name)
+            placed: dict[str, tuple[NodeInfo, list[int]]] = {}
+            exhausted: list[int] = []
+            for node, shards in pending:
+                for s in shards:
+                    # bdlint: disable=retry-backoff -- the failover walk
+                    # dials a DIFFERENT replica each round (the tried
+                    # set grows monotonically, so it terminates);
+                    # sleeping between rounds would only burn the
+                    # query's deadline budget, not protect any endpoint
+                    try:
+                        alt = self.selector.primary(
+                            s, self.alive - tried[s]
+                        )
+                    except RuntimeError:
+                        exhausted.append(s)
+                        continue
+                    placed.setdefault(alt.name, (alt, []))[1].append(s)
+            if guard.expired():
+                # out of budget: every un-replaced shard degrades with
+                # its last failed node named
+                for node, shards in pending:
+                    guard.mark(node.name, "unreachable")
+                return
+            next_retry: list[tuple[NodeInfo, list[int]]] = []
+            for alt, alt_shards in placed.values():
+                # the replacement leg may itself fail: it joins the
+                # next round with this node added to the tried set
                 self._scatter_one(
-                    topic, node, uncovered, env_of, guard, t, on_reply,
-                    None, timeout_cap_s=3.0,
+                    topic, alt, alt_shards, env_of, guard, t, on_reply,
+                    next_retry, attempt=attempt,
                 )
-            for alt, alt_shards in placed.items():
-                # second failure is terminal for the leg (retry=None)
-                self._scatter_one(
-                    topic, alt, alt_shards, env_of, guard, t, on_reply, None
-                )
+            if exhausted:
+                # whole chain walked: one last-chance retry against the
+                # ORIGINAL node on a capped timeout — a transient
+                # transport failure (the wedged-channel dial this
+                # kernel occasionally hands out; call() already evicted
+                # it) heals on a fresh dial, and a query leg is
+                # idempotent.  Terminal: a second failure degrades.
+                last_chance: dict[str, tuple[NodeInfo, list[int]]] = {}
+                for s in exhausted:
+                    node = origin[s]
+                    last_chance.setdefault(node.name, (node, []))[1].append(s)
+                for node, shards in last_chance.values():
+                    self._scatter_one(
+                        topic, node, shards, env_of, guard, t, on_reply,
+                        None, timeout_cap_s=3.0, attempt=attempt,
+                    )
+            pending = next_retry
 
     def _failover_ok(self, group: str, stages: tuple[str, ...]) -> bool:
         """Replica-chain failover is sound only when the query runs
@@ -1035,7 +1271,9 @@ class Liaison:
         addr_of: dict[str, str] = {}
         for item in items:
             shard = shard_of(item)
-            replicas = self.selector.replica_set(shard)
+            # write plane: the chain plus any dual-route adds (a live
+            # rebalance fans writes to old AND new owners)
+            replicas = self._write_replica_set(shard)
             targets = [n for n in replicas if n.name in self.alive]
             if not targets:
                 raise TransportError(f"no alive replica for shard {shard}")
@@ -1235,11 +1473,14 @@ class ChunkedSyncClient:
         segment_start_millis: int,
         shard: str,
         meta_patch: Optional[dict] = None,
+        placement_epoch: Optional[int] = None,
     ) -> str:
         """meta_patch: extra keys merged into the shipped metadata.json
         (not the on-disk original) — tier migration uses it to stamp
         catalog/ordered_tags on engine-flushed parts so the receiver
-        routes and aux-indexes them like wqueue-sealed ones."""
+        routes and aux-indexes them like wqueue-sealed ones.
+        placement_epoch: optional epoch fence (cluster/placement.py) —
+        receivers reject sessions stamped with a superseded epoch."""
         import json as _json
         import zlib
         import base64
@@ -1254,6 +1495,8 @@ class ChunkedSyncClient:
             "segment_start_millis": segment_start_millis,
             "shard": shard,
         }
+        if placement_epoch is not None:
+            base["placement_epoch"] = placement_epoch
         self.transport.call(
             self.addr, Topic.SYNC_PART.value, dict(base, phase="begin"),
             timeout=_RPC_SYNC_S,

@@ -34,10 +34,12 @@ def _observe_rpc(side: str, topic: str, t0: float) -> None:
 
 class TransportError(RuntimeError):
     """kind: "error" (default), "shed" — the remote rejected the call to
-    shed load (DiskFull/ServerBusy); or "deadline" — the remote refused
-    work whose propagated deadline already expired.  Shed and
-    deadline-rejecting nodes are healthy and must not be treated as
-    dead."""
+    shed load (DiskFull/ServerBusy); "deadline" — the remote refused
+    work whose propagated deadline already expired; or "stale_epoch" —
+    the remote fenced a write stamped with a superseded placement epoch
+    (cluster/placement.py): the SENDER must refresh its map and retry.
+    Shed, deadline and stale-epoch rejecting nodes are healthy and must
+    not be treated as dead."""
 
     def __init__(self, msg: str, kind: str = "error"):
         super().__init__(msg)
@@ -49,14 +51,16 @@ _SHED_TYPES = ("DiskFull", "ServerBusy")
 
 
 def _error_kind(e: Exception) -> str:
-    """Classify a handler exception for the wire: shed rejections and
-    deadline refusals are structured (the caller must NOT evict the
-    node); everything else is a hard error."""
+    """Classify a handler exception for the wire: shed rejections,
+    deadline refusals and stale-epoch fences are structured (the caller
+    must NOT evict the node); everything else is a hard error."""
     name = type(e).__name__
     if name in _SHED_TYPES:
         return "shed"
     if name == "DeadlineExceeded":
         return "deadline"
+    if name == "StaleEpoch":
+        return "stale_epoch"
     return "error"
 
 

@@ -53,6 +53,10 @@ class DataServer:
         # and warm recorded + builtin plans before the first query lands
         from banyandb_tpu.query.precompile import default_registry
 
+        # the partition fault site needs this process's node identity
+        from banyandb_tpu.cluster import faults
+
+        faults.set_local_node(self.name)
         reg = default_registry()
         reg.attach_store(self.root / "plan-registry.json")
         reg.warm_async()
@@ -187,7 +191,21 @@ class LiaisonServer:
             discovery=FileDiscovery(discovery_file),
             replicas=replicas,
             handoff_root=str(self.root / "handoff"),
+            # epoch-versioned placement survives liaison restarts (and
+            # is how a straggling second liaison catches up after a
+            # stale-epoch rejection)
+            placement_store=str(self.root / "placement.json"),
         )
+        # elastic-cluster control plane (docs/robustness.md): operator
+        # rebalance surface + the background replica-repair loop
+        from banyandb_tpu.cluster.rebalance import Rebalancer, ReplicaRepairer
+
+        self.rebalancer = Rebalancer(self.liaison)
+        self.repairer = ReplicaRepairer(self.liaison)
+        from banyandb_tpu.utils.envflag import env_float as _env_float
+
+        self.repair_interval_s = _env_float("BYDB_REPAIR_INTERVAL_S", 30.0)
+        self._repair_thread: threading.Thread | None = None
         # schema plane: EVERY create/update on this liaison's registry —
         # whatever surface it arrived on (bus topic, proto wire, HTTP
         # gateway) — pushes to all data nodes (liaison/grpc/registry.go
@@ -300,6 +318,37 @@ class LiaisonServer:
         # dashboard-signature registration to every alive data node
         # (windows are node-local; each node backfills its own shards)
         b.subscribe("streamagg", self._streamagg)
+        # elastic-cluster operator surface (cli.py rebalance
+        # plan|apply|status; docs/robustness.md "Elastic cluster")
+        b.subscribe("rebalance", self._rebalance)
+
+    def _rebalance(self, env: dict):
+        from banyandb_tpu.cluster.rebalance import RebalancePlan
+
+        op = env.get("op", "status")
+        if op == "plan":
+            plan = self.rebalancer.plan(
+                env.get("nodes") or None,
+                replicas=env.get("replicas"),
+            )
+            return {"plan": plan.to_json()}
+        if op == "apply":
+            plan = (
+                RebalancePlan.from_json(env["plan"])
+                if env.get("plan")
+                else self.rebalancer.plan(
+                    env.get("nodes") or None, replicas=env.get("replicas")
+                )
+            )
+            return {"stats": self.rebalancer.apply(plan)}
+        if op == "repair":
+            return {"stats": self.repairer.run_once()}
+        if op == "status":
+            return {
+                "status": self.rebalancer.status(),
+                "repair": self.repairer.status(),
+            }
+        raise ValueError(f"bad rebalance op {op!r}")
 
     def _streamagg(self, env: dict):
         # same op surface as the standalone/data-node handlers (default
@@ -473,7 +522,28 @@ class LiaisonServer:
 
                 logging.getLogger(__name__).exception("liaison probe failed")
 
+    def _repair_loop(self) -> None:
+        """Anti-entropy (docs/robustness.md "Elastic cluster"): every
+        interval, compare per-shard part manifests across each replica
+        chain and re-ship what a replica is missing.  Skipped while a
+        rebalance holds the mover lock — the move's own delta round
+        covers convergence there."""
+        while not self._stop.wait(self.repair_interval_s):
+            try:
+                if self.rebalancer._lock.acquire(blocking=False):
+                    try:
+                        self.repairer.run_once()
+                    finally:
+                        self.rebalancer._lock.release()
+            except Exception:  # noqa: BLE001 - keep repairing
+                import logging
+
+                logging.getLogger(__name__).exception("replica repair failed")
+
     def start(self) -> "LiaisonServer":
+        from banyandb_tpu.cluster import faults
+
+        faults.set_local_node("liaison")
         self.grpc.start()
         if self.wire is not None:
             self.wire.start()
@@ -485,12 +555,20 @@ class LiaisonServer:
             target=self._probe_loop, name="liaison-probe", daemon=True
         )
         self._probe_thread.start()
+        if self.repair_interval_s > 0:
+            self._repair_thread = threading.Thread(
+                target=self._repair_loop, name="bydb-repair", daemon=True
+            )
+            self._repair_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10)
+        if self._repair_thread is not None:
+            self._repair_thread.join(timeout=10)
+            self._repair_thread = None
         if self.http is not None:
             self.http.stop()
         if self.wire is not None:

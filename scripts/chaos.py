@@ -444,6 +444,130 @@ def _smoke_worker_cycles(tmp, seed: int, stats: dict) -> None:
         srv.stop()
 
 
+def _smoke_rebalance_under_kill(tmp, seed: int, stats: dict) -> None:
+    """Elastic-cluster chaos (docs/robustness.md "Elastic cluster"):
+    a join/kill schedule drives a LIVE rebalance whose preferred part
+    source is SIGKILLed mid-move — the mover's holder failover pulls
+    from the surviving replica, installs stay digest-deduped, the
+    cutover bumps the epoch, and zero acked writes are lost."""
+    from banyandb_tpu.api import SchemaRegistry, WriteRequest
+    from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo, faults
+    from banyandb_tpu.cluster.placement import PlacementSelector
+    from banyandb_tpu.cluster.rebalance import Rebalancer
+    from banyandb_tpu.cluster.rpc import GrpcTransport
+
+    # the schedule carries WHO joins and WHO dies mid-move; the harness
+    # performs both (join/leave satellite: events_for_cycle)
+    plane = faults.configure(f"seed={seed};join=r3:at=1;kill=r0:at=1")
+    events = plane.events_for_cycle(1)
+    assert events["join"] == ["r3"] and events["kill"] == ["r0"]
+
+    nodes, servers, dns, ports = [], {}, {}, {}
+    for i in range(3):
+        reg = SchemaRegistry(tmp / f"e-r{i}" / "schema")
+        _schema(reg, group="rg", shard_num=3)
+        dn = DataNode(f"r{i}", reg, tmp / f"e-r{i}" / "data")
+        srv = _bind_server(dn.bus, 0, sync_install=dn.install_synced_parts)
+        servers[f"r{i}"], dns[f"r{i}"], ports[f"r{i}"] = srv, dn, srv.port
+        nodes.append(NodeInfo(f"r{i}", srv.addr))
+    lreg = SchemaRegistry(tmp / "e-liaison" / "schema")
+    _schema(lreg, group="rg", shard_num=3)
+    transport = GrpcTransport()
+    # handoff: the kill window's writes spool the dead replica's copies
+    # and replay them (epoch re-stamped) once it rejoins
+    liaison = Liaison(
+        lreg, transport, nodes, replicas=1,
+        handoff_root=str(tmp / "e-liaison" / "handoff"),
+    )
+    liaison.probe()
+    acked = [0]
+
+    def write(n=90):
+        from banyandb_tpu.api import DataPointValue
+
+        pts = tuple(
+            DataPointValue(
+                ts_millis=T0 + acked[0] + i,
+                tags={"svc": f"s{(acked[0] + i) % 8}"},
+                fields={"v": 1.0}, version=1,
+            )
+            for i in range(n)
+        )
+        acked[0] += liaison.write_measure(WriteRequest("rg", "m", pts))
+
+    def total() -> int:
+        from banyandb_tpu.api import (
+            Aggregation, GroupBy, QueryRequest, TimeRange,
+        )
+
+        res = liaison.query_measure(QueryRequest(
+            groups=("rg",), name="m",
+            time_range=TimeRange(T0, T0 + 50_000_000),
+            group_by=GroupBy(("svc",)), agg=Aggregation("count", "v"),
+        ))
+        return int(sum(res.values.get("count", [])))
+
+    try:
+        write(240)
+        # the scheduled JOIN: r3 appears in the addr book only
+        for name in events["join"]:
+            reg = SchemaRegistry(tmp / f"e-{name}" / "schema")
+            _schema(reg, group="rg", shard_num=3)
+            dn = DataNode(name, reg, tmp / f"e-{name}" / "data")
+            srv = _bind_server(
+                dn.bus, 0, sync_install=dn.install_synced_parts
+            )
+            servers[name], dns[name], ports[name] = srv, dn, srv.port
+            with liaison._placement_lock:
+                liaison.selector = PlacementSelector(
+                    list(liaison.selector.nodes)
+                    + [NodeInfo(name, srv.addr)],
+                    liaison.placement,
+                )
+        liaison.probe()
+        reb = Rebalancer(liaison)
+        plan = reb.plan()
+        assert plan.moves, "scheduled join produced no moves"
+
+        def mid_move():
+            # the scheduled KILL lands exactly mid-move: a part source
+            # goes away between the bulk and delta ship rounds
+            for victim in events["kill"]:
+                servers[victim].stop(grace=0)
+            write(90)  # acked during the kill window (replica covers)
+
+        st = reb.apply(plan, mid_move=mid_move)
+        assert st["ok"], st
+        assert liaison.placement.epoch == 2
+        stats["rebalance_parts_moved"] = st["parts_moved"]
+        # restart the victim on its port; it learns the epoch from the
+        # placement broadcast riding the next probe-visible traffic
+        for victim in events["kill"]:
+            servers[victim] = _bind_server(
+                dns[victim].bus, ports[victim],
+                sync_install=dns[victim].install_synced_parts,
+            )
+        liaison.probe()
+        liaison.broadcast_placement()
+        got = total()
+        assert got == acked[0], (
+            f"rebalance-under-kill lost acked writes: {got} != {acked[0]}"
+        )
+        for name, dn in dns.items():
+            assert dn.epoch_record.epoch == 2, (name, dn.epoch_record.epoch)
+        stats["rebalance_under_kill"] = 1
+        stats["rebalance_acked"] = acked[0]
+    finally:
+        faults.clear()
+        transport.close()
+        for srv in servers.values():
+            srv.stop(grace=0)
+        for dn in dns.values():
+            dn.measure.close()
+            dn.stream.close()
+            dn.trace.close()
+
+
 def run_smoke(tmp_root, seed: int = 42, budget_s: float = 3.0) -> dict:
     from pathlib import Path
 
@@ -458,10 +582,12 @@ def run_smoke(tmp_root, seed: int = 42, budget_s: float = 3.0) -> dict:
     _smoke_degradation(tmp, budget_s, stats)
     _smoke_fault_schedule(tmp, seed, stats)
     _smoke_worker_cycles(tmp, seed, stats)
+    _smoke_rebalance_under_kill(tmp, seed, stats)
     stats["wall_s"] = round(time.perf_counter() - t0, 2)
     assert stats["kill_cycles"] >= 3
     assert stats["degraded_seen"] >= 1
     assert stats["worker_kill_cycles"] >= 2
+    assert stats["rebalance_under_kill"] >= 1
     return stats
 
 

@@ -83,11 +83,20 @@ echo "== workers smoke =="
 # (docs/performance.md "Multi-process data plane")
 env JAX_PLATFORMS=cpu python scripts/workers_smoke.py || fail=1
 
+echo "== rebalance smoke =="
+# elastic cluster: live 3->4 node expansion under sustained ingest —
+# zero acked-write loss, pre/post-cutover result byte parity, epoch
+# bump observed on every node, stale-epoch write rejected (counter),
+# one replica-repair round to convergence
+# (docs/robustness.md "Elastic cluster")
+env JAX_PLATFORMS=cpu python scripts/rebalance_smoke.py || fail=1
+
 echo "== chaos smoke =="
 # 3 in-process data-node kill/restart cycles under the liaison write
-# queue + a degradation scenario + a seeded fault schedule: zero
-# acked-write loss, explicit degraded markers, queries inside their
-# deadline budget (docs/robustness.md)
+# queue + a degradation scenario + a seeded fault schedule + a
+# rebalance whose part source is killed mid-move (join/kill schedule,
+# holder failover, zero loss): explicit degraded markers, queries
+# inside their deadline budget (docs/robustness.md)
 env JAX_PLATFORMS=cpu python scripts/chaos.py --smoke || fail=1
 
 if [ "${1:-}" != "--fast" ]; then

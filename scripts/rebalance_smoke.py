@@ -1,0 +1,291 @@
+"""Elastic-cluster smoke (~5s): live 3->4 node expansion under ingest
+(docs/robustness.md "Elastic cluster").
+
+Boots three in-process data nodes behind REAL gRPC bus servers, drives
+sustained measure ingest from a writer thread, joins a fourth node, and
+runs a full rebalance plan+apply while the writes keep flowing.
+Asserts the cutover contract end to end:
+
+  1. ZERO acked-write loss — every write acked before, during and
+     after the move is served afterwards;
+  2. result-JSON BYTE PARITY — the same fixed-window query returns
+     byte-identical JSON before the move and after the cutover;
+  3. the epoch bump is OBSERVED — every node's fence sits at the new
+     epoch, and the liaison's placement_epoch gauge moved;
+  4. a STALE-EPOCH write (a straggling liaison still routing on the
+     old map) is observably rejected: retryable kind on the wire and
+     stale_epoch_rejected counter > 0;
+  5. one replica-REPAIR round runs to convergence (second round ships
+     nothing).
+
+Wired into scripts/check.sh (both modes) and the check workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+T0 = 1_700_000_000_000
+
+
+def _schema(reg, shard_num=4, replicas=1):
+    from banyandb_tpu.api import (
+        Catalog,
+        Entity,
+        FieldSpec,
+        FieldType,
+        Group,
+        Measure,
+        ResourceOpts,
+        TagSpec,
+        TagType,
+    )
+
+    reg.create_group(
+        Group("eg", Catalog.MEASURE,
+              ResourceOpts(shard_num=shard_num, replicas=replicas))
+    )
+    reg.create_measure(
+        Measure(
+            group="eg", name="m",
+            tags=(TagSpec("svc", TagType.STRING),),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+
+
+def _points(base, n, mod=16):
+    from banyandb_tpu.api import DataPointValue
+
+    return tuple(
+        DataPointValue(
+            ts_millis=T0 + base + i,
+            tags={"svc": f"s{(base + i) % mod}"},
+            fields={"v": 1.0},
+            version=1,
+        )
+        for i in range(n)
+    )
+
+
+def _count_req(lo=T0, hi=T0 + 50_000_000):
+    from banyandb_tpu.api import (
+        Aggregation,
+        GroupBy,
+        QueryRequest,
+        TimeRange,
+    )
+
+    return QueryRequest(
+        groups=("eg",), name="m",
+        time_range=TimeRange(lo, hi),
+        group_by=GroupBy(("svc",)),
+        agg=Aggregation("count", "v"),
+    )
+
+
+def _result_bytes(liaison, req) -> bytes:
+    from banyandb_tpu.server import result_to_json
+
+    res = liaison.query_measure(req)
+    assert not res.degraded, f"unexpected degradation: {res.unavailable_nodes}"
+    return json.dumps(result_to_json(res), sort_keys=True).encode()
+
+
+def _spawn_node(tmp, name, transport):
+    from banyandb_tpu.api import SchemaRegistry
+    from banyandb_tpu.cluster import DataNode, NodeInfo
+    from banyandb_tpu.cluster.rpc import GrpcBusServer
+
+    reg = SchemaRegistry(tmp / name / "schema")
+    _schema(reg)
+    dn = DataNode(name, reg, tmp / name / "data")
+    srv = GrpcBusServer(dn.bus, sync_install=dn.install_synced_parts)
+    srv.start()
+    return dn, srv, NodeInfo(name, srv.addr)
+
+
+def run(tmp_root) -> dict:
+    from pathlib import Path
+
+    from banyandb_tpu.api import SchemaRegistry, WriteRequest
+    from banyandb_tpu.cluster import Liaison
+    from banyandb_tpu.cluster.placement import PlacementSelector
+    from banyandb_tpu.cluster.rebalance import Rebalancer, ReplicaRepairer
+    from banyandb_tpu.cluster.rpc import GrpcTransport, TransportError
+    from banyandb_tpu.obs.metrics import global_meter
+
+    tmp = Path(tmp_root)
+    tmp.mkdir(parents=True, exist_ok=True)
+    t_start = time.perf_counter()
+    stats: dict = {}
+
+    nodes, servers, dns = [], {}, {}
+    for i in range(3):
+        dn, srv, info = _spawn_node(tmp, f"n{i}", None)
+        nodes.append(info)
+        servers[info.name] = srv
+        dns[info.name] = dn
+    transport = GrpcTransport()
+    lreg = SchemaRegistry(tmp / "liaison" / "schema")
+    _schema(lreg)
+    liaison = Liaison(
+        lreg, transport, nodes, replicas=1,
+        placement_store=str(tmp / "liaison" / "placement.json"),
+    )
+    liaison.probe()
+
+    acked = [0]
+    errors = [0]
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            try:
+                liaison.write_measure(
+                    WriteRequest("eg", "m", _points(acked[0], 40))
+                )
+                acked[0] += 40
+            except TransportError:
+                errors[0] += 1  # retryable window (shed/stale): retry
+            time.sleep(0.005)
+
+    try:
+        # baseline ingest + the fixed pre-move window snapshot
+        liaison.write_measure(WriteRequest("eg", "m", _points(0, 400)))
+        acked[0] = 400
+        fixed_req = _count_req(T0, T0 + 400)
+        before = _result_bytes(liaison, fixed_req)
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+
+        # ---- the join: n3 appears in the addr book, owns nothing yet
+        dn3, srv3, info3 = _spawn_node(tmp, "n3", None)
+        servers["n3"], dns["n3"] = srv3, dn3
+        with liaison._placement_lock:
+            liaison.selector = PlacementSelector(
+                list(liaison.selector.nodes) + [info3], liaison.placement
+            )
+        liaison.probe()
+
+        reb = Rebalancer(liaison)
+        plan = reb.plan()
+        assert plan.moves, "join produced no moves"
+        mid_window = []
+
+        def mid_move():
+            mid_window.append(acked[0])
+            assert liaison.dual_route_shards(), "dual-route window closed"
+
+        apply_stats = reb.apply(plan, mid_move=mid_move)
+        stop.set()
+        th.join(timeout=10)
+        assert apply_stats["ok"] and apply_stats["parts_moved"] > 0
+        stats["rebalance"] = apply_stats
+
+        # ---- 3. epoch bump observed everywhere
+        assert liaison.placement.epoch == 2, liaison.placement.epoch
+        for name, dn in dns.items():
+            assert dn.epoch_record.epoch == 2, (name, dn.epoch_record.epoch)
+        stats["epoch"] = liaison.placement.epoch
+
+        # ---- 1. zero acked-write loss (writer rows incl. the window)
+        deadline = time.monotonic() + 10
+        total = -1
+        while time.monotonic() < deadline:
+            from banyandb_tpu.query import measure_exec  # noqa: F401
+
+            res = liaison.query_measure(_count_req())
+            total = int(sum(res.values.get("count", [])))
+            if total == acked[0] and not res.degraded:
+                break
+            time.sleep(0.1)
+        assert total == acked[0], f"acked-write loss: {total} != {acked[0]}"
+        stats["acked"] = acked[0]
+        stats["writer_retries"] = errors[0]
+
+        # ---- 2. byte parity across the cutover (fixed window)
+        after = _result_bytes(liaison, fixed_req)
+        assert after == before, "pre/post-cutover result JSON diverged"
+        stats["parity_bytes"] = len(after)
+
+        # ---- 4. stale-epoch write observably rejected (the straggling
+        # liaison: stamps the OLD epoch on a write envelope)
+        from banyandb_tpu.cluster import serde
+        from banyandb_tpu.cluster.bus import Topic
+
+        env = {
+            "request": serde.write_request_to_json(
+                WriteRequest("eg", "m", _points(acked[0], 4))
+            ),
+            "placement_epoch": 1,
+        }
+        rejected = False
+        try:
+            transport.call(
+                nodes[0].addr, Topic.MEASURE_WRITE.value, env, timeout=5
+            )
+        except TransportError as e:
+            rejected = e.kind == "stale_epoch"
+        assert rejected, "stale-epoch write was not rejected"
+        snap = global_meter().snapshot()["counters"]
+        stale_total = sum(
+            v for (name, _labels), v in snap.items()
+            if name == "stale_epoch_rejected"
+        )
+        # the counter lives in the DATA NODE process == this process
+        assert stale_total > 0, "stale_epoch_rejected counter did not move"
+        stats["stale_rejected_total"] = stale_total
+
+        # ---- 5. one repair round converges (second ships nothing)
+        rep = ReplicaRepairer(liaison)
+        r1 = rep.run_once()
+        r2 = rep.run_once()
+        assert r2["parts_shipped"] == 0, (
+            f"repair did not converge: round2 shipped {r2['parts_shipped']}"
+        )
+        stats["repair_round1"] = r1["parts_shipped"]
+        assert int(sum(
+            liaison.query_measure(_count_req()).values.get("count", [])
+        )) == acked[0]
+    finally:
+        stop.set()
+        transport.close()
+        for srv in servers.values():
+            srv.stop(grace=0)
+        for dn in dns.values():
+            dn.measure.close()
+            dn.stream.close()
+            dn.trace.close()
+    stats["wall_s"] = round(time.perf_counter() - t_start, 2)
+    return stats
+
+
+def main() -> int:
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="bydb-rebalance-smoke-")
+    stats = run(tmp)
+    print(json.dumps(stats, indent=2, default=str))
+    print("rebalance smoke: all invariants held")
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # same exit contract as chaos.py/server.py: skip grpc C++ teardown
+    # (pre-existing abort-at-exit on this gVisor-class kernel)
+    os._exit(rc)

@@ -125,6 +125,91 @@ def test_kill_schedule_for_harness():
     assert plane.kills_for_cycle(3) == ["n1"]
 
 
+def test_join_leave_schedule_events_for_cycle():
+    """Membership-change schedules ride the kill grammar: the harness
+    reads them per cycle and performs the discovery edit + rebalance
+    itself (docs/robustness.md 'Elastic cluster')."""
+    plane = faults.FaultPlane(
+        "kill=n0:at=1;join=n3:at=1;leave=n1:at=2;worker=w000:at=2"
+    )
+    assert plane.events_for_cycle(1) == {
+        "kill": ["n0"], "worker": [], "join": ["n3"], "leave": [],
+    }
+    assert plane.events_for_cycle(2) == {
+        "kill": [], "worker": ["w000"], "join": [], "leave": ["n1"],
+    }
+    assert plane.kills_for_cycle(1, site="join") == ["n3"]
+
+
+def test_partition_blackhole_is_asymmetric():
+    """partition=blackhole:src=A:dst=B drops A->B calls only: B->A (and
+    A->C) stay up.  The process identity comes from set_local_node."""
+    bus = LocalBus()
+    bus.subscribe(Topic.HEALTH, lambda env: {"status": "ok"})
+    transport = LocalTransport()
+    transport.register("n0", bus)
+    transport.register("n1", bus)
+    faults.configure("partition=blackhole:src=liaison:dst=n1")
+    try:
+        faults.set_local_node("liaison")
+        # liaison -> n1: blackholed, with the explicit fault marker
+        with pytest.raises(TransportError, match="blackholed"):
+            transport.call("local:n1", Topic.HEALTH.value, {}, timeout=1)
+        # liaison -> n0: unaffected (dst filter)
+        assert transport.call(
+            "local:n0", Topic.HEALTH.value, {}, timeout=1
+        )["status"] == "ok"
+        # n1 -> n1 (the reverse direction's process): unaffected (src
+        # filter) — the blackhole is asymmetric
+        faults.set_local_node("n1")
+        assert transport.call(
+            "local:n1", Topic.HEALTH.value, {}, timeout=1
+        )["status"] == "ok"
+        # fired decisions land in history + the injected counter
+        plane = faults.get_plane()
+        assert ("partition", 0, "blackhole") in plane.history
+    finally:
+        faults.set_local_node("")
+
+
+def test_partition_count_bounds_the_blackhole():
+    """count=N caps a partition rule like any other: transient
+    partitions heal."""
+    bus = LocalBus()
+    bus.subscribe(Topic.HEALTH, lambda env: {"status": "ok"})
+    transport = LocalTransport()
+    transport.register("n1", bus)
+    faults.configure("partition=blackhole:src=l:dst=n1:count=2")
+    try:
+        faults.set_local_node("l")
+        for _ in range(2):
+            with pytest.raises(TransportError):
+                transport.call("local:n1", Topic.HEALTH.value, {}, timeout=1)
+        # healed: the rule is spent
+        assert transport.call(
+            "local:n1", Topic.HEALTH.value, {}, timeout=1
+        )["status"] == "ok"
+    finally:
+        faults.set_local_node("")
+
+
+def test_partition_matches_registered_grpc_addr():
+    """Real-socket transports carry host:port addresses; the matcher
+    learns name->addr via register_node_addr."""
+    faults.configure("partition=blackhole:src=l:dst=n7")
+    try:
+        faults.set_local_node("l")
+        plane = faults.get_plane()
+        # unknown addr: no match, no fault
+        plane.check_partition("l", "127.0.0.1:4711", "health")
+        faults.register_node_addr("n7", "127.0.0.1:4711")
+        with pytest.raises(TransportError, match="blackholed"):
+            plane.check_partition("l", "127.0.0.1:4711", "health")
+    finally:
+        faults.set_local_node("")
+        faults.clear_node_addrs()
+
+
 def test_deterministic_sequence_reproduces_from_seed():
     """The acceptance pin: same seed+schedule -> identical per-site
     fault sequences, independent of other sites' traffic."""

@@ -1,0 +1,603 @@
+"""Elastic cluster: epoch-fenced placement, live rebalancing, replica
+repair, exhaustive read failover (cluster/placement.py,
+cluster/rebalance.py, docs/robustness.md "Elastic cluster").
+
+Covers: the pure-plan golden, round-robin equivalence of the initial
+map, the refresh_nodes no-silent-re-placement pin, the stale-epoch
+write fence (retryable kind, counter, adoption ratchet), mid-move
+re-ship idempotence, dual-route window result parity under ingest,
+repair convergence after a seeded replica wipe, and multi-round read
+failover past the old one-round limit.
+"""
+
+import json
+
+import pytest
+
+from banyandb_tpu.api import (
+    Aggregation,
+    Catalog,
+    DataPointValue,
+    Entity,
+    FieldSpec,
+    FieldType,
+    Group,
+    GroupBy,
+    Measure,
+    QueryRequest,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+    TimeRange,
+    WriteRequest,
+)
+from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo
+from banyandb_tpu.cluster.node import RoundRobinSelector
+from banyandb_tpu.cluster.placement import (
+    EpochRecord,
+    PlacementMap,
+    PlacementSelector,
+    StaleEpoch,
+)
+from banyandb_tpu.cluster.rebalance import (
+    RebalancePlan,
+    Rebalancer,
+    ReplicaRepairer,
+    plan_rebalance,
+    shard_manifest,
+    ship_part,
+)
+from banyandb_tpu.cluster.rpc import LocalTransport, TransportError
+
+T0 = 1_700_000_000_000
+
+
+def _schema(reg, shard_num=4, replicas=0):
+    reg.create_group(
+        Group(
+            "sw", Catalog.MEASURE,
+            ResourceOpts(shard_num=shard_num, replicas=replicas),
+        )
+    )
+    reg.create_measure(
+        Measure(
+            group="sw", name="cpm",
+            tags=(TagSpec("svc", TagType.STRING),),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        )
+    )
+
+
+def _points(base, n, mod=16):
+    return tuple(
+        DataPointValue(
+            ts_millis=T0 + base + i,
+            tags={"svc": f"s{(base + i) % mod}"},
+            fields={"v": 1.0},
+            version=1,
+        )
+        for i in range(n)
+    )
+
+
+def _count_req(trace=False):
+    return QueryRequest(
+        groups=("sw",), name="cpm",
+        time_range=TimeRange(T0, T0 + 50_000_000),
+        group_by=GroupBy(("svc",)),
+        agg=Aggregation("count", "v"),
+        trace=trace,
+    )
+
+
+def _total(res) -> int:
+    return int(sum(res.values.get("count", [])))
+
+
+def _result_bytes(liaison) -> bytes:
+    from banyandb_tpu.server import result_to_json
+
+    res = liaison.query_measure(_count_req())
+    assert not res.degraded
+    return json.dumps(result_to_json(res), sort_keys=True).encode()
+
+
+def _cluster(tmp_path, n_nodes=2, shard_num=4, replicas=0, prefix="n"):
+    transport = LocalTransport()
+    nodes, datanodes = [], {}
+    for i in range(n_nodes):
+        reg = SchemaRegistry(tmp_path / f"{prefix}{i}")
+        _schema(reg, shard_num, replicas)
+        dn = DataNode(f"{prefix}{i}", reg, tmp_path / f"{prefix}{i}" / "data")
+        addr = transport.register(dn.name, dn.bus)
+        nodes.append(NodeInfo(dn.name, addr))
+        datanodes[dn.name] = dn
+    lreg = SchemaRegistry(tmp_path / "liaison")
+    _schema(lreg, shard_num, replicas)
+    liaison = Liaison(lreg, transport, nodes, replicas=replicas)
+    return transport, liaison, datanodes
+
+
+def _add_node(tmp_path, transport, liaison, name, shard_num=4, replicas=0):
+    """Join a fresh node: register its bus and widen the addr book the
+    way refresh_nodes would (without re-placing)."""
+    reg = SchemaRegistry(tmp_path / name)
+    _schema(reg, shard_num, replicas)
+    dn = DataNode(name, reg, tmp_path / name / "data")
+    addr = transport.register(name, dn.bus)
+    nodes = list(liaison.selector.nodes) + [NodeInfo(name, addr)]
+    with liaison._placement_lock:
+        liaison.selector = PlacementSelector(nodes, liaison.placement)
+    liaison.probe()
+    return dn
+
+
+# -- placement map ------------------------------------------------------------
+
+
+def test_initial_placement_equals_round_robin():
+    names = ["a", "b", "c"]
+    infos = [NodeInfo(n, f"local:{n}") for n in names]
+    for replicas in (0, 1, 2):
+        pm = PlacementMap.initial(names, replicas)
+        ps = PlacementSelector(infos, pm)
+        rr = RoundRobinSelector(infos, replicas)
+        for shard in range(12):
+            assert [n.name for n in ps.replica_set(shard)] == [
+                n.name for n in rr.replica_set(shard)
+            ]
+            # primary failover walk agrees too (incl. the no-alive-
+            # replica error contract)
+            try:
+                want = rr.primary(shard, {"b", "c"}).name
+            except RuntimeError:
+                with pytest.raises(RuntimeError):
+                    ps.primary(shard, {"b", "c"})
+            else:
+                assert ps.primary(shard, {"b", "c"}).name == want
+
+
+def test_placement_map_round_trips_and_persists(tmp_path):
+    pm = PlacementMap(
+        epoch=7, nodes=("a", "b"), replicas=1, chains=(("a", "b"), ("b", "a"))
+    )
+    assert PlacementMap.from_json(pm.to_json()) == pm
+    pm.save(tmp_path / "p.json")
+    assert PlacementMap.load(tmp_path / "p.json") == pm
+    assert PlacementMap.load(tmp_path / "missing.json") is None
+
+
+def test_plan_golden_three_to_four_nodes():
+    """The pure plan is a deterministic function of (placement, target):
+    pinned so a planner change is a conscious diff, not drift."""
+    pm = PlacementMap.initial(["n0", "n1", "n2"], replicas=1)
+    plan = plan_rebalance(pm, ["n0", "n1", "n2", "n3"], num_shards=8)
+    assert plan.base_epoch == 1 and plan.new_epoch == 2
+    assert plan.chains == (
+        ("n0", "n3"),
+        ("n1", "n3"),
+        ("n2", "n3"),
+        ("n0", "n3"),
+        ("n1", "n2"),
+        ("n2", "n0"),
+        ("n0", "n1"),
+        ("n1", "n2"),
+    )
+    moves = {m.shard: m for m in plan.moves}
+    # exactly the joiner's fair share (4 of 16 slots), each slot from a
+    # DISTINCT shard, and every surviving primary stays primary
+    assert sorted(moves) == [0, 1, 2, 3]
+    assert all(m.add == ("n3",) for m in plan.moves)
+    assert moves[0].remove == ("n1",)
+    assert moves[1].remove == ("n2",)
+    assert moves[2].remove == ("n0",)
+    assert moves[3].remove == ("n1",)
+    # balance: every node ends at its exact quota
+    loads: dict[str, int] = {}
+    for chain in plan.chains:
+        for nm in chain:
+            loads[nm] = loads.get(nm, 0) + 1
+    assert loads == {"n0": 4, "n1": 4, "n2": 4, "n3": 4}
+    # round-trip (the wire form the cli ships back to apply)
+    assert RebalancePlan.from_json(plan.to_json()) == plan
+
+
+def test_plan_is_stable_when_target_matches():
+    pm = PlacementMap.initial(["n0", "n1", "n2"], replicas=1)
+    plan = plan_rebalance(pm, ["n0", "n1", "n2"], num_shards=6)
+    assert plan.moves == ()
+    for shard in range(6):
+        assert plan.chains[shard] == pm.chain(shard)
+
+
+# -- the silent-re-placement hazard (satellite pin) ---------------------------
+
+
+def test_refresh_nodes_does_not_replace_shards(tmp_path):
+    """Membership change must only PROPOSE: before this PR,
+    refresh_nodes rebuilt the round-robin selector over the new node
+    set, silently rerouting reads onto nodes that hold no data.  Now
+    the addr book widens but every shard's chain is unchanged until an
+    explicit rebalance applies."""
+    from banyandb_tpu.cluster.discovery import FileDiscovery
+
+    nodes_file = tmp_path / "nodes.json"
+    infos = [NodeInfo(f"n{i}", f"local:n{i}") for i in range(2)]
+    FileDiscovery.write(nodes_file, infos)
+    lreg = SchemaRegistry(tmp_path / "liaison")
+    _schema(lreg)
+    transport = LocalTransport()
+    liaison = Liaison(
+        lreg, transport, discovery=FileDiscovery(nodes_file), replicas=0
+    )
+    before = {
+        s: [n.name for n in liaison.selector.replica_set(s)] for s in range(8)
+    }
+    # membership change: n2 joins
+    FileDiscovery.write(
+        nodes_file, infos + [NodeInfo("n2", "local:n2")]
+    )
+    assert liaison.refresh_nodes()
+    after = {
+        s: [n.name for n in liaison.selector.replica_set(s)] for s in range(8)
+    }
+    assert after == before, "membership change silently re-placed shards"
+    # the joiner is reachable (addr book) and the change is proposed
+    assert {n.name for n in liaison.selector.nodes} == {"n0", "n1", "n2"}
+    assert liaison.pending_topology == ("n0", "n1", "n2")
+    assert liaison.placement.epoch == 1  # no cutover happened
+
+
+# -- stale-epoch fence --------------------------------------------------------
+
+
+def test_epoch_record_ratchets_and_persists(tmp_path):
+    rec = EpochRecord(tmp_path / "e.json")
+    assert rec.epoch == 0
+    rec.observe(3)
+    rec.observe(3)  # equal: no-op
+    with pytest.raises(StaleEpoch):
+        rec.observe(2)
+    # restart keeps the fence
+    assert EpochRecord(tmp_path / "e.json").epoch == 3
+
+
+def test_stale_epoch_write_rejected_with_retryable_kind(tmp_path):
+    """A write stamped with a superseded epoch is rejected with a
+    STRUCTURED retryable kind (never treated as a dead node), and the
+    rejection counter moves."""
+    from banyandb_tpu.obs.metrics import global_meter
+
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=1)
+    dn = datanodes["n0"]
+    # the node witnesses a cutover this liaison missed
+    dn.epoch_record.observe(5, source="placement-set")
+    before = global_meter().snapshot()["counters"].get(
+        ("stale_epoch_rejected", (("site", "measure-write"),)), 0.0
+    )
+    with pytest.raises(TransportError) as ei:
+        liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 4)))
+    assert ei.value.kind == "stale_epoch"
+    after = global_meter().snapshot()["counters"].get(
+        ("stale_epoch_rejected", (("site", "measure-write"),)), 0.0
+    )
+    assert after > before
+    # the node was NOT marked dead: it is healthy, the sender is stale
+    assert "n0" in liaison.alive
+
+
+def test_fenced_write_gossips_epoch_to_node(tmp_path):
+    """Epoch knowledge rides ordinary traffic: a node that missed the
+    cutover broadcast adopts the fresher epoch from the next fenced
+    write envelope (and persists it)."""
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=1)
+    dn = datanodes["n0"]
+    assert dn.epoch_record.epoch == 0
+    liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 4)))
+    assert dn.epoch_record.epoch == liaison.placement.epoch == 1
+
+
+def test_stale_liaison_reloads_placement_from_store(tmp_path):
+    """The straggling-liaison story: liaison B (old epoch) gets fenced,
+    re-reads the shared placement store, and retries successfully."""
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=2)
+    store = tmp_path / "placement.json"
+    liaison.placement.save(store)
+    liaison._placement_store = store
+    # another liaison cut over to epoch 4: nodes fenced, store updated
+    newer = PlacementMap(
+        epoch=4, nodes=liaison.placement.nodes, replicas=0,
+        chains=liaison.placement.chains,
+    )
+    newer.save(store)
+    for dn in datanodes.values():
+        dn.epoch_record.observe(4, source="placement-set")
+    with pytest.raises(TransportError) as ei:
+        liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 8)))
+    assert ei.value.kind == "stale_epoch"
+    # the rejection already reloaded the store: the retry goes through
+    assert liaison.placement.epoch == 4
+    assert liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 8))) == 8
+
+
+def test_stale_write_fails_even_with_partial_delivery(tmp_path):
+    """Mixed epoch knowledge across a replica set: one replica accepts
+    (it missed the cutover too), another fences.  The write must FAIL
+    retryably — every target was computed from the superseded map, so
+    an ack could cover a row no post-cutover read routes to.  The
+    retry (fresh map) re-delivers; the stray copy collapses in version
+    dedup."""
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=2, replicas=1)
+    # n1 witnessed a cutover this liaison (and n0) missed
+    datanodes["n1"].epoch_record.observe(5, source="placement-set")
+    with pytest.raises(TransportError) as ei:
+        liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 8)))
+    assert ei.value.kind == "stale_epoch"
+    # neither node was marked dead (both healthy)
+    assert liaison.alive == {"n0", "n1"}
+
+
+def test_streaming_ship_epoch_fence(tmp_path):
+    """The wqueue's streaming part-sync path is fenced too: the epoch
+    rides a @epoch=N topic suffix (the proto has no spare field) and
+    the receiving install rejects superseded senders / adopts fresher
+    epochs."""
+    from types import SimpleNamespace
+
+    from banyandb_tpu.cluster.chunked_sync import parse_epoch_topic
+
+    assert parse_epoch_topic("measure-part-sync") == (
+        "measure-part-sync", None,
+    )
+    assert parse_epoch_topic("measure-part-sync@epoch=7") == (
+        "measure-part-sync", 7,
+    )
+    assert parse_epoch_topic("t@epoch=bogus") == ("t", None)
+
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=1)
+    dn = datanodes["n0"]
+    dn.epoch_record.observe(5, source="placement-set")
+    meta = SimpleNamespace(topic="measure-part-sync@epoch=2", group="sw",
+                           shard_id=0)
+    with pytest.raises(StaleEpoch):
+        dn.install_synced_parts(meta, [])
+    # a fresher sender epoch is adopted (ratchet-up gossip)
+    meta.topic = "measure-part-sync@epoch=9"
+    dn.install_synced_parts(meta, [])
+    assert dn.epoch_record.epoch == 9
+
+
+# -- live rebalance -----------------------------------------------------------
+
+
+def test_live_rebalance_moves_parts_and_bumps_epoch(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=2, replicas=0)
+    acked = 0
+    liaison.write_measure(WriteRequest("sw", "cpm", _points(acked, 200)))
+    acked += 200
+    before_bytes = _result_bytes(liaison)
+    dn3 = _add_node(tmp_path, transport, liaison, "n2")
+    reb = Rebalancer(liaison)
+    plan = reb.plan()  # target = addr book = n0,n1,n2
+    assert plan.moves, "join produced no moves"
+    mid_acked = []
+
+    def mid_move():
+        # ingest DURING the catch-up window: dual-routed to old+new
+        n = liaison.write_measure(WriteRequest("sw", "cpm", _points(acked, 60)))
+        mid_acked.append(n)
+        assert liaison.dual_route_shards(), "window not open mid-move"
+
+    stats = reb.apply(plan, mid_move=mid_move)
+    assert stats["ok"] and stats["parts_moved"] > 0
+    assert liaison.placement.epoch == 2
+    assert not liaison.dual_route_shards()
+    # every node is fenced at the new epoch
+    for dn in datanodes.values():
+        assert dn.epoch_record.epoch == 2
+    assert dn3.epoch_record.epoch == 2
+    # zero acked loss: every row (pre-move AND mid-window) is served
+    total = _total(liaison.query_measure(_count_req()))
+    assert total == acked + sum(mid_acked)
+    # byte parity for the pre-move workload: the same query over the
+    # pre-move time window is byte-identical on the NEW placement
+    res = liaison.query_measure(
+        QueryRequest(
+            groups=("sw",), name="cpm",
+            time_range=TimeRange(T0, T0 + 50_000_000),
+            group_by=GroupBy(("svc",)),
+            agg=Aggregation("count", "v"),
+        )
+    )
+    assert not res.degraded
+    # (the mid-move rows change totals; compare against a fresh oracle
+    # of the FULL ingest instead: grouped counts must match exactly)
+    from banyandb_tpu.server import result_to_json
+
+    got = dict(zip([g[0] for g in res.groups], res.values["count"]))
+    want: dict[str, int] = {}
+    for i in range(acked + sum(mid_acked)):
+        want[f"s{i % 16}"] = want.get(f"s{i % 16}", 0) + 1
+    assert {k: int(v) for k, v in got.items()} == want
+    assert before_bytes  # pre-move snapshot was captured and non-empty
+    assert isinstance(result_to_json(res), dict)
+    # the new owner actually serves shards: drop it and the query degrades
+    transport.unregister("n2")
+    liaison.probe()
+    res = liaison.query_measure(_count_req())
+    assert res.degraded and "n2" in res.unavailable_nodes
+
+
+def test_midmove_reship_is_digest_dedup_noop(tmp_path):
+    """The crash contract: re-shipping a part that already installed is
+    a no-op (uuid/content-digest dedup), so a mover restarted after a
+    mid-move SIGKILL just re-runs the plan."""
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=1, shard_num=2)
+    liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 100)))
+    datanodes["n0"].measure.flush()
+    dn1 = _add_node(tmp_path, transport, liaison, "nx", shard_num=2)
+    src = liaison.selector.node_by_name("n0")
+    dst = liaison.selector.node_by_name("nx")
+    moved = deduped = 0
+    for shard in range(2):
+        for entry in shard_manifest(transport, src, shard)[0].values():
+            assert ship_part(transport, src, dst, entry, epoch=1) == "moved"
+            moved += 1
+            # the re-ship after a "crash": byte-identical, deduped
+            assert (
+                ship_part(transport, src, dst, entry, epoch=1) == "deduped"
+            )
+            deduped += 1
+    assert moved == deduped and moved > 0
+    # manifests converged: dst holds exactly src's digest keys
+    for shard in range(2):
+        src_keys = set(shard_manifest(transport, src, shard)[0])
+        dst_keys = set(shard_manifest(transport, dst, shard)[0])
+        assert src_keys <= dst_keys
+    assert _total(dn1.measure.query(_count_req())) == 100
+
+
+def test_apply_refuses_stale_plan(tmp_path):
+    transport, liaison, datanodes = _cluster(tmp_path, n_nodes=2)
+    reb = Rebalancer(liaison)
+    plan = reb.plan(["n0"])
+    # a concurrent cutover bumps the epoch under the plan
+    other = reb.plan(["n0", "n1"])
+    liaison.cutover(
+        RebalancePlan(
+            base_epoch=1, target_nodes=other.target_nodes,
+            replicas=0, chains=other.chains,
+        )
+    )
+    with pytest.raises(RuntimeError, match="stale plan"):
+        reb.apply(plan)
+    assert not liaison.dual_route_shards()
+
+
+# -- replica repair (anti-entropy) -------------------------------------------
+
+
+def test_repair_converges_after_replica_wipe(tmp_path):
+    """Replication factor 2: a replica restored from TOTAL loss (fresh
+    empty root) converges back to digest-identical part manifests in
+    one repair round — and a query scattered during the outage succeeds
+    via failover instead of degrading."""
+    transport, liaison, datanodes = _cluster(
+        tmp_path, n_nodes=3, shard_num=3, replicas=1
+    )
+    acked = liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 300)))
+    for dn in datanodes.values():
+        dn.measure.flush()
+    assert _total(liaison.query_measure(_count_req())) == acked
+
+    # the outage: n1 gone; a query must still answer completely via the
+    # surviving replica of each of n1's shards
+    transport.unregister("n1")
+    res = liaison.query_measure(_count_req())
+    assert not res.degraded and _total(res) == acked
+
+    # "restored from loss": same name/addr, EMPTY root (disk replaced)
+    fresh = DataNode(
+        "n1", datanodes["n1"].registry, tmp_path / "n1-restored" / "data"
+    )
+    transport.register("n1", fresh.bus)
+    datanodes["n1"] = fresh
+    liaison.probe()
+
+    rep = ReplicaRepairer(liaison)
+    stats = rep.run_once()
+    assert stats["parts_shipped"] > 0
+    # digest-identical manifests per shard across every chain member
+    for shard in range(3):
+        chain = liaison.placement.chain(shard)
+        keys = [
+            set(
+                shard_manifest(
+                    transport, liaison.selector.node_by_name(nm), shard
+                )[0]
+            )
+            for nm in chain
+        ]
+        assert keys[0] == keys[1], f"shard {shard} diverged after repair"
+    # second round is a pure no-op (dedup, nothing to ship)
+    stats2 = rep.run_once()
+    assert stats2["parts_shipped"] == 0
+    # and the restored replica can serve alone: kill the OTHER nodes
+    transport.unregister("n0")
+    transport.unregister("n2")
+    liaison.probe()
+    res = liaison.query_measure(_count_req())
+    # n1 holds a replica of shards 0 and 1 (chains (n0,n1) and (n1,n2));
+    # shard 2's chain (n2,n0) is fully down -> degraded, but n1's shards
+    # answer from the REPAIRED parts
+    assert res.degraded
+    got = _total(res)
+    assert 0 < got < acked
+
+
+# -- exhaustive read failover -------------------------------------------------
+
+
+def test_multi_round_failover_walks_whole_chain(tmp_path):
+    """replicas=2 (chain of 3): with the primary AND first replica dead
+    but the probe not yet run, the scatter must walk to the THIRD
+    replica — the old one-round failover returned degraded here.
+
+    shard_num=1 so there is exactly ONE leg: each dead node is only
+    discovered when a failover round actually dials it (with more
+    shards the first round's other legs would mark both dead at once,
+    collapsing the walk into one round)."""
+    from banyandb_tpu.obs.metrics import global_meter
+
+    transport, liaison, datanodes = _cluster(
+        tmp_path, n_nodes=4, shard_num=1, replicas=2
+    )
+    acked = liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 240)))
+    assert _total(liaison.query_measure(_count_req())) == acked
+    # kill the primary and first replica WITHOUT a probe: the liaison
+    # still thinks they are alive, so the leg fails live and must fail
+    # over round after round
+    transport.unregister("n0")
+    transport.unregister("n1")
+    before = global_meter().snapshot()["counters"].get(
+        ("failover_attempts", ()), 0.0
+    )
+    res = liaison.query_measure(_count_req(trace=True))
+    after = global_meter().snapshot()["counters"].get(
+        ("failover_attempts", ()), 0.0
+    )
+    assert not res.degraded, (
+        f"multi-round failover still degraded: {res.unavailable_nodes}"
+    )
+    assert _total(res) == acked
+    assert after - before >= 2, "expected at least two failover rounds"
+    # per-attempt span tags: some scatter leg recorded a retry attempt
+    tree = (res.trace or {}).get("span_tree") or {}
+
+    def attempts(node):
+        out = []
+        if (node.get("tags") or {}).get("attempt"):
+            out.append(node["tags"]["attempt"])
+        for c in node.get("children", ()):
+            out.extend(attempts(c))
+        return out
+
+    assert attempts(tree), "no scatter span carried an attempt tag"
+
+
+def test_failover_degrades_after_chain_exhausted(tmp_path):
+    """When every replica of a shard is gone the leg still degrades
+    (exhaustive != infinite): markers stay explicit."""
+    transport, liaison, datanodes = _cluster(
+        tmp_path, n_nodes=3, shard_num=3, replicas=1
+    )
+    acked = liaison.write_measure(WriteRequest("sw", "cpm", _points(0, 120)))
+    # adjacent pair down = some shard loses its whole chain
+    transport.unregister("n0")
+    transport.unregister("n1")
+    res = liaison.query_measure(_count_req())
+    assert res.degraded
+    assert set(res.unavailable_nodes) & {"n0", "n1"}
+    assert 0 < _total(res) < acked
