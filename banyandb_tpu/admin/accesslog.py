@@ -55,11 +55,21 @@ class AccessLog:
                 self._f = open(self.path, "a", buffering=1)
             self._f.write(json.dumps(record) + "\n")
 
-    def log_write(self, group: str, name: str, points: int, duration_ms: float) -> None:
+    def log_write(
+        self, group: str, name: str, points: int, duration_ms: float,
+        *, tenant: str = "",
+    ) -> None:
         self._emit(
             {"kind": "write", "group": group, "name": name,
+             "tenant": tenant or self._tenant(group),
              "points": points, "ms": round(duration_ms, 3)}
         )
+
+    @staticmethod
+    def _tenant(group: str) -> str:
+        from banyandb_tpu.qos.tenancy import tenant_of_group
+
+        return tenant_of_group(group)
 
     def log_query(
         self,
@@ -69,9 +79,11 @@ class AccessLog:
         *,
         ql: Optional[str] = None,
         rows: int = 0,
+        tenant: str = "",
     ) -> None:
         rec = {
             "kind": "query", "group": group, "name": name,
+            "tenant": tenant or self._tenant(group),
             "ms": round(duration_ms, 3), "rows": rows,
         }
         if ql:

@@ -56,6 +56,15 @@ def _unary_stream(fn: Callable, req_cls):
 
 
 def _abort(context, e: Exception):
+    # lazy boundary (layering): the shed exceptions live in admin/
+    from banyandb_tpu.admin.diskmonitor import DiskFull
+    from banyandb_tpu.admin.protector import ServerBusy
+
+    if isinstance(e, (ServerBusy, DiskFull)):
+        # load shedding (QoS quota / memory gate / disk watermark) is an
+        # explicit RETRYABLE rejection on the proto wire — the
+        # ErrServerBusy contract, never a silent drop or a plain 500
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
     if isinstance(e, KeyError):
         context.abort(grpc.StatusCode.NOT_FOUND, str(e))
     if isinstance(e, NotImplementedError):
@@ -239,6 +248,26 @@ class WireServices:
                 return dataclasses.replace(ireq, order_by_tag=r.tags[0])
         return ireq
 
+    @staticmethod
+    def _admit(group: str):
+        """Per-tenant weighted query admission on the proto wire
+        (docs/robustness.md "Multi-tenant QoS"); a shed maps to
+        RESOURCE_EXHAUSTED in _abort.  Returns a context manager that
+        also binds the tenant scope (serving-cache partitions)."""
+        import contextlib
+
+        from banyandb_tpu.qos import tenant_scope
+        from banyandb_tpu.qos.plane import global_qos
+
+        adm = global_qos().admit_query(group)
+
+        @contextlib.contextmanager
+        def scoped():
+            with adm, tenant_scope(adm.tenant):
+                yield adm
+
+        return scoped()
+
     # -- MeasureService ----------------------------------------------------
     def measure_query(self, req, context):
         try:
@@ -254,7 +283,8 @@ class WireServices:
             for f in ireq.field_projection:
                 m.field(f)
             ireq = self._resolve_order(group, ireq)
-            res = self.measure.query(ireq)
+            with self._admit(group):
+                res = self.measure.query(ireq)
             return wire.measure_result_to_pb(m, ireq, res)
         except Exception as e:  # noqa: BLE001 - mapped to gRPC status
             _abort(context, e)
@@ -364,13 +394,26 @@ class WireServices:
             if not pending:
                 return []
             group, name = cur
+            from banyandb_tpu.admin.diskmonitor import DiskFull
+            from banyandb_tpu.admin.protector import ServerBusy
+            from banyandb_tpu.qos.plane import global_qos
+
             try:
+                # per-tenant ingest quota (QoS): the whole batch is one
+                # admission charge; over-quota rejects the batch with
+                # the shed-class wire status below — explicit and
+                # retryable, never a silent drop
+                global_qos().admit_write(group, len(pending))
                 self.measure.write_points_bulk(
                     im.WriteRequest(
                         group, name, tuple(p for _, p in pending)
                     )
                 )
                 statuses = ["STATUS_SUCCEED"] * len(pending)
+            except (ServerBusy, DiskFull):
+                # the wire enum's only shed-class value (model/v1
+                # Status): clients treat it as back-off-and-retry
+                statuses = ["STATUS_DISK_FULL"] * len(pending)
             except Exception:  # noqa: BLE001 — replay for per-point status
                 statuses = []
                 for _, p in pending:
@@ -379,6 +422,8 @@ class WireServices:
                         statuses.append("STATUS_SUCCEED")
                     except KeyError:
                         statuses.append("STATUS_NOT_FOUND")
+                    except (ServerBusy, DiskFull):
+                        statuses.append("STATUS_DISK_FULL")
                     except Exception:  # noqa: BLE001
                         log.exception("measure write failed")
                         statuses.append("STATUS_INTERNAL_ERROR")
@@ -602,13 +647,19 @@ class WireServices:
     def stream_query(self, req, context):
         try:
             ireq = wire.stream_query_to_internal(req)
-            ireq = self._resolve_order(self._one_group(ireq), ireq)
-            res = self.stream.query(ireq)
+            group = self._one_group(ireq)
+            ireq = self._resolve_order(group, ireq)
+            with self._admit(group):
+                res = self.stream.query(ireq)
             return wire.stream_result_to_pb(res)
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
 
     def stream_write(self, request_iterator, context):
+        from banyandb_tpu.admin.diskmonitor import DiskFull
+        from banyandb_tpu.admin.protector import ServerBusy
+        from banyandb_tpu.qos.plane import global_qos
+
         for wreq in request_iterator:
             resp = pb.stream_write_pb2.WriteResponse(message_id=wreq.message_id)
             try:
@@ -616,10 +667,13 @@ class WireServices:
                     wreq.metadata.group, wreq.metadata.name
                 )
                 el = wire.element_value_from_pb(s, wreq)
+                global_qos().admit_write(wreq.metadata.group, 1)
                 self.stream.write(wreq.metadata.group, wreq.metadata.name, [el])
                 resp.status = "STATUS_SUCCEED"
             except KeyError:
                 resp.status = "STATUS_NOT_FOUND"
+            except (ServerBusy, DiskFull):
+                resp.status = "STATUS_DISK_FULL"  # shed-class: retryable
             except Exception:  # noqa: BLE001
                 log.exception("stream write failed")
                 resp.status = "STATUS_INTERNAL_ERROR"
@@ -1443,40 +1497,41 @@ class WireServices:
             params = [wire.tag_value_to_py(tv) for tv in req.params]
             catalog, ireq = bydbql.parse_with_catalog(req.query, params)
             out = pb.bydbql_query_pb2.QueryResponse()
-            if catalog == "measure":
-                m = self.registry.get_measure(ireq.groups[0], ireq.name)
-                res = self.measure.query(ireq)
-                out.measure_result.CopyFrom(
-                    wire.measure_result_to_pb(m, ireq, res)
-                )
-            elif catalog == "stream":
-                res = self.stream.query(ireq)
-                out.stream_result.CopyFrom(wire.stream_result_to_pb(res))
-            elif catalog == "trace":
-                if self.trace is None:
-                    raise ValueError("trace engine not wired")
-                from banyandb_tpu.query import ql_exec
+            with self._admit(ireq.groups[0] if ireq.groups else ""):
+                if catalog == "measure":
+                    m = self.registry.get_measure(ireq.groups[0], ireq.name)
+                    res = self.measure.query(ireq)
+                    out.measure_result.CopyFrom(
+                        wire.measure_result_to_pb(m, ireq, res)
+                    )
+                elif catalog == "stream":
+                    res = self.stream.query(ireq)
+                    out.stream_result.CopyFrom(wire.stream_result_to_pb(res))
+                elif catalog == "trace":
+                    if self.trace is None:
+                        raise ValueError("trace engine not wired")
+                    from banyandb_tpu.query import ql_exec
 
-                res = ql_exec.execute_trace_ql(self.trace, ireq)
-                out.trace_result.CopyFrom(
-                    self._trace_result_to_pb(ireq, res)
-                )
-            elif catalog == "property":
-                if self.property is None:
-                    raise ValueError("property engine not wired")
-                from banyandb_tpu.query import ql_exec
+                    res = ql_exec.execute_trace_ql(self.trace, ireq)
+                    out.trace_result.CopyFrom(
+                        self._trace_result_to_pb(ireq, res)
+                    )
+                elif catalog == "property":
+                    if self.property is None:
+                        raise ValueError("property engine not wired")
+                    from banyandb_tpu.query import ql_exec
 
-                res = ql_exec.execute_property_ql(self.property, ireq)
-                out.property_result.CopyFrom(
-                    self._property_result_to_pb(ireq, res)
-                )
-            else:
-                # NotImplementedError maps to UNIMPLEMENTED in _abort;
-                # aborting inside the try would be re-caught and
-                # re-aborted as INTERNAL with a spurious stack trace
-                raise NotImplementedError(
-                    f"BydbQL catalog {catalog} not yet wired"
-                )
+                    res = ql_exec.execute_property_ql(self.property, ireq)
+                    out.property_result.CopyFrom(
+                        self._property_result_to_pb(ireq, res)
+                    )
+                else:
+                    # NotImplementedError maps to UNIMPLEMENTED in _abort;
+                    # aborting inside the try would be re-caught and
+                    # re-aborted as INTERNAL with a spurious stack trace
+                    raise NotImplementedError(
+                        f"BydbQL catalog {catalog} not yet wired"
+                    )
             return out
         except Exception as e:  # noqa: BLE001
             _abort(context, e)

@@ -55,6 +55,9 @@ _GRPC_TO_HTTP = {
     "ALREADY_EXISTS": 409,
     "UNIMPLEMENTED": 501,
     "INTERNAL": 500,
+    # load shedding (QoS quota / ServerBusy / DiskFull): the HTTP
+    # retryable rejection — clients back off, never silently dropped
+    "RESOURCE_EXHAUSTED": 429,
 }
 
 # resource kinds -> registry service stems, keyed by their upstream route

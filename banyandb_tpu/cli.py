@@ -177,6 +177,14 @@ def main(argv=None) -> int:
 
     sub.add_parser("metrics", help="Prometheus exposition text")
 
+    sub.add_parser(
+        "qos",
+        help="multi-tenant QoS status: per-tenant admission counters "
+        "(write/query admitted/queued/shed), limits, serving-cache "
+        "partitions and in-flight byte charges "
+        "(docs/robustness.md 'Multi-tenant QoS')",
+    )
+
     tg = sub.add_parser("trace-get")
     tg.add_argument("group")
     tg.add_argument("name")
@@ -315,6 +323,10 @@ def main(argv=None) -> int:
         print(json.dumps(_call(args, TOPIC_SLOWLOG, env), indent=1))
     elif args.cmd == "metrics":
         print(_call(args, TOPIC_METRICS, {})["prometheus"], end="")
+    elif args.cmd == "qos":
+        from banyandb_tpu.server import TOPIC_QOS
+
+        print(json.dumps(_call(args, TOPIC_QOS, {}), indent=1))
     elif args.cmd == "trace-get":
         print(json.dumps(_call(args, Topic.TRACE_QUERY_BY_ID.value, {
             "group": args.group, "name": args.name, "trace_id": args.trace_id,

@@ -455,7 +455,8 @@ class DataNode:
         except KeyError:
             return {"data_points": []}
         tracer = self._node_tracer(req, env)
-        res = self.stream.query(req, shard_ids=shard_ids, tracer=tracer)
+        with self._tenant_scope(env, req.groups[0] if req.groups else ""):
+            res = self.stream.query(req, shard_ids=shard_ids, tracer=tracer)
         out = {
             "data_points": [
                 {
@@ -636,15 +637,29 @@ class DataNode:
 
         return Tracer(f"data:{self.name}")
 
+    @staticmethod
+    def _tenant_scope(env: dict, group: str):
+        """Bind the envelope's stamped tenant (else derive from the
+        group) for the handler's work, so this node's serving-cache
+        reads/writes land in the tenant's OWN partition
+        (docs/robustness.md "Multi-tenant QoS")."""
+        from banyandb_tpu.qos import tenancy
+
+        return tenancy.tenant_scope(
+            env.get("tenant") or tenancy.tenant_of_group(group)
+        )
+
     def _on_measure_query_partial(self, env: dict) -> dict:
         self._check_deadline(env)
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         hist_range = tuple(env["hist_range"]) if env.get("hist_range") else None
         tracer = self._node_tracer(req, env)
-        partials = self.measure.query_partials(
-            req, shard_ids=shard_ids, hist_range=hist_range, tracer=tracer
-        )
+        with self._tenant_scope(env, req.groups[0] if req.groups else ""):
+            partials = self.measure.query_partials(
+                req, shard_ids=shard_ids, hist_range=hist_range,
+                tracer=tracer,
+            )
         out = {"partials": serde.partials_to_json(partials)}
         if tracer is not None:
             out["trace"] = tracer.finish()
@@ -655,7 +670,8 @@ class DataNode:
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         tracer = self._node_tracer(req, env)
-        res = self.measure.query(req, shard_ids=shard_ids, tracer=tracer)
+        with self._tenant_scope(env, req.groups[0] if req.groups else ""):
+            res = self.measure.query(req, shard_ids=shard_ids, tracer=tracer)
         out = {"data_points": res.data_points}
         if tracer is not None:
             out["trace"] = tracer.finish()

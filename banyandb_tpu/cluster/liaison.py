@@ -324,6 +324,16 @@ class Liaison:
         placement epoch so data nodes can reject superseded writers."""
         return dict(env, placement_epoch=self.placement.epoch)
 
+    @staticmethod
+    def _stamp_tenant(env: dict, group: str) -> dict:
+        """Tenant identity rides every write/scatter envelope
+        (docs/robustness.md "Multi-tenant QoS") so data nodes partition
+        their serving caches without re-deriving from the group."""
+        from banyandb_tpu.qos.tenancy import tenant_of_group
+
+        env["tenant"] = tenant_of_group(group)
+        return env
+
     def _mark_dead(self, name: str) -> None:
         """Drop a peer from the alive snapshot (rebind, never mutate:
         concurrent lock-free readers hold the old reference)."""
@@ -697,11 +707,11 @@ class Liaison:
         accepted = len(req.points)
 
         def env_for(points):
-            return {
+            return self._stamp_tenant({
                 "request": serde.write_request_to_json(
                     WriteRequest(req.group, req.name, tuple(points))
                 )
-            }
+            }, req.group)
 
         self._deliver_writes(
             Topic.MEASURE_WRITE.value,
@@ -1092,10 +1102,10 @@ class Liaison:
     ) -> list[measure_exec.Partials]:
         if guard is None:
             guard = _QueryGuard(self.query_budget_s)
-        env_base = {
+        env_base = self._stamp_tenant({
             "request": serde.query_request_to_json(req),
             "hist_range": list(hist_range) if hist_range else None,
-        }
+        }, req.groups[0] if req.groups else "")
         out = []
 
         def env_of(shards):
@@ -1170,7 +1180,9 @@ class Liaison:
             req_json = serde.query_request_to_json(node_req)
 
             def env_of(shards):
-                return {"request": req_json, "shards": shards}
+                return self._stamp_tenant(
+                    {"request": req_json, "shards": shards}, group
+                )
 
             def on_reply(node, shards, r, sp):
                 sp.tag("rows", len(r["data_points"]))
@@ -1302,7 +1314,11 @@ class Liaison:
         by_node, spool_items, addr_of = self._route_items(elements, shard_of)
 
         def env_for(elems):
-            return {"group": group, "name": name, "schema": stream_schema, "elements": elems}
+            return self._stamp_tenant(
+                {"group": group, "name": name, "schema": stream_schema,
+                 "elements": elems},
+                group,
+            )
 
         self._deliver_writes(
             Topic.STREAM_WRITE.value,
@@ -1328,7 +1344,10 @@ class Liaison:
         req_json = serde.query_request_to_json(node_req)
 
         def env_of(shards):
-            return {"request": req_json, "shards": shards}
+            return self._stamp_tenant(
+                {"request": req_json, "shards": shards},
+                req.groups[0] if req.groups else "",
+            )
 
         def on_reply(node, shards, r, sp):
             sp.tag("rows", len(r["data_points"]))
@@ -1373,10 +1392,10 @@ class Liaison:
         )
 
         def env_for(batch):
-            return {
+            return self._stamp_tenant({
                 "group": group, "name": name, "schema": trace_schema,
                 "spans": batch, "ordered_tags": list(ordered_tags),
-            }
+            }, group)
 
         self._deliver_writes(
             Topic.TRACE_WRITE.value,

@@ -185,6 +185,12 @@ class LiaisonServer:
             )
         self.slow_query_ms = slow_query_ms
         self.slowlog = SlowQueryRecorder()
+        # multi-tenant QoS at the gateway (docs/robustness.md
+        # "Multi-tenant QoS"): the liaison is the cluster's ingest/query
+        # ingress, so per-tenant quotas and weighted admission gate here
+        from banyandb_tpu.qos.plane import global_qos
+
+        self.qos = global_qos()
         self.liaison = Liaison(
             self.registry,
             self.transport,
@@ -286,10 +292,10 @@ class LiaisonServer:
 
     # -- user surface -------------------------------------------------------
     def _register(self) -> None:
-        from banyandb_tpu.obs.metrics import global_meter
         from banyandb_tpu.server import (
             TOPIC_METRICS,
             TOPIC_QL,
+            TOPIC_QOS,
             TOPIC_REGISTRY,
             TOPIC_SLOWLOG,
         )
@@ -304,10 +310,8 @@ class LiaisonServer:
             },
         )
         b.subscribe(TOPIC_REGISTRY, self._registry_op)
-        b.subscribe(
-            TOPIC_METRICS,
-            lambda env: {"prometheus": global_meter().prometheus_text()},
-        )
+        b.subscribe(TOPIC_METRICS, self._metrics)
+        b.subscribe(TOPIC_QOS, self._qos)
         b.subscribe(TOPIC_SLOWLOG, self._slowlog)
         b.subscribe(Topic.MEASURE_WRITE, self._measure_write)
         b.subscribe(Topic.STREAM_WRITE, self._stream_write)
@@ -321,6 +325,35 @@ class LiaisonServer:
         # elastic-cluster operator surface (cli.py rebalance
         # plan|apply|status; docs/robustness.md "Elastic cluster")
         b.subscribe("rebalance", self._rebalance)
+
+    def _metrics(self, env: dict):
+        """Liaison /metrics: the process-global meter, with the QoS
+        admission gauges and tenant-labeled cache-partition rows
+        refreshed first — the liaison is the cluster's admission
+        ingress, so sheds/queue depth surface HERE."""
+        from banyandb_tpu.obs.metrics import global_meter
+        from banyandb_tpu.storage.cache import partition_stats
+
+        meter = global_meter()
+        self.qos.export_gauges(meter)
+        for tenant, st in partition_stats().items():
+            for k in ("hits", "misses", "evictions", "entries", "bytes"):
+                meter.gauge_set(
+                    f"serving_cache_{k}", float(st[k]), {"tenant": tenant}
+                )
+        return {"prometheus": meter.prometheus_text()}
+
+    def _qos(self, env: dict):
+        """QoS introspection (cli.py qos), liaison edition — same reply
+        shape as the standalone handler (no protector here: in-flight
+        byte charges live on the write-owning roles)."""
+        from banyandb_tpu.storage.cache import partition_stats
+
+        return {
+            "qos": self.qos.stats(),
+            "cache_partitions": partition_stats(),
+            "inflight_bytes": {},
+        }
 
     def _rebalance(self, env: dict):
         from banyandb_tpu.cluster.rebalance import RebalancePlan
@@ -418,11 +451,15 @@ class LiaisonServer:
         from banyandb_tpu.cluster import serde
 
         req = serde.write_request_from_json(env["request"])
+        # per-tenant ingest quota at the gateway: over-rate sheds with
+        # the retryable ServerBusy wire kind before any fan-out work
+        self.qos.admit_write(req.group, len(req.points))
         return {"written": self.liaison.write_measure(req)}
 
     def _stream_write(self, env: dict):
         from banyandb_tpu.api.schema import _to_jsonable
 
+        self.qos.admit_write(env["group"], len(env["elements"]))
         n = self.liaison.write_stream(
             env["group"], env["name"],
             _to_jsonable(self.registry.get_stream(env["group"], env["name"])),
@@ -433,6 +470,7 @@ class LiaisonServer:
     def _trace_write(self, env: dict):
         from banyandb_tpu.api.schema import _to_jsonable
 
+        self.qos.admit_write(env["group"], len(env["spans"]))
         n = self.liaison.write_trace(
             env["group"], env["name"],
             _to_jsonable(self.registry.get_trace(env["group"], env["name"])),
@@ -468,17 +506,31 @@ class LiaisonServer:
         # req.trace rode the scatter): slow distributed queries land in
         # the flight recorder with whatever tree exists
         tracer = Tracer(f"liaison:{catalog}")
-        t0 = _time.perf_counter()
-        if catalog == "measure":
-            res = self.liaison.query_measure(req, tracer=tracer)
-        elif catalog == "stream":
-            res = self.liaison.query_stream(req, tracer=tracer)
-        else:
-            raise ValueError(
-                f"liaison QL serves measure/stream catalogs; {catalog} "
-                "queries use the dedicated topics"
-            )
-        ms = (_time.perf_counter() - t0) * 1000
+        deadline_ms = env.get("deadline_ms")
+        adm = self.qos.admit_query(
+            req.groups[0] if req.groups else "",
+            deadline_s=(
+                float(deadline_ms) / 1000.0 if deadline_ms else None
+            ),
+        )
+        from banyandb_tpu.qos import tenant_scope
+
+        with adm, tenant_scope(adm.tenant):
+            with tracer.span("qos") as sp:
+                sp.tag("tenant", adm.tenant)
+                if adm.queued_ms >= 1.0:
+                    sp.tag("queued_ms", round(adm.queued_ms, 2))
+            t0 = _time.perf_counter()
+            if catalog == "measure":
+                res = self.liaison.query_measure(req, tracer=tracer)
+            elif catalog == "stream":
+                res = self.liaison.query_stream(req, tracer=tracer)
+            else:
+                raise ValueError(
+                    f"liaison QL serves measure/stream catalogs; {catalog} "
+                    "queries use the dedicated topics"
+                )
+            ms = (_time.perf_counter() - t0) * 1000
         tree = tracer.finish()
 
         def render_plan():
@@ -507,6 +559,7 @@ class LiaisonServer:
             span_tree=tree, ql=env["ql"],
             plan=(res.trace or {}).get("plan"),
             plan_fn=render_plan,
+            tenant=adm.tenant,
         )
         attach_tree(res, req, tree)
         return {"result": result_to_json(res)}

@@ -73,6 +73,11 @@ CONFIG = LayerConfig(
         # dependency-free by design so storage, engines, query and the
         # fabric can all instrument themselves without upward edges
         "obs": L0,
+        # multi-tenant QoS plane: tenancy + admission primitives consulted
+        # by storage (cache partitions), query (streamagg caps) and the
+        # serving roles alike — platform, like obs (its ServerBusy shed
+        # exception is reached lazily, so no upward edge to admin/)
+        "qos": L0,
         # L1 — storage substrate + shared model/schema types
         "storage": L1,
         "index": L1,

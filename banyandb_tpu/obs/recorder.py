@@ -133,6 +133,7 @@ def record_slow_query(
     ql: Optional[str] = None,
     plan: Optional[str] = None,
     plan_fn=None,
+    tenant: str = "",
 ) -> bool:
     """The slow-query epilogue every server role shares: one record
     schema, one threshold check.  `plan_fn` renders the plan post-hoc
@@ -145,11 +146,16 @@ def record_slow_query(
             plan = plan_fn()
         except Exception:  # noqa: BLE001 - the record stays useful
             plan = None
+    if not tenant:
+        from banyandb_tpu.qos.tenancy import tenant_of_group
+
+        tenant = tenant_of_group(group)
     recorder.record(
         {
             "engine": engine,
             "group": group,
             "name": name,
+            "tenant": tenant,
             "ql": ql,
             "duration_ms": round(duration_ms, 3),
             "rows": rows,

@@ -722,52 +722,79 @@ class AutoRegistrar:
     def _enforce_budget(self, live: dict) -> None:
         """Evict least-recently-hit AUTO signatures past either bound.
         Manual registrations (rows whose key this loop never
-        registered) are never touched."""
-        auto_rows = [
-            (key, row) for key, row in live.items() if key in self._auto
-        ]
+        registered) are never touched.
+
+        Budgets are PER TENANT (docs/robustness.md "Multi-tenant QoS"):
+        each tenant gets the full signature-count and state-MB
+        allowance over its own groups, so one tenant's churn can never
+        evict another tenant's materialized windows.  A single-tenant
+        deployment — every group in the default tenant — degenerates to
+        exactly the old global budget."""
+        from banyandb_tpu.qos.tenancy import tenant_of_group
+
+        by_tenant: dict[str, list] = {}
+        for key, row in live.items():
+            if key in self._auto:
+                by_tenant.setdefault(tenant_of_group(key[0]), []).append(
+                    (key, row)
+                )
         max_n = autoreg_max_signatures()
         max_bytes = autoreg_max_state_mb() * (1 << 20)
-        # only AUTO signatures' window states count against the autoreg
-        # budget: a large MANUAL registration is the operator's own
-        # memory decision and must not starve auto materialization
-        # (only auto signatures are ever evicted here)
-        total_states = sum(int(r.get("states", 0)) for _k, r in auto_rows)
 
         def lru_order(kr):
             row = kr[1]
             return (row.get("last_hit_ms") or 0, row.get("hits") or 0)
 
-        auto_rows.sort(key=lru_order)
-        while auto_rows and (
-            len(auto_rows) > max_n
-            or total_states * _STATE_BYTES > max_bytes
-        ):
-            key, row = auto_rows.pop(0)
-            try:
-                if self.unregister_fn(*key):
-                    self.evicted_total += 1
-                    total_states -= int(row.get("states", 0))
-                    with self._lock:
-                        self._auto.discard(key)
-                    self._note_evicted(key)
-                    log.info(
-                        "autoreg: evicted %s/%s%s (budget)",
-                        key[0], key[1], list(key[2]),
-                    )
-            except Exception:  # noqa: BLE001 — eviction must not kill the loop
-                self.errors += 1
-                break
+        for auto_rows in by_tenant.values():
+            # only AUTO signatures' window states count against the
+            # autoreg budget: a large MANUAL registration is the
+            # operator's own memory decision and must not starve auto
+            # materialization (only auto signatures are ever evicted)
+            total_states = sum(
+                int(r.get("states", 0)) for _k, r in auto_rows
+            )
+            auto_rows.sort(key=lru_order)
+            while auto_rows and (
+                len(auto_rows) > max_n
+                or total_states * _STATE_BYTES > max_bytes
+            ):
+                key, row = auto_rows.pop(0)
+                try:
+                    if self.unregister_fn(*key):
+                        self.evicted_total += 1
+                        total_states -= int(row.get("states", 0))
+                        with self._lock:
+                            self._auto.discard(key)
+                        self._note_evicted(key)
+                        log.info(
+                            "autoreg: evicted %s/%s%s (budget)",
+                            key[0], key[1], list(key[2]),
+                        )
+                except Exception:  # noqa: BLE001 — must not kill the loop
+                    self.errors += 1
+                    return
 
     # -- the tick ------------------------------------------------------------
-    def _make_room(self, live: dict, cand_last_ms: int) -> bool:
+    def _make_room(
+        self, live: dict, cand_last_ms: int, tenant: str = ""
+    ) -> bool:
         """Displace the least-recently-HIT auto signature for a new
         candidate — only when that victim is actually COLDER than the
         candidate's evidence (a dashboard whose windows serve every
         refresh keeps a fresh last-hit and is never displaced by a
-        one-off).  Manual registrations are never touched."""
+        one-off).  Manual registrations are never touched; victims come
+        from the CANDIDATE'S OWN tenant only (per-tenant budget
+        partitions — one tenant's hot pattern never displaces
+        another's)."""
+        from banyandb_tpu.qos.tenancy import tenant_of_group
+
         rows = sorted(
-            ((k, live[k]) for k in live if k in self._auto),
+            (
+                (k, live[k])
+                for k in live
+                if k in self._auto
+                and (not tenant or tenant_of_group(k[0]) == tenant)
+            ),
             key=lambda kr: (
                 kr[1].get("last_hit_ms") or 0,
                 kr[1].get("hits") or 0,
@@ -814,10 +841,19 @@ class AutoRegistrar:
                 ),
                 key=lambda kr: -kr[1]["hits"],
             )
+        from banyandb_tpu.qos.tenancy import tenant_of_group
+
         for key, rec in candidates:
-            n_auto = sum(1 for k in live if k in self._auto)
+            # per-tenant count: the cap applies within the candidate's
+            # tenant, not across the whole node
+            tenant = tenant_of_group(key[0])
+            n_auto = sum(
+                1
+                for k in live
+                if k in self._auto and tenant_of_group(k[0]) == tenant
+            )
             if n_auto >= max_n and not self._make_room(
-                live, rec["last_ms"]
+                live, rec["last_ms"], tenant
             ):
                 continue
             try:

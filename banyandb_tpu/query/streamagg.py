@@ -379,9 +379,26 @@ class StreamAggRegistry:
             if promoted:
                 self._persist()
             return existing_out
+        # per-tenant registration cap (docs/robustness.md "Multi-tenant
+        # QoS"): a NEW signature must fit its tenant's quota — one
+        # tenant registering signatures cannot grow another tenant's
+        # node state (generous default: unlimited).  Idempotent
+        # re-registration returned above and is never gated.
+        from banyandb_tpu.qos.plane import global_qos as _global_qos
+        from banyandb_tpu.qos.tenancy import tenant_of_group as _tenant_of
+
+        _tenant = _tenant_of(group)
         with self._lock:
             if spec in self._sigs:  # raced a concurrent register
                 return self._stats_one_locked(self._sigs[spec])
+            # count + admit + install under ONE critical section, or
+            # two concurrent registrations could both squeeze past the
+            # cap (the plane's lock nests under this one; nothing takes
+            # them in the opposite order)
+            _existing_n = sum(
+                1 for s in self._sigs if _tenant_of(s.group) == _tenant
+            )
+            _global_qos().admit_streamagg(group, _existing_n)
             self._sigs[spec] = sig
             self._rebind_snapshots_locked()
         try:

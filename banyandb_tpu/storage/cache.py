@@ -23,14 +23,21 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from banyandb_tpu.qos import tenancy
 from banyandb_tpu.utils.envflag import env_int
 
 DEFAULT_BUDGET = int(os.environ.get("BYDB_SERVING_CACHE_BYTES", 256 << 20))
-# optional ENTRY capacity on top of the byte budget: the load harness
-# showed a 916-entry squeeze churning 18k evictions in 10 minutes
-# (docs/load_r06.json) — operators size the entry population explicitly
-# with BYDB_SERVING_CACHE_CAP / --serving-cache-cap (0 = bytes-only)
-DEFAULT_CAP = env_int("BYDB_SERVING_CACHE_CAP", 0)
+
+
+def default_cap() -> int:
+    """Optional ENTRY capacity on top of the byte budget: the load
+    harness showed a 916-entry squeeze churning 18k evictions in 10
+    minutes (docs/load_r06.json) — operators size the entry population
+    explicitly with BYDB_SERVING_CACHE_CAP / --serving-cache-cap
+    (0 = bytes-only).  Read at CONSTRUCTION time, matching the other
+    envflag call sites, so a post-import env change or late server flag
+    takes effect without re-import (tests/test_serving_cache.py pins)."""
+    return env_int("BYDB_SERVING_CACHE_CAP", 0)
 
 
 def _sizeof(obj) -> int:
@@ -59,8 +66,8 @@ class ServingCache:
     ):
         self.budget = budget_bytes
         # entry cap: 0 = unlimited (byte budget only); None inherits the
-        # BYDB_SERVING_CACHE_CAP env default read at import
-        self.cap = DEFAULT_CAP if max_entries is None else int(max_entries)
+        # BYDB_SERVING_CACHE_CAP env default, read now (construction)
+        self.cap = default_cap() if max_entries is None else int(max_entries)
         self._lock = threading.Lock()
         self._entries: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
         self.bytes = 0
@@ -154,9 +161,46 @@ _global = ServingCache()
 DEVICE_BUDGET = int(os.environ.get("BYDB_DEVICE_CACHE_BYTES", 1 << 30))
 _device = ServingCache(DEVICE_BUDGET, max_entries=0)
 
+# Per-tenant serving-cache partitions (docs/robustness.md "Multi-tenant
+# QoS"): queries running under a non-default tenant scope (qos/tenancy
+# contextvar, bound by the serving roles) read/write their tenant's OWN
+# LRU, so one tenant's churn cannot evict another's entries.  The
+# default tenant keeps the original process-global instance — untenanted
+# deployments are byte-identical to pre-QoS behavior.  Each partition
+# gets the tenant's configured budget (qos limits `cache_bytes`) or the
+# process default, and the same entry-cap knob.
+_partitions: dict[str, ServingCache] = {}
+_partitions_lock = threading.Lock()
+
+
+def _tenant_partition(tenant: str) -> ServingCache:
+    part = _partitions.get(tenant)
+    if part is None:
+        with _partitions_lock:
+            part = _partitions.get(tenant)
+            if part is None:
+                from banyandb_tpu.qos.plane import global_qos
+
+                budget = (
+                    global_qos().limits(tenant).cache_bytes or DEFAULT_BUDGET
+                )
+                part = _partitions[tenant] = ServingCache(budget)
+    return part
+
 
 def global_cache() -> ServingCache:
-    return _global
+    tenant = tenancy.current_tenant()
+    if tenant == tenancy.DEFAULT_TENANT:
+        return _global
+    return _tenant_partition(tenant)
+
+
+def partition_stats() -> dict[str, dict]:
+    """Per-tenant partition stats for /metrics (`tenant`-labeled rows);
+    the default tenant's cache keeps its original unlabeled series."""
+    with _partitions_lock:
+        parts = dict(_partitions)
+    return {t: c.stats() for t, c in sorted(parts.items())}
 
 
 def device_cache() -> ServingCache:
@@ -168,4 +212,6 @@ def reset_global_cache(budget_bytes: int = DEFAULT_BUDGET) -> ServingCache:
     global _global, _device
     _global = ServingCache(budget_bytes)
     _device = ServingCache(DEVICE_BUDGET, max_entries=0)
+    with _partitions_lock:
+        _partitions.clear()
     return _global

@@ -577,12 +577,36 @@ def run_smoke(tmp_root, seed: int = 42, budget_s: float = 3.0) -> dict:
         "mode": "smoke", "seed": seed, "kill_cycles": 0,
         "degraded_seen": 0, "max_query_wall_s": 0.0,
     }
+    # QoS armed for every cycle (docs/robustness.md "Multi-tenant
+    # QoS"): the admission plane runs live with a configured tenant
+    # table; the chaos traffic is untenanted (default tenant, generous
+    # limits), so the kill/degradation cycles must stay green — zero
+    # acked loss, zero spurious sheds — THROUGH the armed plane.
+    from banyandb_tpu.qos.plane import reset_qos
+
+    saved_qos = {
+        k: os.environ.get(k) for k in ("BYDB_QOS", "BYDB_QOS_TENANTS")
+    }
+    os.environ["BYDB_QOS"] = "1"
+    os.environ["BYDB_QOS_TENANTS"] = json.dumps(
+        {"chaos": {"write_rate": 1_000_000, "max_concurrent": 64}}
+    )
+    reset_qos()
+    stats["qos_armed"] = 1
     t0 = time.perf_counter()
-    _smoke_wqueue_cycles(tmp, budget_s, stats)
-    _smoke_degradation(tmp, budget_s, stats)
-    _smoke_fault_schedule(tmp, seed, stats)
-    _smoke_worker_cycles(tmp, seed, stats)
-    _smoke_rebalance_under_kill(tmp, seed, stats)
+    try:
+        _smoke_wqueue_cycles(tmp, budget_s, stats)
+        _smoke_degradation(tmp, budget_s, stats)
+        _smoke_fault_schedule(tmp, seed, stats)
+        _smoke_worker_cycles(tmp, seed, stats)
+        _smoke_rebalance_under_kill(tmp, seed, stats)
+    finally:
+        for k, v in saved_qos.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        reset_qos()
     stats["wall_s"] = round(time.perf_counter() - t0, 2)
     assert stats["kill_cycles"] >= 3
     assert stats["degraded_seen"] >= 1

@@ -64,6 +64,13 @@ echo "== planner smoke =="
 # (docs/performance.md "Adaptive planner")
 env JAX_PLATFORMS=cpu python scripts/planner_smoke.py || fail=1
 
+echo "== qos smoke =="
+# multi-tenant QoS: abuser tenant shed with the retryable kind=shed
+# wire rejection + per-tenant counters, compliant tenant served,
+# serving-cache partition isolation, single-tenant parity
+# (docs/robustness.md "Multi-tenant QoS")
+env JAX_PLATFORMS=cpu python scripts/qos_smoke.py || fail=1
+
 echo "== sanitize smoke (bdsan) =="
 # live-engine stress slice under BYDB_SANITIZE=1: lock-order witnesses
 # consistent with the declared graph, zero leaked threads/fds, seeded

@@ -446,6 +446,393 @@ def _drive_load(
     }
 
 
+def _columns_env(group: str, measure: str, ts, rng, batch: int) -> dict:
+    """One columnar write envelope in the benchmark-single-model ingest
+    shape (svc/region/status tags + one float field)."""
+    import base64
+
+    def b64(a) -> str:
+        return base64.b64encode(a.tobytes()).decode()
+
+    return {
+        "group": group, "name": measure,
+        "ts": b64(ts.astype("<i8")),
+        "versions": b64(np.ones(batch, dtype="<i8")),
+        "tags": {
+            "svc": {
+                "dict": [f"s{i}" for i in range(50)],
+                "codes": b64(
+                    rng.integers(0, 50, batch, dtype=np.int32).astype("<i4")
+                ),
+            },
+            "region": {
+                "dict": [f"r{i}" for i in range(3)],
+                "codes": b64(
+                    rng.integers(0, 3, batch, dtype=np.int32).astype("<i4")
+                ),
+            },
+            "status": {
+                "dict": [200, 404, 500],
+                "codes": b64(
+                    rng.integers(0, 3, batch, dtype=np.int32).astype("<i4")
+                ),
+            },
+        },
+        "fields": {
+            "value": b64(rng.integers(0, 1000, batch).astype("<f8")),
+        },
+    }
+
+
+def _tenant_phase(
+    *,
+    tenants: dict,
+    seconds: float,
+    batch: int,
+    seed: int,
+    query_interval_ms: int,
+    quota: int,
+) -> dict:
+    """One multi-tenant load phase on a fresh standalone server with the
+    QoS plane armed: ``tenants`` maps tenant name -> target write rate
+    (points/s; the ABUSER's target exceeds its quota on purpose).  Every
+    tenant gets its own group (``<tenant>.load``), one paced writer and
+    one open-loop querier; per-tenant latency percentiles, client-side
+    shed counts (TransportError kind="shed" — the EXPECTED rejection,
+    never counted as an error) and a zero-silent-drop witness (acked
+    writes == served count) come back per tenant."""
+    import os as _os
+    import tempfile
+
+    from banyandb_tpu.cluster.bus import Topic
+    from banyandb_tpu.cluster.rpc import GrpcTransport, TransportError
+    from banyandb_tpu.qos.plane import reset_qos
+    from banyandb_tpu.server import (
+        TOPIC_QL,
+        TOPIC_QOS,
+        TOPIC_REGISTRY,
+        StandaloneServer,
+    )
+
+    root = tempfile.mkdtemp(prefix="bydb-tenants-")
+    _os.environ["BYDB_AUTOREG"] = "0"
+    _os.environ["BYDB_QOS"] = "1"
+    _os.environ["BYDB_QOS_TENANTS"] = json.dumps(
+        {t: {"write_rate": quota} for t in tenants}
+    )
+    reset_qos()
+    srv = StandaloneServer(root, port=0, workers=0)
+    srv.start()
+    addr = srv.addr
+
+    def call(transport, topic, env, timeout=60.0):
+        return transport.call(addr, topic, env, timeout=timeout)
+
+    try:
+        setup = GrpcTransport()
+        try:
+            for tenant in tenants:
+                call(setup, TOPIC_REGISTRY, {
+                    "op": "create", "kind": "group", "item": {
+                        "name": f"{tenant}.load", "catalog": "measure",
+                        "resource_opts": {
+                            "shard_num": 1, "replicas": 0,
+                            "segment_interval": {"num": 1, "unit": "day"},
+                            "ttl": {"num": 7, "unit": "day"}, "stages": [],
+                        },
+                    },
+                })
+                call(setup, TOPIC_REGISTRY, {
+                    "op": "create", "kind": "measure", "item": {
+                        "group": f"{tenant}.load", "name": MEASURE,
+                        "tags": [
+                            {"name": "svc", "type": "string"},
+                            {"name": "region", "type": "string"},
+                            {"name": "status", "type": "int"},
+                        ],
+                        "fields": [{"name": "value", "type": "float"}],
+                        "entity": {"tag_names": ["svc"]},
+                        "interval": "", "index_mode": False,
+                    },
+                })
+                # the covering dashboard signature, per tenant (same
+                # deployment shape as run_load): tenant-partitioned
+                # materialized windows are part of what the scenario
+                # verifies — one tenant's churn must not evict another's
+                from banyandb_tpu.server import TOPIC_STREAMAGG
+
+                call(setup, TOPIC_STREAMAGG, {
+                    "op": "register", "group": f"{tenant}.load",
+                    "measure": MEASURE,
+                    "key_tags": ["region", "svc"], "fields": ["value"],
+                    "window_millis": 15_000,
+                })
+        finally:
+            setup.close()
+
+        stop = threading.Event()
+        acked = {t: 0 for t in tenants}
+        sheds = {t: 0 for t in tenants}
+        write_errors = {t: 0 for t in tenants}
+        q_lat = {t: [] for t in tenants}  # (ms, served)
+        q_sheds = {t: 0 for t in tenants}
+        q_errors = {t: 0 for t in tenants}
+        clock0 = time.time()
+
+        def writer(tenant: str, rate: float):
+            rng = np.random.default_rng(seed + sum(map(ord, tenant)))
+            tr = GrpcTransport()
+            t_start = time.monotonic()
+            sent = 0  # attempted points (pacing covers sheds too)
+            try:
+                while not stop.is_set():
+                    due = t_start + sent / rate
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        if stop.wait(min(delay, 0.5)):
+                            break
+                        continue
+                    ts = T0 + acked[tenant] + np.arange(batch, dtype=np.int64)
+                    env = _columns_env(
+                        f"{tenant}.load", MEASURE, ts, rng, batch
+                    )
+                    sent += batch
+                    try:
+                        call(tr, Topic.MEASURE_WRITE_COLUMNS.value, env)
+                        acked[tenant] += batch
+                    except TransportError as e:
+                        if getattr(e, "kind", "") == "shed":
+                            sheds[tenant] += 1  # EXPECTED, retryable
+                        else:
+                            write_errors[tenant] += 1
+                    except Exception:  # noqa: BLE001 - keep load flowing
+                        write_errors[tenant] += 1
+            finally:
+                tr.close()
+
+        AGGS = ("count", "sum", "mean", "max")
+
+        def querier(tenant: str):
+            rng = np.random.default_rng(7000 + seed + sum(map(ord, tenant)))
+            tr = GrpcTransport()
+            issued = 0
+            q_start = time.monotonic()
+            try:
+                while not stop.is_set():
+                    due = q_start + issued * query_interval_ms / 1000.0
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        if stop.wait(min(delay, 0.5)):
+                            break
+                        continue
+                    issued += 1
+                    agg = AGGS[rng.integers(0, len(AGGS))]
+                    hw = T0 + max(acked[tenant], 1)
+                    where = (
+                        f"WHERE region = 'r{rng.integers(0, 3)}' "
+                        if rng.integers(0, 2) else ""
+                    )
+                    ql = (
+                        f"SELECT {agg}(value) FROM MEASURE {MEASURE} "
+                        f"IN {tenant}.load "
+                        f"TIME BETWEEN {T0} AND {hw} "
+                        f"{where}GROUP BY svc LIMIT 100"
+                    )
+                    t0 = time.perf_counter()
+                    try:
+                        reply = call(tr, TOPIC_QL, {"ql": ql})
+                        q_lat[tenant].append((
+                            (time.perf_counter() - t0) * 1000,
+                            reply.get("served", "scan"),
+                        ))
+                    except TransportError as e:
+                        if getattr(e, "kind", "") == "shed":
+                            q_sheds[tenant] += 1
+                        else:
+                            q_errors[tenant] += 1
+                    except Exception:  # noqa: BLE001
+                        q_errors[tenant] += 1
+            finally:
+                tr.close()
+
+        threads = [
+            threading.Thread(target=writer, args=(t, r), daemon=True)
+            for t, r in tenants.items()
+        ] + [
+            threading.Thread(target=querier, args=(t,), daemon=True)
+            for t in tenants
+        ]
+        for th in threads:
+            th.start()
+        time.sleep(seconds)
+        stop.set()
+        for th in threads:
+            th.join(timeout=30)
+        elapsed = time.time() - clock0
+
+        # zero-silent-drop witness: every ACKED point must be served
+        # back by a count over the full range (sheds were never acked)
+        served_counts = {}
+        probe = GrpcTransport()
+        try:
+            for tenant in tenants:
+                r = call(probe, TOPIC_QL, {
+                    "ql": f"SELECT count(value) FROM MEASURE {MEASURE} "
+                          f"IN {tenant}.load "
+                          f"TIME BETWEEN {T0} AND {T0 + (1 << 40)}",
+                })
+                served_counts[tenant] = int(
+                    sum(r["result"]["values"].get("count", ()))
+                )
+            qos_stats = call(probe, TOPIC_QOS, {})["qos"]["tenants"]
+        finally:
+            probe.close()
+
+        out: dict = {"seconds": round(elapsed, 1), "tenants": {}}
+        for tenant, rate in tenants.items():
+            lats = q_lat[tenant]
+            scans = sorted(ms for ms, served in lats if served != "replay")
+            all_ms = sorted(ms for ms, _s in lats)
+            server_side = qos_stats.get(tenant, {})
+            out["tenants"][tenant] = {
+                "target_rate": rate,
+                "quota": quota,
+                "acked_points": acked[tenant],
+                "acked_rate": round(acked[tenant] / elapsed, 1),
+                "write_sheds_client": sheds[tenant],
+                "write_shed_server": server_side.get("write_shed", 0),
+                "write_errors": write_errors[tenant],
+                "silent_drops": max(
+                    0, acked[tenant] - served_counts[tenant]
+                ),
+                "queries": len(lats),
+                "query_sheds": q_sheds[tenant],
+                "query_errors": q_errors[tenant],
+                "p50_ms": round(_percentile(all_ms, 50), 1),
+                "p99_ms": round(_percentile(all_ms, 99), 1),
+                "scan_p50_ms": round(_percentile(scans, 50), 1),
+                "scan_samples": len(scans),
+            }
+        return out
+    finally:
+        srv.stop()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+        for k in ("BYDB_QOS", "BYDB_QOS_TENANTS"):
+            _os.environ.pop(k, None)
+        from banyandb_tpu.qos.plane import reset_qos as _reset
+
+        _reset()
+
+
+TENANTS_MIN_CORES = 4
+
+
+def run_tenants(
+    *,
+    seconds: float = 40.0,
+    quota: int = 4000,
+    abuse_x: int = 10,
+    batch: int = 200,
+    seed: int = 0,
+    query_interval_ms: int = 250,
+    allow_small_host: bool = False,
+) -> dict:
+    """The ROADMAP item 4 adversarial scenario: one ABUSER tenant
+    driving ingest at ``abuse_x`` times its quota beside two compliant
+    tenants, after a SOLO baseline phase measuring one compliant tenant
+    alone (SAME duration and rate, so both phases scan comparable row
+    counts).  The done-bar: compliant scan_p50 within 1.5x of its solo
+    baseline, the abuser shed with explicit retryable rejections, zero
+    silent drops anywhere.
+
+    Small-host rule (same as --scaling/--expand): on a
+    < TENANTS_MIN_CORES host the server, three tenants' clients and
+    the abuser's shed attempts all convoy on the same cores, so the
+    p50 ratio measures the BOX, not the admission plane — refuse
+    unless --allow-small-host, and stamp the artifact with an explicit
+    caveat when recorded anyway."""
+    import os as _os
+
+    cores = _os.cpu_count() or 1
+    small = cores < TENANTS_MIN_CORES
+    if small and not allow_small_host:
+        raise SystemExit(
+            f"load --tenants: host has {cores} cores < "
+            f"{TENANTS_MIN_CORES}; the compliant-p50 ratio would "
+            "measure core contention, not tenant isolation.  Re-run on "
+            "a bigger host, or pass --allow-small-host to record an "
+            "explicitly-caveated artifact."
+        )
+    compliant_rate = max(quota // 2, 1)  # well inside quota
+    phase_s = max(seconds * 0.5, 10.0)  # EQUAL phases: comparable scans
+    solo = _tenant_phase(
+        tenants={"t1": compliant_rate},
+        seconds=phase_s,
+        batch=batch, seed=seed,
+        query_interval_ms=query_interval_ms, quota=quota,
+    )
+    adversarial = _tenant_phase(
+        tenants={
+            "t1": compliant_rate,
+            "t2": compliant_rate,
+            "abuser": quota * abuse_x,
+        },
+        seconds=phase_s,
+        batch=batch, seed=seed + 1,
+        query_interval_ms=query_interval_ms, quota=quota,
+    )
+    solo_p50 = solo["tenants"]["t1"]["scan_p50_ms"]
+    compliant = [adversarial["tenants"][t] for t in ("t1", "t2")]
+    worst_p50 = max(c["scan_p50_ms"] for c in compliant)
+    abuser = adversarial["tenants"]["abuser"]
+    out = {
+        "phase": "tenants",
+        "cores": cores,
+        "small_host": small,
+        "quota_points_per_s": quota,
+        "abuse_x": abuse_x,
+        "solo": solo,
+        "adversarial": adversarial,
+        "solo_scan_p50_ms": solo_p50,
+        "worst_compliant_scan_p50_ms": worst_p50,
+        "compliant_p50_x": (
+            round(worst_p50 / solo_p50, 2) if solo_p50 > 0 else None
+        ),
+        "abuser_sheds": abuser["write_sheds_client"],
+        "abuser_acked_rate": abuser["acked_rate"],
+        "silent_drops": sum(
+            row["silent_drops"]
+            for phase in (solo, adversarial)
+            for row in phase["tenants"].values()
+        ),
+        "compliant_scan_samples": sum(
+            c["scan_samples"] for c in compliant
+        ),
+        "write_errors": sum(
+            row["write_errors"]
+            for phase in (solo, adversarial)
+            for row in phase["tenants"].values()
+        ),
+        "query_errors": sum(
+            row["query_errors"]
+            for phase in (solo, adversarial)
+            for row in phase["tenants"].values()
+        ),
+    }
+    if small:
+        out["caveat"] = (
+            f"measured on a {cores}-core host: server + three tenants' "
+            "clients + the abuser's shed attempts share cores, so the "
+            "compliant-p50 ratio OVERSTATES the abuser's impact; the "
+            "ROADMAP <=1.5x bar is only valid on >= "
+            f"{TENANTS_MIN_CORES} cores.  Shed/isolation/zero-drop "
+            "witnesses are box-independent and binding."
+        )
+    return out
+
+
 SCALING_MIN_CORES = 8
 
 
@@ -847,6 +1234,30 @@ def main(argv=None) -> int:
         "a small host = failed SLO (vacuous-pass rule)",
     )
     ap.add_argument(
+        "--tenants", action="store_true",
+        help="multi-tenant adversarial scenario (ROADMAP item 4 "
+        "done-bar): one abusive tenant at --abuse-x times its quota "
+        "beside two compliant tenants, after a solo compliant "
+        "baseline — persists per-tenant p50/p99, shed counts and the "
+        "zero-silent-drop witness",
+    )
+    ap.add_argument(
+        "--tenant-quota", type=int, default=4000,
+        help="per-tenant ingest quota, points/s (the abuser targets "
+        "--abuse-x times this)",
+    )
+    ap.add_argument(
+        "--abuse-x", type=int, default=10,
+        help="abuser ingest multiple over its quota (default 10)",
+    )
+    ap.add_argument(
+        "--max-compliant-p50-x", type=float, default=0.0,
+        help="SLO ceiling on worst compliant-tenant scan_p50 / solo "
+        "baseline scan_p50 under --tenants (the ROADMAP done-bar reads "
+        "<= 1.5); zero compliant scan samples = failed SLO "
+        "(vacuous-pass rule)",
+    )
+    ap.add_argument(
         "--scaling", action="store_true",
         help="run the 1->4 worker scaling phase instead of one load run "
         "(persists per-phase stats + scaling ratios; requires a host "
@@ -890,6 +1301,41 @@ def main(argv=None) -> int:
                 or stats["move_p99_x"] > args.max_move_p99_x
             ):
                 slo_fail.append("move_p99")
+        stats["slo_fail"] = slo_fail
+        print(json.dumps(stats))
+        if args.out:
+            from pathlib import Path
+
+            Path(args.out).write_text(json.dumps(stats, indent=1) + "\n")
+        return 1 if slo_fail else 0
+    if args.tenants:
+        stats = run_tenants(
+            seconds=args.seconds, quota=args.tenant_quota,
+            abuse_x=args.abuse_x, batch=args.batch, seed=args.seed,
+            query_interval_ms=args.query_interval_ms or 250,
+            allow_small_host=args.allow_small_host,
+        )
+        slo_fail = []
+        if stats["abuser_sheds"] == 0:
+            # the quota never bit: the scenario measured nothing
+            slo_fail.append("abuser_not_shed")
+        if stats["silent_drops"]:
+            slo_fail.append("silent_drops")
+        if stats["write_errors"] or stats["query_errors"]:
+            slo_fail.append("errors")
+        if args.max_compliant_p50_x:
+            if stats["small_host"]:
+                # vacuous-pass guard: a ratio measured under core
+                # contention must never satisfy the bar
+                slo_fail.append("compliant_p50_unmeasurable_small_host")
+            elif stats["compliant_scan_samples"] == 0 or not stats[
+                "compliant_p50_x"
+            ]:
+                # zero compliant samples (or an unmeasurable solo
+                # baseline) must never satisfy the bar either
+                slo_fail.append("compliant_p50_unmeasurable")
+            elif stats["compliant_p50_x"] > args.max_compliant_p50_x:
+                slo_fail.append("compliant_p50")
         stats["slo_fail"] = slo_fail
         print(json.dumps(stats))
         if args.out:

@@ -1,0 +1,609 @@
+"""Multi-tenant QoS plane (docs/robustness.md "Multi-tenant QoS").
+
+Covers the ISSUE 15 tentpole end to end:
+
+- tenant derivation from the group namespace (untenanted -> ``default``);
+- per-tenant ingest token buckets shedding with ServerBusy (the
+  retryable ``kind="shed"`` wire class);
+- weighted query admission: per-tenant concurrency caps, deadline-aware
+  queueing, weighted sharing of a global pool;
+- the protector's per-tenant in-flight charge accounting;
+- per-tenant serving-cache partitions (isolation + default identity);
+- per-tenant streamagg registration caps and autoreg budget partitions;
+- single-tenant back-compat: with the DEFAULT config (QoS on, generous
+  limits) untenanted writes/queries produce result JSON byte-identical
+  to the plane being off, across measure aggregate / raw / streamagg /
+  TopN shapes, and /metrics keeps every pre-QoS series name (the tenant
+  label only ADDS series).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from banyandb_tpu.admin.protector import MemoryProtector, ServerBusy
+from banyandb_tpu.qos.plane import QosPlane
+from banyandb_tpu.qos.tenancy import (
+    DEFAULT_TENANT,
+    current_tenant,
+    tenant_of_group,
+    tenant_scope,
+)
+
+T0 = 1_700_000_000_000
+
+
+# -- tenancy -----------------------------------------------------------------
+
+
+def test_tenant_derivation():
+    assert tenant_of_group("load") == DEFAULT_TENANT
+    assert tenant_of_group("") == DEFAULT_TENANT
+    assert tenant_of_group("acme.metrics") == "acme"
+    assert tenant_of_group("acme.a.b") == "acme"
+    # a leading separator has an empty namespace: default, not ""
+    assert tenant_of_group(".metrics") == DEFAULT_TENANT
+
+
+def test_tenant_scope_contextvar():
+    assert current_tenant() == DEFAULT_TENANT
+    with tenant_scope("acme"):
+        assert current_tenant() == "acme"
+        with tenant_scope("zeta"):
+            assert current_tenant() == "zeta"
+        assert current_tenant() == "acme"
+    assert current_tenant() == DEFAULT_TENANT
+
+
+# -- ingest quotas -----------------------------------------------------------
+
+
+def test_write_quota_sheds_retryably():
+    q = QosPlane(
+        enabled=True, tenants={"abuser": {"write_rate": 100}},
+    )
+    # burst = 2s of rate (200 tokens); the debt model admits while
+    # tokens remain positive, then sheds until the refill catches up
+    admitted = shed = 0
+    for _ in range(10):
+        try:
+            q.admit_write("abuser.g", 100)
+            admitted += 1
+        except ServerBusy:
+            shed += 1
+    assert admitted >= 1 and shed >= 5
+    st = q.stats()["tenants"]["abuser"]
+    assert st["write_admitted"] == admitted
+    assert st["write_shed"] == shed
+    # other tenants are untouched by the abuser's bucket
+    assert q.admit_write("good.g", 10_000) == "good"
+    # untenanted groups ride the default tenant, unlimited by default
+    assert q.admit_write("plain", 10_000_000) == DEFAULT_TENANT
+
+
+def test_malformed_tenant_limits_never_crash(monkeypatch):
+    """A typo'd tuning value in BYDB_QOS_TENANTS must not keep a server
+    from booting: the bad value falls back to its generous default
+    (same policy as malformed JSON)."""
+    from banyandb_tpu.qos.plane import reset_qos
+
+    monkeypatch.setenv(
+        "BYDB_QOS_TENANTS",
+        '{"acme": {"write_rate": null, "weight": "fast"}, "odd": 5,'
+        ' "ok": {"write_rate": 10}}',
+    )
+    try:
+        q = reset_qos()
+        assert q.limits("acme").write_rate == 0.0  # default kept
+        assert q.limits("acme").weight == 1.0
+        assert q.limits("ok").write_rate == 10.0
+        assert q.admit_write("acme.g", 10_000) == "acme"
+        # fully malformed JSON is ignored wholesale
+        monkeypatch.setenv("BYDB_QOS_TENANTS", "{not json")
+        assert reset_qos().limits("acme").write_rate == 0.0
+    finally:
+        monkeypatch.delenv("BYDB_QOS_TENANTS")
+        reset_qos()
+
+
+def test_export_gauges_zero_after_drain():
+    from banyandb_tpu.obs.metrics import Meter
+
+    q = QosPlane(enabled=True, tenants={"t": {"max_concurrent": 2}})
+    m = Meter("t")
+    with q.admit_query("t.g"):
+        q.export_gauges(m)
+        snap = m.snapshot()["gauges"]
+        assert snap[("qos_query_active", (("tenant", "t"),))] == 1.0
+    q.export_gauges(m)
+    snap = m.snapshot()["gauges"]
+    # drained tenants overwrite to ZERO — a stale last-nonzero gauge
+    # would page on idle tenants forever
+    assert snap[("qos_query_active", (("tenant", "t"),))] == 0.0
+
+
+def test_oversized_write_sheds_immediately():
+    import time
+
+    p = MemoryProtector(
+        limit_bytes=None, max_wait_s=2.0,
+        tenant_limit_fn=lambda t: 1000,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(ServerBusy, match="whole in-flight budget"):
+        p.acquire(2000, tenant="small")
+    # no amount of draining admits 2000B into a 1000B budget: the shed
+    # must NOT burn the full 2s backoff window
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_qos_disabled_is_passthrough():
+    q = QosPlane(enabled=False, tenants={"t": {"write_rate": 1}})
+    for _ in range(50):
+        assert q.admit_write("t.g", 1000) == "t"
+    with q.admit_query("t.g") as adm:
+        assert adm.tenant == "t"
+
+
+# -- query admission ---------------------------------------------------------
+
+
+def test_query_cap_queue_and_shed():
+    q = QosPlane(
+        enabled=True,
+        tenants={"t": {"max_concurrent": 1}},
+        max_queue_s=0.15,
+    )
+    first = q.admit_query("t.g")
+    first.__enter__()
+    try:
+        with pytest.raises(ServerBusy):
+            with q.admit_query("t.g"):
+                pass  # pragma: no cover
+    finally:
+        first.__exit__(None, None, None)
+    # slot released: next admission is immediate
+    with q.admit_query("t.g") as adm:
+        assert adm.tenant == "t"
+    st = q.stats()["tenants"]["t"]
+    assert st["query_shed"] == 1 and st["query_admitted"] == 2
+
+
+def test_query_deadline_clamps_queue_wait():
+    q = QosPlane(
+        enabled=True, tenants={"t": {"max_concurrent": 1}}, max_queue_s=30.0
+    )
+    hold = q.admit_query("t.g")
+    hold.__enter__()
+    try:
+        import time
+
+        t0 = time.monotonic()
+        with pytest.raises(ServerBusy):
+            with q.admit_query("t.g", deadline_s=0.1):
+                pass  # pragma: no cover
+        # waited the query's deadline headroom, not the 30s queue cap
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        hold.__exit__(None, None, None)
+
+
+def test_queued_query_admits_on_release():
+    import threading
+
+    q = QosPlane(
+        enabled=True, tenants={"t": {"max_concurrent": 1}}, max_queue_s=5.0
+    )
+    hold = q.admit_query("t.g")
+    hold.__enter__()
+    got = []
+
+    def waiter():
+        with q.admit_query("t.g") as adm:
+            got.append(adm.queued_ms)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    import time
+
+    time.sleep(0.2)
+    hold.__exit__(None, None, None)
+    th.join(timeout=5)
+    assert got and got[0] >= 100.0  # really queued, then admitted
+    assert q.stats()["tenants"]["t"]["query_queued"] == 1
+
+
+def test_weighted_global_pool_prefers_light_tenant():
+    """Under a contended global pool the tenant with the fewest active
+    slots per unit weight admits first: a weight-4 tenant holding 2
+    slots (deficit 0.5) beats a weight-1 tenant holding 1 (deficit 1)."""
+    q = QosPlane(
+        enabled=True,
+        tenants={"heavy": {"weight": 1.0}, "vip": {"weight": 4.0}},
+        query_global_max=4,
+        max_queue_s=0.5,
+    )
+    held = [q.admit_query("heavy.g"), q.admit_query("vip.g"),
+            q.admit_query("vip.g"), q.admit_query("heavy.g")]
+    for h in held:
+        h.__enter__()
+    import threading
+
+    order = []
+
+    def waiter(group):
+        try:
+            with q.admit_query(group):
+                order.append(tenant_of_group(group))
+                import time
+
+                time.sleep(0.05)
+        except ServerBusy:
+            order.append(f"shed:{tenant_of_group(group)}")
+
+    ts = [
+        threading.Thread(target=waiter, args=("heavy.g",)),
+        threading.Thread(target=waiter, args=("vip.g",)),
+    ]
+    for t in ts:
+        t.start()
+    import time
+
+    time.sleep(0.1)  # both queued against the full pool
+    held[0].__exit__(None, None, None)  # one slot frees
+    time.sleep(0.2)
+    for h in held[1:]:
+        h.__exit__(None, None, None)
+    for t in ts:
+        t.join(timeout=5)
+    # the vip waiter (active 2 / weight 4 = 0.5) beat the heavy waiter
+    # (active 1 / weight 1 = 1.0) to the freed slot
+    assert order[0] == "vip", order
+
+
+# -- protector per-tenant charges --------------------------------------------
+
+
+def test_protector_tenant_inflight_budget():
+    p = MemoryProtector(
+        limit_bytes=None,
+        max_wait_s=0.1,
+        tenant_limit_fn=lambda t: 1000 if t == "small" else 0,
+    )
+    p.acquire(800, tenant="small")
+    assert p.tenant_usage() == {"small": 800}
+    with pytest.raises(ServerBusy, match="in-flight write budget"):
+        p.acquire(300, tenant="small")
+    # another tenant is not gated by small's budget
+    p.acquire(10_000_000, tenant="big")
+    p.release(800, tenant="small")
+    p.acquire(900, tenant="small")  # freed: admits again
+    p.release(900, tenant="small")
+    p.release(10_000_000, tenant="big")
+    assert p.tenant_usage() == {}
+
+
+# -- serving-cache partitions ------------------------------------------------
+
+
+def test_cache_partitions_isolate_tenants():
+    from banyandb_tpu.storage import cache as cache_mod
+
+    cache_mod.reset_global_cache()
+    try:
+        default = cache_mod.global_cache()
+        with tenant_scope("noisy"):
+            noisy = cache_mod.global_cache()
+        with tenant_scope("quiet"):
+            quiet = cache_mod.global_cache()
+        assert default is not noisy and noisy is not quiet
+        # default tenant keeps the ORIGINAL process-global instance
+        assert default is cache_mod.global_cache()
+        quiet.get_or_load(("k",), lambda: np.zeros(8, np.int8))
+        # a churn storm in the noisy partition...
+        noisy.set_cap(4)
+        for i in range(100):
+            noisy.get_or_load(("n", i), lambda: np.zeros(8, np.int8))
+        assert noisy.stats()["evictions"] >= 96
+        # ...evicts NOTHING from the quiet tenant or the default cache
+        assert quiet.stats()["evictions"] == 0
+        hits0 = quiet.stats()["hits"]
+        quiet.get_or_load(
+            ("k",), lambda: (_ for _ in ()).throw(AssertionError)
+        )
+        assert quiet.stats()["hits"] == hits0 + 1
+        st = cache_mod.partition_stats()
+        assert set(st) == {"noisy", "quiet"}
+    finally:
+        cache_mod.reset_global_cache()
+
+
+# -- streamagg + autoreg per-tenant budgets ----------------------------------
+
+
+def _mk_engine(tmp_path, groups):
+    from banyandb_tpu.api.schema import (
+        Catalog, Entity, FieldSpec, FieldType, Group, Measure,
+        ResourceOpts, TagSpec, TagType,
+    )
+    from banyandb_tpu.api.schema import SchemaRegistry
+    from banyandb_tpu.models.measure import MeasureEngine
+
+    reg = SchemaRegistry(tmp_path / "schema")
+    for g in groups:
+        reg.create_group(Group(g, Catalog.MEASURE, ResourceOpts(shard_num=1)))
+        reg.create_measure(Measure(
+            group=g, name="m",
+            tags=(TagSpec("svc", TagType.STRING),),
+            fields=(FieldSpec("v", FieldType.FLOAT),),
+            entity=Entity(("svc",)),
+        ))
+    return MeasureEngine(reg, tmp_path / "data")
+
+
+def test_streamagg_per_tenant_signature_cap(tmp_path, monkeypatch):
+    from banyandb_tpu.qos import plane as plane_mod
+
+    eng = _mk_engine(tmp_path, ["a.g", "b.g"])
+    try:
+        monkeypatch.setattr(
+            plane_mod, "_PLANE",
+            QosPlane(enabled=True, tenants={"*": {"max_signatures": 1}}),
+        )
+        eng.streamagg.register("a.g", "m", key_tags=("svc",), fields=("v",))
+        # tenant a is at its cap: a SECOND distinct signature sheds...
+        with pytest.raises(ServerBusy, match="signature cap"):
+            eng.streamagg.register("a.g", "m", key_tags=(), fields=("v",))
+        # ...idempotent re-registration is never gated...
+        eng.streamagg.register("a.g", "m", key_tags=("svc",), fields=("v",))
+        # ...and tenant b still has its own full allowance
+        eng.streamagg.register("b.g", "m", key_tags=("svc",), fields=("v",))
+        assert plane_mod._PLANE.stats()["tenants"]["a"][
+            "streamagg_rejected"
+        ] == 1
+    finally:
+        monkeypatch.setattr(plane_mod, "_PLANE", None)
+        eng.close()
+
+
+def test_autoreg_budget_is_per_tenant(tmp_path, monkeypatch):
+    """BYDB_AUTOREG_MAX_SIGNATURES=1 means one AUTO signature PER
+    TENANT, not one per node: two tenants each get their own slot, and
+    tenant A's overflow evicts only tenant A."""
+    from banyandb_tpu.obs.recorder import SignatureStats
+    from banyandb_tpu.query.planner import AutoRegistrar
+
+    monkeypatch.setenv("BYDB_AUTOREG_MAX_SIGNATURES", "1")
+    monkeypatch.setenv("BYDB_AUTOREG_MIN_HITS", "1")
+    live: dict[tuple, dict] = {}
+
+    def register_fn(g, m, kt, f):
+        row = {
+            "group": g, "measure": m, "key_tags": list(kt),
+            "fields": list(f), "states": 1, "hits": 0,
+            "last_hit_ms": 0,
+        }
+        live[(g, m, tuple(kt), tuple(f))] = row
+        return row
+
+    def unregister_fn(g, m, kt, f):
+        return live.pop((g, m, tuple(kt), tuple(f)), None) is not None
+
+    stats = SignatureStats()
+    ar = AutoRegistrar(
+        tmp_path / "autoreg.json",
+        sig_stats=stats,
+        register_fn=register_fn,
+        unregister_fn=unregister_fn,
+        stats_fn=lambda: list(live.values()),
+    )
+    stats.observe(("a.g", "m", ("svc",), ("v",)), weight=5)
+    stats.observe(("b.g", "m", ("svc",), ("v",)), weight=5)
+    ar.tick()
+    groups = sorted(k[0] for k in live)
+    # one slot per tenant: BOTH tenants' signatures registered
+    assert groups == ["a.g", "b.g"], live
+    # a second tenant-a signature displaces only within tenant a
+    stats.observe(("a.g2", "m", ("svc",), ("v",)), weight=50)
+    live[("a.g", "m", ("svc",), ("v",))]["last_hit_ms"] = 1  # cold victim
+    ar.tick()
+    groups = sorted(k[0] for k in live)
+    assert "b.g" in groups and len([g for g in groups if g[0] == "a"]) == 1
+
+
+# -- single-tenant back-compat (parity pin) ----------------------------------
+
+
+@pytest.fixture()
+def qos_server(tmp_path):
+    """A real StandaloneServer over untenanted groups, handlers invoked
+    directly (no sockets) — the pre-PR usage shape."""
+    from banyandb_tpu.server import StandaloneServer
+
+    srv = StandaloneServer(tmp_path / "root", port=0)
+    try:
+        srv._registry_op({"op": "create", "kind": "group", "item": {
+            "name": "load", "catalog": "measure",
+            "resource_opts": {
+                "shard_num": 2, "replicas": 0,
+                "segment_interval": {"num": 1, "unit": "day"},
+                "ttl": {"num": 7, "unit": "day"}, "stages": [],
+            },
+        }})
+        srv._registry_op({"op": "create", "kind": "measure", "item": {
+            "group": "load", "name": "m",
+            "tags": [{"name": "svc", "type": "string"},
+                     {"name": "region", "type": "string"}],
+            "fields": [{"name": "v", "type": "float"}],
+            "entity": {"tag_names": ["svc"]}, "interval": "",
+            "index_mode": False,
+        }})
+        srv._registry_op({"op": "create", "kind": "topn", "item": {
+            "group": "load", "name": "top_m", "source_measure": "m",
+            "field_name": "v", "field_value_sort": "desc",
+            "group_by_tag_names": [], "counters_number": 1000,
+            "lru_size": 10, "source_group": "", "criteria": None,
+        }})
+        srv._streamagg({
+            "op": "register", "group": "load", "measure": "m",
+            "key_tags": ["svc"], "fields": ["v"], "window_millis": 1000,
+        })
+        rng = np.random.default_rng(7)
+        pts = [
+            {
+                "ts": T0 + i,
+                "tags": {"svc": f"s{int(rng.integers(0, 5))}",
+                         "region": f"r{int(rng.integers(0, 3))}"},
+                "fields": {"v": float(rng.integers(0, 100))},
+                "version": i + 1,
+            }
+            for i in range(600)
+        ]
+        srv._measure_write({"request": {
+            "group": "load", "name": "m", "points": pts,
+        }})
+        yield srv
+    finally:
+        srv.stop()
+
+
+_PARITY_SHAPES = [
+    ("agg", {"ql": "SELECT count(v) FROM MEASURE m IN load "
+                   f"TIME BETWEEN {T0} AND {T0 + 4000} GROUP BY svc"}),
+    ("raw", {"ql": "SELECT svc, region FROM MEASURE m IN load "
+                   f"TIME BETWEEN {T0} AND {T0 + 4000} LIMIT 20"}),
+    ("streamagg", {"ql": "SELECT sum(v) FROM MEASURE m IN load "
+                         f"TIME BETWEEN {T0} AND {T0 + 1000} GROUP BY svc"}),
+]
+
+
+def test_untenanted_parity_qos_on_vs_off(qos_server):
+    """Default config (QoS ON, generous limits) result JSON must be
+    byte-identical to the plane OFF across the builtin query shapes —
+    untenanted traffic is the `default` tenant with no behavior change."""
+    srv = qos_server
+    assert srv.qos.enabled  # the DEFAULT: on, generous
+    for name, env in _PARITY_SHAPES:
+        on = json.dumps(srv._ql(dict(env))["result"], sort_keys=True)
+        srv.qos.enabled = False
+        off = json.dumps(srv._ql(dict(env))["result"], sort_keys=True)
+        srv.qos.enabled = True
+        assert on == off, f"{name}: QoS on/off results differ"
+    # TopN shape (windows flush into the shared result measure first)
+    srv.measure.topn.flush_all_windows()
+    env = {"group": "load", "name": "top_m", "time_range": [T0, T0 + 4000],
+           "n": 5}
+    on = json.dumps(srv._topn(dict(env)), sort_keys=True)
+    srv.qos.enabled = False
+    off = json.dumps(srv._topn(dict(env)), sort_keys=True)
+    srv.qos.enabled = True
+    assert on == off
+    # stream shape: untenanted stream write + query round-trips
+    srv._registry_op({"op": "create_stream", "kind": "stream", "item": {
+        "group": "load", "name": "st",
+        "tags": [{"name": "svc", "type": "string"}], "entity": ["svc"],
+    }})
+    srv._stream_write({"group": "load", "name": "st", "elements": [
+        {"element_id": "e1", "ts": T0 + 1, "tags": {"svc": "a"},
+         "body": ""},
+    ]})
+    env = {"request": {"groups": ["load"], "name": "st",
+                       "time_range": [T0, T0 + 4000], "limit": 10}}
+    on = json.dumps(srv._stream_query(dict(env))["result"], sort_keys=True)
+    srv.qos.enabled = False
+    off = json.dumps(srv._stream_query(dict(env))["result"], sort_keys=True)
+    srv.qos.enabled = True
+    assert on == off
+
+
+def test_metrics_keep_series_names_only_add_tenant_label(qos_server):
+    """/metrics after QoS: every pre-QoS series keeps its name; the new
+    qos_* instruments carry a `tenant` label; the default serving-cache
+    series stay UNLABELED (partition rows would be tenant-labeled)."""
+    srv = qos_server
+    srv._ql({"ql": f"SELECT count(v) FROM MEASURE m IN load "
+                   f"TIME BETWEEN {T0} AND {T0 + 4000} GROUP BY svc"})
+    text = srv._metrics({})["prometheus"]
+    for series in (
+        "banyandb_measure_write_points_total",
+        "banyandb_serving_cache_hits",
+        "banyandb_serving_cache_misses",
+        "banyandb_write_ms_count",
+    ):
+        assert series in text, f"pre-QoS series {series} missing"
+    assert "banyandb_qos_enabled 1.0" in text
+    # untenanted traffic lands on the default tenant's labeled counters
+    assert 'banyandb_qos_query_admitted_total{tenant="default"}' in text
+    # the default serving cache's rows are NOT tenant-labeled (renames
+    # would break every dashboard reading the pre-QoS series)
+    assert "banyandb_serving_cache_hits " in text
+
+
+def test_server_sheds_abuser_and_serves_compliant(qos_server):
+    """The adversarial shape at unit scale: an over-quota tenant sheds
+    with ServerBusy while the default tenant keeps being served."""
+    srv = qos_server
+    old = srv.qos
+    try:
+        srv.qos = QosPlane(
+            enabled=True, tenants={"abuser": {"write_rate": 50}},
+        )
+        srv._registry_op({"op": "create", "kind": "group", "item": {
+            "name": "abuser.load", "catalog": "measure",
+            "resource_opts": {
+                "shard_num": 1, "replicas": 0,
+                "segment_interval": {"num": 1, "unit": "day"},
+                "ttl": {"num": 7, "unit": "day"}, "stages": [],
+            },
+        }})
+        srv._registry_op({"op": "create", "kind": "measure", "item": {
+            "group": "abuser.load", "name": "m",
+            "tags": [{"name": "svc", "type": "string"}],
+            "fields": [{"name": "v", "type": "float"}],
+            "entity": {"tag_names": ["svc"]}, "interval": "",
+            "index_mode": False,
+        }})
+
+        def burst():
+            return srv._measure_write({"request": {
+                "group": "abuser.load", "name": "m",
+                "points": [
+                    {"ts": T0 + i, "tags": {"svc": "a"},
+                     "fields": {"v": 1.0}, "version": 1}
+                    for i in range(200)
+                ],
+            }})
+
+        burst()  # eats the burst allowance
+        with pytest.raises(ServerBusy):
+            for _ in range(10):
+                burst()
+        shed = srv.qos.stats()["tenants"]["abuser"]["write_shed"]
+        assert shed >= 1
+        # compliant (default-tenant) traffic still flows
+        r = srv._ql({"ql": f"SELECT count(v) FROM MEASURE m IN load "
+                           f"TIME BETWEEN {T0} AND {T0 + 4000}"})
+        assert r["result"]["values"]
+    finally:
+        srv.qos = old
+
+
+def test_qos_topic_and_slowlog_tenant(qos_server):
+    srv = qos_server
+    reply = srv._qos({})
+    assert reply["qos"]["enabled"] is True
+    assert "tenants" in reply["qos"]
+    # slow-query records carry the tenant dimension
+    from banyandb_tpu.obs.recorder import record_slow_query
+
+    record_slow_query(
+        srv.slowlog, 0.0, engine="measure", group="acme.g", name="m",
+        duration_ms=5.0, rows=1, span_tree={},
+    )
+    assert srv.slowlog.entries(limit=1)[0]["tenant"] == "acme"
+    # access-log records stamp it too
+    srv.access_log.log_query("acme.g", "m", 1.0)
+    srv.access_log.log_write("plain", "m", 1, 1.0)

@@ -167,14 +167,21 @@ def test_entry_cap_evicts_beyond_capacity():
 
 
 def test_entry_cap_env_default(monkeypatch):
-    from banyandb_tpu.storage import cache as cache_mod
-
-    monkeypatch.setattr(cache_mod, "DEFAULT_CAP", 3)
+    """BYDB_SERVING_CACHE_CAP is read at CONSTRUCTION time (ISSUE 15
+    satellite): a post-import env change — or a late server flag — must
+    take effect on the next ServingCache() without re-importing the
+    module (the old import-time read froze the value forever)."""
+    monkeypatch.setenv("BYDB_SERVING_CACHE_CAP", "3")
     c = ServingCache(budget_bytes=1 << 30)
     assert c.cap == 3
     for i in range(6):
         c.get_or_load(("e", i), lambda: np.zeros(1, np.int8))
     assert c.stats()["entries"] == 3
+    # the knob stays live: a second post-import change is honored too
+    monkeypatch.setenv("BYDB_SERVING_CACHE_CAP", "5")
+    assert ServingCache(budget_bytes=1 << 30).cap == 5
+    # explicit max_entries still wins over the env
+    assert ServingCache(budget_bytes=1 << 30, max_entries=2).cap == 2
 
 
 def test_set_cap_live_shrinks_and_churn_reported():
