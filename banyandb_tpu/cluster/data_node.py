@@ -433,9 +433,14 @@ class DataNode:
         import time as _time
 
         t0 = _time.perf_counter()
-        n = self.stream.write(
-            env["group"], env["name"], serde.elements_from_json(env["elements"])
-        )
+        # the write runs under the stamped tenant too: the engine's
+        # cache invalidations and QoS accounting must land in the SAME
+        # partition the tenant's queries read from
+        with self._tenant_scope(env, env["group"]):
+            n = self.stream.write(
+                env["group"], env["name"],
+                serde.elements_from_json(env["elements"]),
+            )
         self._observe_write("stream", t0)
         return {"written": n}
 
@@ -443,6 +448,11 @@ class DataNode:
         import base64
 
         self._check_deadline(env)
+        # queries fence too: a scatter routed on a superseded placement
+        # map would read shards this node no longer (or not yet) owns —
+        # and the fence's adopt-if-fresher half means epoch knowledge
+        # gossips with READ traffic, not just writes
+        self._fence_epoch(env, "stream-query")
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         try:
@@ -484,10 +494,12 @@ class DataNode:
         import time as _time
 
         t0 = _time.perf_counter()
-        n = self.trace.write(
-            env["group"], env["name"], serde.spans_from_json(env["spans"]),
-            ordered_tags=tuple(env.get("ordered_tags", ())),
-        )
+        with self._tenant_scope(env, env["group"]):
+            n = self.trace.write(
+                env["group"], env["name"],
+                serde.spans_from_json(env["spans"]),
+                ordered_tags=tuple(env.get("ordered_tags", ())),
+            )
         self._observe_write("trace", t0)
         return {"written": n}
 
@@ -509,6 +521,7 @@ class DataNode:
         their ordering keys for the liaison's k-way merge."""
         from banyandb_tpu.api.model import TimeRange
 
+        self._fence_epoch(env, "trace-query-ordered")
         try:
             self.trace.get_trace(env["group"], env["name"])
         except KeyError:
@@ -543,7 +556,8 @@ class DataNode:
         self.disk.check_write()
         req = serde.write_request_from_json(env["request"])
         t0 = _time.perf_counter()
-        n = self.measure.write(req)
+        with self._tenant_scope(env, req.group):
+            n = self.measure.write(req)
         self._observe_write("measure", t0)
         return {"written": n}
 
@@ -558,7 +572,10 @@ class DataNode:
         self._fence_epoch(env, "measure-write-cols")
         self.disk.check_write()
         t0 = _time.perf_counter()
-        n = self.measure.write_columns(**serde.write_columns_env_decode(env))
+        with self._tenant_scope(env, env.get("group", "")):
+            n = self.measure.write_columns(
+                **serde.write_columns_env_decode(env)
+            )
         self._observe_write("measure", t0)
         return {"written": n}
 
@@ -651,6 +668,7 @@ class DataNode:
 
     def _on_measure_query_partial(self, env: dict) -> dict:
         self._check_deadline(env)
+        self._fence_epoch(env, "measure-query-partial")
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         hist_range = tuple(env["hist_range"]) if env.get("hist_range") else None
@@ -667,6 +685,7 @@ class DataNode:
 
     def _on_measure_query_raw(self, env: dict) -> dict:
         self._check_deadline(env)
+        self._fence_epoch(env, "measure-query-raw")
         req = serde.query_request_from_json(env["request"])
         shard_ids = set(env["shards"]) if env.get("shards") is not None else None
         tracer = self._node_tracer(req, env)
@@ -761,6 +780,13 @@ class DataNode:
                     return {"introduced": "", "duplicate": True}
                 self._installed[digest] = None
             try:
+                # disk-fault boundary (cluster/faults.py): the part
+                # materialization is the JSON sync plane's spool write —
+                # ENOSPC here must surface as a failed FinishSync the
+                # sender retries, never a half-installed part
+                from banyandb_tpu.cluster import faults as _faults
+
+                _faults.check_disk("sync-part-finish")
                 for fname, buf in files.items():
                     fs.atomic_write(state["dir"] / fname, buf)
                 # catalog from the part's own metadata (parts carry their
@@ -907,6 +933,12 @@ class DataNode:
             # first install is still running
             self._installed[digest] = None
         try:
+            # disk-fault boundary: staging is where a chunk-synced part
+            # first touches disk; an injected ENOSPC releases the
+            # digest claim below so the sender's re-ship can install
+            from banyandb_tpu.cluster import faults as _faults
+
+            _faults.check_disk("sync-install")
             staged = self.root / ".sync-staging" / _uuid.uuid4().hex
             staged.mkdir(parents=True, exist_ok=True)
             for fname, blob in files.items():

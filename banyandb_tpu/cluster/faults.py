@@ -418,7 +418,9 @@ def get_plane() -> Optional[FaultPlane]:
     if not _INIT:
         with _GLOBAL_LOCK:
             if not _INIT:
-                spec = os.environ.get("BYDB_FAULTS", "").strip()
+                from banyandb_tpu.utils.envflag import env_str
+
+                spec = env_str("BYDB_FAULTS").strip()
                 _PLANE = FaultPlane(spec) if spec else None
                 _ACTIVE = _PLANE is not None
                 _INIT = True

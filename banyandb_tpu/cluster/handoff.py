@@ -127,6 +127,11 @@ class HandoffController:
                     continue
                 rest.append(ln)
             if rest:
+                # same disk-fault boundary as spool(): an ENOSPC on the
+                # rewrite raises with the spool file intact — delivered
+                # entries replay again, and every handler on this plane
+                # is an idempotent repair, so over-delivery is safe
+                faults.check_disk("handoff-spool")
                 self._spool_path(node).write_text("\n".join(rest) + "\n")
             else:
                 self._spool_path(node).unlink(missing_ok=True)

@@ -771,7 +771,12 @@ class Liaison:
                     first_rejection = first_rejection or e
                     continue  # never spooled: the copy is fenced
                 failed[name] = env  # spooled below (shed AND dead alike)
-                if kind == "shed":
+                if kind in ("shed", "deadline"):
+                    # a shedding OR deadline-rejecting node is healthy
+                    # (rpc.py contract): its budget ran out, the node
+                    # did not.  Spool the copy and surface the retryable
+                    # rejection — marking it dead would evict a healthy
+                    # replica over the sender's own clock.
                     rejected_names.add(name)
                     first_rejection = first_rejection or e
                 else:
@@ -953,6 +958,19 @@ class Liaison:
                 kind = getattr(e, "kind", "error")
                 if kind in ("shed", "deadline"):
                     guard.mark(node.name, kind)
+                    return
+                if kind == "stale_epoch":
+                    # the node fenced this leg: WE route on a superseded
+                    # placement map.  Adopt the fresh map and hand the
+                    # shards to the failover walk, which re-places them
+                    # on the new map's owners — the fencing node is
+                    # healthy and must never be evicted for our
+                    # staleness.
+                    self._reload_placement()
+                    if retry is not None:
+                        retry.append((node, list(shards)))
+                    else:
+                        guard.mark(node.name, kind)
                     return
                 self._mark_dead(node.name)
                 if retry is not None:

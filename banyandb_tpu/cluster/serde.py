@@ -353,11 +353,10 @@ def partials_to_json(p: Partials) -> dict:
     nodes, or set BYDB_PARTIALS_FRAME_V1=1 on not-yet-upgraded-peer
     senders to emit the legacy shape during the transition.
     """
-    import os
-
     from banyandb_tpu.utils import encoding as enc
+    from banyandb_tpu.utils.envflag import env_flag
 
-    if os.environ.get("BYDB_PARTIALS_FRAME_V1") == "1":
+    if env_flag("BYDB_PARTIALS_FRAME_V1"):
         return _partials_to_json_v1(p)
 
     fields = sorted(p.sums.keys())

@@ -395,8 +395,10 @@ class WorkerPool:
         # their own (worker_main passes local_flush=False), so the
         # supervisor flushes on the single-process loop's default
         # interval — same crash-loss window, journal trimmed in step
+        from banyandb_tpu.utils.envflag import env_float
+
         self._flush_interval_s = max(
-            float(os.environ.get("BYDB_WORKER_FLUSH_S", "1.0") or 1.0), 0.05
+            env_float("BYDB_WORKER_FLUSH_S", 1.0), 0.05
         )
         # supervisor-thread-only; seeded with now so the first periodic
         # flush waits a full interval (monotonic() is not epoch-0-based)
@@ -1254,6 +1256,9 @@ def _write_wm(path: Optional[Path], seq: int) -> None:
     streams/traces only because the watermark is trustworthy."""
     if path is None:
         return
+    # disk-fault boundary: ENOSPC raises before the tmp write, so the
+    # rename never runs and the OLD watermark stays authoritative
+    faults.check_disk("worker-watermark")
     tmp = path.with_suffix(".tmp")
     tmp.write_text(str(seq))
     os.replace(tmp, path)

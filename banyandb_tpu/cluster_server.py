@@ -208,9 +208,9 @@ class LiaisonServer:
 
         self.rebalancer = Rebalancer(self.liaison)
         self.repairer = ReplicaRepairer(self.liaison)
-        from banyandb_tpu.utils.envflag import env_float as _env_float
+        from banyandb_tpu.utils.envflag import env_float
 
-        self.repair_interval_s = _env_float("BYDB_REPAIR_INTERVAL_S", 30.0)
+        self.repair_interval_s = env_float("BYDB_REPAIR_INTERVAL_S", 30.0)
         self._repair_thread: threading.Thread | None = None
         # schema plane: EVERY create/update on this liaison's registry —
         # whatever surface it arrived on (bus topic, proto wire, HTTP
@@ -396,6 +396,19 @@ class LiaisonServer:
                     fields=tuple(env.get("fields", ())),
                     window_millis=env.get("window_millis"),
                     max_windows=env.get("max_windows"),
+                )
+            }
+        if op == "unregister":
+            # the autoreg eviction path reaches the liaison role too:
+            # drop the broadcast registration AND the remembered copy so
+            # probe() stops re-sending it to rejoining nodes
+            return {
+                "acks": self.liaison.unregister_streamagg(
+                    env["group"],
+                    env["measure"],
+                    key_tags=tuple(env.get("key_tags", ())),
+                    fields=tuple(env.get("fields", ())),
+                    window_millis=env.get("window_millis"),
                 )
             }
         if op == "stats":

@@ -19,6 +19,8 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Optional
 
+from banyandb_tpu.utils.envflag import env_str
+
 
 @dataclass(frozen=True)
 class Flag:
@@ -81,7 +83,7 @@ class Config:
         ns = ap.parse_args(argv)
 
         file_vals: dict = {}
-        cfg_path = getattr(ns, "config", None) or os.environ.get("BYDB_CONFIG")
+        cfg_path = getattr(ns, "config", None) or env_str("BYDB_CONFIG")
         if cfg_path:
             file_vals = json.loads(Path(cfg_path).read_text())
 

@@ -28,6 +28,12 @@ Four analyzers, all surfaced through ``python -m banyandb_tpu.lint``
                       of representative plan shapes: dtype promotion,
                       shape mismatch and retrace hazards, zero device
                       execution
+- ``wire-*``          the bdwire wire-contract family (lint/wire):
+                      role/topic exhaustiveness, wire-kind taxonomy,
+                      envelope producer/consumer matching, fault-site
+                      coverage, retryable handling, BYDB_* flag registry
+                      and the obs contract (wire_config.py is the
+                      checked-in policy)
 - ``kernel-*``        the bdjit kernel audit family (lint/kernel):
                       jaxpr walk, stub-device dispatch/transfer counts,
                       CPU lowering facts, and the ratcheted
@@ -49,6 +55,7 @@ from banyandb_tpu.lint.core import Finding, parse_suppressions
 # modules, not in per-file rule objects.  The kernel-audit family
 # (lint/kernel, "bdjit") rides the same surface.
 from banyandb_tpu.lint.kernel import KERNEL_RULES
+from banyandb_tpu.lint.wire import WIRE_RULES
 
 WP_RULES = (
     ("layering", "import respects the SURVEY L0-L6 layer map"),
@@ -57,7 +64,7 @@ WP_RULES = (
     ("lock-order", "potential deadlock cycle in the lock-order graph"),
     ("wp-shared-state", "attribute written from >=2 thread roots unguarded"),
     ("plan-audit", "eval_shape plan matrix: dtype/shape/retrace hazards"),
-) + KERNEL_RULES
+) + WIRE_RULES + KERNEL_RULES
 
 
 def apply_suppressions(
@@ -96,6 +103,7 @@ FAMILIES = {
     "lock-order": ("lock-order",),
     "shared-state": ("wp-shared-state",),
     "plan-audit": ("plan-audit",),
+    "wire": tuple(name for name, _ in WIRE_RULES),
     "kernel": (
         "kernel-jaxpr",
         "kernel-dispatch",
@@ -136,7 +144,9 @@ def run_whole_program(
 
     findings: list[Finding] = []
     stats = {"wp_functions": 0, "wp_roots": 0}
-    need_program = any(want(f) for f in ("sync", "lock-order", "shared-state"))
+    need_program = any(
+        want(f) for f in ("sync", "lock-order", "shared-state", "wire")
+    )
     trees = (
         parse_package(pkg_root, layer_config.PACKAGE)
         if need_program or want("layering")
@@ -190,6 +200,12 @@ def run_whole_program(
                 ),
                 roots=roots,
             )
+        if want("wire"):
+            from banyandb_tpu.lint.wire import run_wire
+
+            wire_findings, wire_stats = run_wire(program, trees, pkg_root)
+            findings += wire_findings
+            stats.update(wire_stats)
     if plan_audit and want("plan-audit"):
         from banyandb_tpu.lint.whole_program.plan_audit import run_plan_audit
 

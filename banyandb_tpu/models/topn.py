@@ -162,16 +162,9 @@ _VERSION_ROWS_CAP_DEFAULT = 1 << 18
 def _version_rows_cap() -> int:
     """Per-window bound on the (series, ts) -> last-version tracking
     table (version-merge exactness, see _Window.rows).  0 disables."""
-    import os
+    from banyandb_tpu.utils.envflag import env_int
 
-    try:
-        return int(
-            os.environ.get(
-                "BYDB_TOPN_VERSION_ROWS", _VERSION_ROWS_CAP_DEFAULT
-            )
-        )
-    except ValueError:
-        return _VERSION_ROWS_CAP_DEFAULT
+    return env_int("BYDB_TOPN_VERSION_ROWS", _VERSION_ROWS_CAP_DEFAULT)
 
 
 @dataclass

@@ -38,7 +38,7 @@ from typing import Optional
 
 from banyandb_tpu.obs.metrics import global_meter
 from banyandb_tpu.qos.tenancy import tenant_of_group
-from banyandb_tpu.utils.envflag import env_flag, env_float, env_int
+from banyandb_tpu.utils.envflag import env_flag, env_float, env_int, env_str
 
 
 def _server_busy(msg: str):
@@ -129,7 +129,7 @@ class QosPlane:
         )
         if tenants is None:
             tenants = {}
-            raw = os.environ.get("BYDB_QOS_TENANTS", "").strip()
+            raw = env_str("BYDB_QOS_TENANTS").strip()
             if raw:
                 try:
                     tenants = json.loads(raw)

@@ -26,7 +26,9 @@ _current: contextvars.ContextVar[str] = contextvars.ContextVar(
 
 
 def tenant_separator() -> str:
-    return os.environ.get("BYDB_QOS_TENANT_SEP", ".") or "."
+    from banyandb_tpu.utils.envflag import env_str
+
+    return env_str("BYDB_QOS_TENANT_SEP", ".") or "."
 
 
 def tenant_of_group(group: str) -> str:

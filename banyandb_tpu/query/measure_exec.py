@@ -43,6 +43,7 @@ from banyandb_tpu.api.schema import Measure, TagType
 from banyandb_tpu.obs import metrics as obs_metrics
 from banyandb_tpu.storage.part import ColumnData
 from banyandb_tpu.utils import hostops
+from banyandb_tpu.utils.envflag import env_int
 
 # stage latency instruments (always on, spans or not): the attribution
 # plane ROADMAP item 1's bench reads back as stage_breakdown.  Handles
@@ -62,7 +63,7 @@ CHUNK = 8192
 # dispatch + [G]-sized host accumulation dominate at small chunks (profiled
 # ~330ms of a 372ms warm 100k-group scan at 8192).  Power-of-two buckets up
 # to SCAN_CHUNK keep the compiled-shape set finite.
-SCAN_CHUNK = int(os.environ.get("BYDB_SCAN_CHUNK", 1 << 20))
+SCAN_CHUNK = env_int("BYDB_SCAN_CHUNK", 1 << 20)
 _NUM_HIST_BUCKETS = 512
 
 
@@ -370,9 +371,7 @@ def _build_rank_lut(values: list) -> np.ndarray:
     return lut
 
 
-_MAX_PERSISTENT_GROUPS = int(
-    os.environ.get("BYDB_MAX_PERSISTENT_GROUPS", 1 << 18)
-)
+_MAX_PERSISTENT_GROUPS = env_int("BYDB_MAX_PERSISTENT_GROUPS", 1 << 18)
 
 
 def _tag_value_bytes(v) -> bytes:

@@ -1104,14 +1104,15 @@ def main(argv=None) -> None:
     # an armed fault plane must be impossible to miss in a server log
     # (docs/robustness.md): chaos harnesses set it on purpose, a stray
     # env var in production must not inject faults silently
-    import os as _os
+    from banyandb_tpu.utils.envflag import env_str
 
-    if _os.environ.get("BYDB_FAULTS", "").strip():
+    _faults_spec = env_str("BYDB_FAULTS").strip()
+    if _faults_spec:
         import sys as _sys
 
         print(
             f"warning: fault injection ARMED via BYDB_FAULTS="
-            f"{_os.environ['BYDB_FAULTS']!r}",
+            f"{_faults_spec!r}",
             file=_sys.stderr,
             flush=True,
         )

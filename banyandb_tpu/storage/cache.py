@@ -26,7 +26,7 @@ import numpy as np
 from banyandb_tpu.qos import tenancy
 from banyandb_tpu.utils.envflag import env_int
 
-DEFAULT_BUDGET = int(os.environ.get("BYDB_SERVING_CACHE_BYTES", 256 << 20))
+DEFAULT_BUDGET = env_int("BYDB_SERVING_CACHE_BYTES", 256 << 20)
 
 
 def default_cap() -> int:
@@ -158,7 +158,7 @@ _global = ServingCache()
 # since resident chunks save both decode AND host->device transfer).
 # max_entries=0: the serving-cache ENTRY cap (BYDB_SERVING_CACHE_CAP) is
 # a host-cache knob and must not silently bound HBM residency too.
-DEVICE_BUDGET = int(os.environ.get("BYDB_DEVICE_CACHE_BYTES", 1 << 30))
+DEVICE_BUDGET = env_int("BYDB_DEVICE_CACHE_BYTES", 1 << 30)
 _device = ServingCache(DEVICE_BUDGET, max_entries=0)
 
 # Per-tenant serving-cache partitions (docs/robustness.md "Multi-tenant

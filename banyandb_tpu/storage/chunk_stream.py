@@ -30,7 +30,7 @@ import queue
 import threading
 from typing import Callable, Iterator, Sequence
 
-from banyandb_tpu.utils.envflag import env_flag
+from banyandb_tpu.utils.envflag import env_flag, env_int
 
 
 def pipeline_enabled() -> bool:
@@ -39,10 +39,7 @@ def pipeline_enabled() -> bool:
 
 
 def default_depth() -> int:
-    try:
-        return max(1, int(os.environ.get("BYDB_PREFETCH_DEPTH", "2")))
-    except ValueError:
-        return 2
+    return max(1, env_int("BYDB_PREFETCH_DEPTH", 2))
 
 
 class PrefetchIterator:

@@ -26,6 +26,8 @@ from __future__ import annotations
 import os
 import threading
 
+from banyandb_tpu.utils.envflag import env_str
+
 _DISABLE_VALUES = ("0", "off", "no", "none", "false", "disabled")
 
 _lock = threading.Lock()
@@ -90,8 +92,8 @@ def enable(default_dir=None) -> str | None:
     Returns the active directory, or None when disabled (env set to an
     off-value, or no directory resolvable).  Idempotent; later calls
     with a different directory keep the first wiring."""
-    env = os.environ.get("BYDB_COMPILE_CACHE_DIR")
-    if env is not None and env.strip().lower() in _DISABLE_VALUES:
+    env = env_str("BYDB_COMPILE_CACHE_DIR")
+    if env and env.strip().lower() in _DISABLE_VALUES:
         return None
     target = env or (str(default_dir) if default_dir else None)
     if not target:

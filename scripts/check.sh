@@ -32,6 +32,12 @@ echo "== kernel smoke (bdjit) =="
 # audit itself just ran inside bdlint --check above — no double work
 env JAX_PLATFORMS=cpu python scripts/kernel_smoke.py --no-audit || fail=1
 
+echo "== wire smoke (bdwire) =="
+# role/topic matrix == golden, every wire analyzer fires on its seeded
+# violation (docs/linting.md "Wire-contract audit").  --no-audit: the
+# real-tree wire audit just ran inside bdlint --check above
+env JAX_PLATFORMS=cpu python scripts/wire_smoke.py --no-audit || fail=1
+
 echo "== cold-path smoke =="
 # tiny store: pipelined == serial byte-identical, precompile registry
 # populated + persisted, compile cache active (docs/performance.md)

@@ -1,0 +1,195 @@
+"""Fast CPU-only wire-contract smoke (scripts/check.sh, both modes + CI).
+
+Proves, in a few seconds with zero cluster processes, the bdwire
+invariants (docs/linting.md "Wire-contract audit"):
+
+1. the live role/topic matrix discovered from the tree equals the
+   checked-in golden `EXPECTED_MATRIX` — the wire surface cannot grow
+   or shrink without a reviewed diff (printed as the golden table);
+2. seeded-violation self-test: every one of the seven analyzers FIRES
+   on a tiny synthetic package carrying exactly its violation — the
+   audit is not vacuous (a refactor that silently blinds an analyzer
+   fails here, not in a post-incident review);
+3. (unless --no-audit) the full bdwire family over the real tree is
+   ZERO findings — every exemption in wire_config.py carries a reviewed
+   reason and none is stale.
+
+`scripts/check.sh` passes --no-audit because its `bdlint --check` gate
+just ran the same family; steps 1-2 are this smoke's unique checks.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable as `python scripts/wire_smoke.py` from the repo root or CI
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# one synthetic package, one violation per analyzer (mirrors the
+# fixtures in tests/test_wire_audit.py)
+_SEED = {
+    "__init__.py": "",
+    "bus.py": "TOPIC_PING = 'ping'\nTOPIC_PONG = 'pong'\n",
+    "server.py": (
+        "from mypkg.bus import TOPIC_PING\n"
+        "class Server:\n"
+        "    def _register(self):\n"
+        "        self.bus.subscribe(TOPIC_PING, self._on_ping)\n"
+        "    def _on_ping(self, env):\n"
+        "        return {}\n"
+    ),
+    "client.py": (
+        "import os\n"
+        "from mypkg.bus import TOPIC_PONG\n"
+        "from mypkg.rpc import TransportError\n"
+        "RAW = os.environ.get('BYDB_RAW')\n"
+        "class Client:\n"
+        "    def go(self):\n"
+        "        try:\n"
+        "            self.transport.call('a', TOPIC_PONG, {})\n"
+        "        except TransportError:\n"
+        "            pass\n"
+    ),
+    "rpc.py": (
+        "class TransportError(Exception):\n"
+        "    def __init__(self, msg, kind='error'):\n"
+        "        self.kind = kind\n"
+        "class Transport:\n"
+        "    def call(self, addr, topic, env):\n"
+        "        raise TransportError('busy', kind='sched')\n"
+    ),
+    "liaison.py": (
+        "class Liaison:\n"
+        "    def send(self):\n"
+        "        return {'rows': 1, 'epoch': 2}\n"
+    ),
+    "node.py": (
+        "class Node:\n"
+        "    def on_write(self, env, meter):\n"
+        "        meter.counter_add('rogue_metric', 1, {'a': 1})\n"
+        "        return env['rows']\n"
+    ),
+}
+
+
+def _self_test() -> None:
+    from banyandb_tpu.lint.whole_program.callgraph import Program
+    from banyandb_tpu.lint.whole_program.layers import parse_package
+    from banyandb_tpu.lint.wire.envelopes import analyze_envelopes
+    from banyandb_tpu.lint.wire.envregistry import analyze_envflags
+    from banyandb_tpu.lint.wire.fault_sites import analyze_fault_sites
+    from banyandb_tpu.lint.wire.kinds import analyze_kinds
+    from banyandb_tpu.lint.wire.obs_contract import analyze_obs
+    from banyandb_tpu.lint.wire.retryable import analyze_retryable
+    from banyandb_tpu.lint.wire.topics import analyze_topics
+
+    with tempfile.TemporaryDirectory() as td:
+        root = Path(td) / "mypkg"
+        root.mkdir()
+        for rel, src in _SEED.items():
+            (root / rel).write_text(src)
+        trees = parse_package(root, "mypkg")
+        program = Program.build(root, "mypkg", trees=trees)
+        fired = set()
+        for f in analyze_topics(
+            program, trees,
+            roles={"server": ("mypkg.server:Server._register",)},
+            client_targets={"mypkg.client": ("server",)},
+            exemptions={}, expected_matrix={"server": ("ping",)},
+        ):
+            fired.add(f.rule)
+        for f in analyze_kinds(
+            program, declared=("error", "shed"),
+            retryable=frozenset({"shed"}),
+            error_classes=("TransportError",),
+            transport_kinds={}, classifier_switches={},
+        ):
+            fired.add(f.rule)
+        for f in analyze_envelopes(program, groups={"write": {
+            "producers": ("mypkg.liaison:Liaison.send",),
+            "consumers": ("mypkg.node:Node.on_write",),
+            "accepted_write_only": {}, "accepted_silent_default": {},
+        }}):
+            fired.add(f.rule)
+        for f in analyze_fault_sites(
+            program, transport_exempt={}, disk_prefixes=("mypkg.",),
+            disk_exempt={}, sync_modules=(),
+        ):
+            fired.add(f.rule)
+        for f in analyze_retryable(
+            program, error_classes=("TransportError",),
+            substrings=("spool",), exempt={},
+        ):
+            fired.add(f.rule)
+        for f in analyze_envflags(
+            trees, None, envflag_module="mypkg.envflag",
+            envflag_funcs=("env_flag",), prefix="BYDB_",
+            flags_doc="flags.md",
+        ):
+            fired.add(f.rule)
+        for f in analyze_obs(trees, None, contract={}, obs_doc="obs.md"):
+            fired.add(f.rule)
+    want = {
+        "wire-topic", "wire-kind", "wire-envelope", "wire-fault",
+        "wire-retry", "wire-envflag", "wire-obs",
+    }
+    assert fired >= want, f"analyzers silent on seeded violations: {want - fired}"
+    print(f"# self-test: all {len(want)} analyzers fire on seeded violations")
+
+
+def main(run_audit: bool = True) -> int:
+    import banyandb_tpu
+    from banyandb_tpu.lint.whole_program.callgraph import Program
+    from banyandb_tpu.lint.whole_program.layers import parse_package
+    from banyandb_tpu.lint.wire import run_wire, wire_config
+    from banyandb_tpu.lint.wire.topics import role_topic_matrix
+
+    pkg = Path(banyandb_tpu.__file__).parent
+    trees = parse_package(pkg, "banyandb_tpu")
+    program = Program.build(pkg, "banyandb_tpu", trees=trees)
+
+    # -- 1: live matrix == golden matrix -----------------------------------
+    live = {
+        role: tuple(sorted(t))
+        for role, t in role_topic_matrix(program, trees).items()
+    }
+    golden = {
+        r: tuple(sorted(t)) for r, t in wire_config.EXPECTED_MATRIX.items()
+    }
+    assert live == golden, (
+        "role/topic matrix drifted from wire_config.EXPECTED_MATRIX:\n"
+        f"  live:   {live}\n  golden: {golden}"
+    )
+    print("# role/topic matrix (golden, wire_config.EXPECTED_MATRIX):")
+    for role in sorted(live):
+        print(f"#   {role:<12} {len(live[role]):>2} topics: "
+              + " ".join(live[role]))
+
+    # -- 2: every analyzer fires on its seeded violation -------------------
+    _self_test()
+
+    # -- 3: the real tree audits to zero -----------------------------------
+    # (--no-audit skips this half when the caller just ran the same
+    # family through `python -m banyandb_tpu.lint --check`)
+    if run_audit:
+        findings, stats = run_wire(program, trees, pkg_root=pkg)
+        assert findings == [], "wire findings:\n" + "\n".join(
+            f.render() for f in findings
+        )
+        print(
+            f"# bdwire: 0 findings over {stats['wire_topics']} topics / "
+            f"{stats['wire_kind_sites']} kind sites"
+        )
+    print("wire_smoke: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(run_audit="--no-audit" not in sys.argv[1:]))
