@@ -751,46 +751,18 @@ class WireServices:
 
     # -- TraceService ------------------------------------------------------
     def trace_query(self, req, context):
-        """trace/v1 Query: trace_id equality fetches that trace's spans
-        (the span-store lookup; broader criteria land with the sidx
-        order-by surface)."""
+        """trace/v1 Query: the full surface — general AND criteria
+        (bloom/zone pruned), tag projection, sidx order-by with
+        limit+offset pushed into the walk.  Plan selection lives in
+        models.trace.classify_plan; this handler only converts wire
+        shapes."""
         try:
             if self.trace is None:
                 raise ValueError("trace engine not wired")
-            group = self._one_group(req)
-            crit = (
-                wire.criteria_to_internal(req.criteria)
-                if req.HasField("criteria")
-                else None
-            )
-            from banyandb_tpu.query.measure_exec import _lower_criteria
-
-            leaves, expr = _lower_criteria(crit)
-            if expr:
-                raise ValueError("trace queries take AND criteria only")
-            t_schema = self.registry.get_trace(group, req.name)
-            tid_conds = [
-                c
-                for c in leaves
-                if c.name == t_schema.trace_id_tag and c.op == "eq"
-            ]
-            if not tid_conds:
-                raise ValueError(
-                    f"trace query needs {t_schema.trace_id_tag} = <id>"
-                )
-            spans = self.trace.query_by_trace_id(
-                group, req.name, str(tid_conds[0].value)
-            )
-            out = pb.trace_query_pb2.QueryResponse()
-            if spans:
-                tr = out.traces.add()
-                tr.trace_id = str(tid_conds[0].value)
-                proj = set(req.tag_projection)
-                for s in spans[: int(req.limit) or 100]:
-                    wire.fill_trace_span_pb(
-                        tr.spans.add(), s, t_schema, proj
-                    )
-            return out
+            self._one_group(req)  # validates single-group addressing
+            ireq = wire.trace_query_to_internal(req)
+            res = self.trace.query(ireq)
+            return self._trace_result_to_pb(ireq, res)
         except Exception as e:  # noqa: BLE001
             _abort(context, e)
 

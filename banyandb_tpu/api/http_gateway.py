@@ -22,6 +22,8 @@ Routes (base path /api as upstream):
     GET  /api/healthz
     GET  /metrics                      Prometheus exposition (obs plane)
     GET  /api/v1/slowlog?limit=N       slow-query flight recorder
+    GET  /api/v1/trace/search?group=&name=&where=&order_by=&desc=&limit=&offset=
+                                       trace search via BydbQL
 """
 
 from __future__ import annotations
@@ -202,6 +204,58 @@ class HttpGateway:
                         200,
                         {"entries": gateway.slowlog.entries(limit=limit)},
                     )
+                if self.path.split("?")[0] == "/api/v1/trace/search":
+                    # search params compose into one BydbQL trace query
+                    # through the same builder cli.py uses (lazy import:
+                    # the server package is fully loaded at request time)
+                    if not self._check_auth():
+                        return
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from banyandb_tpu.cli import trace_search_ql
+
+                    q = parse_qs(urlsplit(self.path).query)
+
+                    def one(k, d=""):
+                        return q.get(k, [d])[0]
+
+                    if not one("group") or not one("name"):
+                        return self._send(
+                            400, {"error": "group and name params required"}
+                        )
+                    try:
+                        limit = int(one("limit", "20"))
+                        offset = int(one("offset", "0"))
+                    except ValueError:
+                        return self._send(
+                            400, {"error": "limit/offset must be integers"}
+                        )
+                    ql = trace_search_ql(
+                        one("group"), one("name"),
+                        tags=one("tags", "*"),
+                        where=q.get("where", []),
+                        order_by=one("order_by"),
+                        desc=one("desc").lower() in ("1", "true", "yes", "on"),
+                        limit=limit, offset=offset,
+                        from_ms=int(one("from_ms")) if one("from_ms") else None,
+                        to_ms=int(one("to_ms")) if one("to_ms") else None,
+                    )
+                    try:
+                        req = pb.bydbql_query_pb2.QueryRequest(query=ql)
+                        resp = gateway.services.bydbql_query(
+                            req, _HTTPContext()
+                        )
+                        return self._send(
+                            200,
+                            json_format.MessageToDict(
+                                resp, preserving_proto_field_name=True
+                            ),
+                        )
+                    except _GatewayAbort as e:
+                        return self._send(
+                            _GRPC_TO_HTTP.get(e.code.name, 500),
+                            {"error": e.details},
+                        )
                 if self.path in ("/", "/console"):
                     page = gateway._console_page
                     if page is None:

@@ -607,6 +607,38 @@ def element_value_from_pb(stream: "isch.Stream", wreq):
     )
 
 
+def trace_query_to_internal(req) -> im.QueryRequest:
+    """trace/v1 QueryRequest -> internal: the full surface (criteria,
+    flat tag projection, sidx order-by with limit+offset) — the plan
+    split happens in models.trace.classify_plan, not here."""
+    order_by_tag = ""
+    order_by_dir = "asc"
+    if req.HasField("order_by"):
+        if req.order_by.index_rule_name not in ("", "timestamp"):
+            order_by_tag = req.order_by.index_rule_name
+            order_by_dir = _SORT.get(req.order_by.sort, "asc")
+    return im.QueryRequest(
+        groups=tuple(req.groups),
+        name=req.name,
+        time_range=im.TimeRange(
+            ts_to_millis(req.time_range.begin),
+            ts_to_millis(req.time_range.end),
+        )
+        if req.HasField("time_range")
+        else im.TimeRange(0, 1 << 62),
+        criteria=criteria_to_internal(req.criteria)
+        if req.HasField("criteria")
+        else None,
+        tag_projection=tuple(req.tag_projection),
+        limit=int(req.limit),  # 0 -> per-plan engine default
+        offset=int(req.offset),
+        order_by_tag=order_by_tag,
+        order_by_dir=order_by_dir,
+        trace=req.trace,
+        stages=tuple(req.stages),
+    )
+
+
 def fill_trace_span_pb(sp, span: dict, t_schema=None, proj=()):
     """Fill one trace/v1 Span message from an engine span dict; tags
     outside `proj` (when non-empty) are dropped, tag types resolve from

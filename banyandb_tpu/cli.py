@@ -95,6 +95,50 @@ def render_explain(reply: dict) -> str:
     return "\n".join(lines)
 
 
+def trace_search_ql(
+    group: str,
+    name: str,
+    *,
+    tags: str = "*",
+    where=(),
+    order_by: str = "",
+    desc: bool = False,
+    limit: int = 20,
+    offset: int = 0,
+    from_ms=None,
+    to_ms=None,
+) -> str:
+    """Compose one BydbQL trace query from CLI/gateway search fields —
+    shared by `cli.py trace search` and `GET /api/v1/trace/search` so
+    the two front doors cannot drift.  [from_ms, to_ms) is half-open,
+    matching the engine's TimeRange."""
+    parts = [f"SELECT {tags} FROM TRACE {name} IN {group}"]
+    if from_ms is not None:
+        parts.append(f"TIME >= {int(from_ms)}")
+        if to_ms is not None:
+            parts.append(f"AND TIME < {int(to_ms)}")
+    elif to_ms is not None:
+        parts.append(f"TIME < {int(to_ms)}")
+    conds = [w for w in where if w and w.strip()]
+    if conds:
+        parts.append("WHERE " + " AND ".join(conds))
+    if order_by:
+        parts.append(f"ORDER BY {order_by} {'DESC' if desc else 'ASC'}")
+    parts.append(f"LIMIT {int(limit)}")
+    if offset:
+        parts.append(f"OFFSET {int(offset)}")
+    return " ".join(parts)
+
+
+# the pre-canned slowlog --from-db query: slowest self-traced queries
+# first (duration_us is the sidx ordering key — docs/observability.md
+# "Self-trace")
+SELF_QUERY_QL = (
+    "SELECT * FROM TRACE self_query IN _monitoring "
+    "ORDER BY duration_us DESC LIMIT {limit}"
+)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("bydbctl (banyandb-tpu)")
     ap.add_argument("--addr", default="127.0.0.1:17912")
@@ -174,6 +218,12 @@ def main(argv=None) -> int:
     sl.add_argument(
         "--clear", action="store_true", help="drain the ring buffer"
     )
+    sl.add_argument(
+        "--from-db", action="store_true",
+        help="read the persistent self-trace rows from "
+        "_monitoring.self_query instead of the in-memory ring "
+        "(BYDB_SELF_TRACE; docs/observability.md 'Self-trace')",
+    )
 
     sub.add_parser("metrics", help="Prometheus exposition text")
 
@@ -189,6 +239,39 @@ def main(argv=None) -> int:
     tg.add_argument("group")
     tg.add_argument("name")
     tg.add_argument("trace_id")
+
+    ts = sub.add_parser(
+        "trace",
+        help="trace query surface: search composes criteria, tag "
+        "projection and a sidx ORDER BY into one BydbQL request "
+        "(served by standalone and liaison roles)",
+    )
+    ts.add_argument("action", choices=["search"])
+    ts.add_argument("--group", required=True)
+    ts.add_argument("--name", required=True)
+    ts.add_argument(
+        "--where", action="append", default=[],
+        help="one condition, e.g. \"svc = 'a'\" or \"dur > 100\" "
+        "(repeatable; ANDed)",
+    )
+    ts.add_argument(
+        "--tags", default="*", help="comma-separated tag projection"
+    )
+    ts.add_argument(
+        "--order-by", default="",
+        help="sidx-indexed INT tag to order traces by",
+    )
+    ts.add_argument("--desc", action="store_true")
+    ts.add_argument("--limit", type=int, default=20)
+    ts.add_argument("--offset", type=int, default=0)
+    ts.add_argument(
+        "--from-ms", type=int, default=None,
+        help="epoch-ms lower bound (inclusive)",
+    )
+    ts.add_argument(
+        "--to-ms", type=int, default=None,
+        help="epoch-ms upper bound (exclusive)",
+    )
 
     pr = sub.add_parser("property")
     pr.add_argument("action", choices=["apply", "get", "query"])
@@ -317,16 +400,29 @@ def main(argv=None) -> int:
             env["replicas"] = args.replicas
         print(json.dumps(_call(args, "rebalance", env), indent=1))
     elif args.cmd == "slowlog":
-        env = {"limit": args.limit}
-        if args.clear:
-            env["clear"] = True
-        print(json.dumps(_call(args, TOPIC_SLOWLOG, env), indent=1))
+        if args.from_db:
+            ql = SELF_QUERY_QL.format(limit=args.limit)
+            print(json.dumps(_call(args, TOPIC_QL, {"ql": ql}), indent=1))
+        else:
+            env = {"limit": args.limit}
+            if args.clear:
+                env["clear"] = True
+            print(json.dumps(_call(args, TOPIC_SLOWLOG, env), indent=1))
     elif args.cmd == "metrics":
         print(_call(args, TOPIC_METRICS, {})["prometheus"], end="")
     elif args.cmd == "qos":
         from banyandb_tpu.server import TOPIC_QOS
 
         print(json.dumps(_call(args, TOPIC_QOS, {}), indent=1))
+    elif args.cmd == "trace":
+        ql = trace_search_ql(
+            args.group, args.name,
+            tags=args.tags, where=args.where,
+            order_by=args.order_by, desc=args.desc,
+            limit=args.limit, offset=args.offset,
+            from_ms=args.from_ms, to_ms=args.to_ms,
+        )
+        print(json.dumps(_call(args, TOPIC_QL, {"ql": ql}), indent=1))
     elif args.cmd == "trace-get":
         print(json.dumps(_call(args, Topic.TRACE_QUERY_BY_ID.value, {
             "group": args.group, "name": args.name, "trace_id": args.trace_id,

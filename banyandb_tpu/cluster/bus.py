@@ -29,6 +29,9 @@ class Topic(str, enum.Enum):
     STREAM_QUERY = "stream-query"
     TRACE_QUERY_BY_ID = "trace-query-by-id"
     TRACE_QUERY_ORDERED = "trace-query-ordered"
+    # full trace query surface: criteria/projection/order-by QueryRequest
+    # scattered per shard set, span rows + sidx keys back
+    TRACE_QUERY_EXEC = "trace-query-exec"
     PROPERTY_QUERY = "property-query"
     # schema + control plane
     SCHEMA_SYNC = "schema-sync"

@@ -118,6 +118,7 @@ class DataNode:
         self.bus.subscribe(Topic.TRACE_WRITE, self._on_trace_write)
         self.bus.subscribe(Topic.TRACE_QUERY_BY_ID, self._on_trace_query)
         self.bus.subscribe(Topic.TRACE_QUERY_ORDERED, self._on_trace_query_ordered)
+        self.bus.subscribe(Topic.TRACE_QUERY_EXEC, self._on_trace_query_exec)
         self.bus.subscribe(
             Topic.HEALTH,
             lambda env: {
@@ -535,6 +536,39 @@ class DataNode:
             with_keys=True,
         )
         return {"results": [[int(k), tid] for k, tid in keyed]}
+
+    def _on_trace_query_exec(self, env: dict) -> dict:
+        """Full trace query surface map phase: the complete QueryRequest
+        (criteria/projection/order-by/limit+offset) runs against owned
+        shards; span rows carry their sidx keys so the liaison's partial
+        merge preserves sidx order across nodes."""
+        import base64
+
+        self._check_deadline(env)
+        self._fence_epoch(env, "trace-query-exec")
+        req = serde.query_request_from_json(env["request"])
+        shard_ids = set(env["shards"]) if env.get("shards") is not None else None
+        try:
+            # forgiving only for the schema lookup (see _on_stream_query)
+            self.trace.get_trace(req.groups[0], req.name)
+        except KeyError:
+            return {"data_points": []}
+        tracer = self._node_tracer(req, env)
+        with self._tenant_scope(env, req.groups[0] if req.groups else ""):
+            res = self.trace.query(req, shard_ids=shard_ids, tracer=tracer)
+        out = {
+            "data_points": [
+                {
+                    **dp,
+                    "tags": serde.tags_to_json(dp["tags"]),
+                    "span": base64.b64encode(dp["span"]).decode(),
+                }
+                for dp in res.data_points
+            ]
+        }
+        if tracer is not None:
+            out["trace"] = tracer.finish()
+        return out
 
     # -- write plane --------------------------------------------------------
     @staticmethod

@@ -1490,6 +1490,122 @@ class Liaison:
                 break
         return out
 
+    def query_trace(self, req: QueryRequest, tracer=None) -> QueryResult:
+        """Full trace query surface, distributed (TraceService.Query
+        analog): the complete QueryRequest scatters to shard owners over
+        TRACE_QUERY_EXEC under the query guard (deadline budget,
+        exhaustive failover, degraded markers); per-node span rows merge
+        at the liaison — sidx (key, trace_id) partial merge on ordered
+        plans, deterministic (ts, trace_id, span) order otherwise — with
+        global limit+offset applied post-merge (each node pre-trims to
+        offset+limit).  Trace-id plans scatter only to the ids' hash-
+        shard owners; a trace lives wholly on one shard."""
+        import base64
+
+        from banyandb_tpu.models.trace import (
+            _DEFAULT_LIMITS,
+            _row_order,
+            classify_plan,
+            trace_shard_id,
+        )
+
+        own_tracer = tracer is None and req.trace
+        if own_tracer:
+            tracer = Tracer("liaison:trace")
+        t = tracer if tracer is not None else NOOP_TRACER
+        group = req.groups[0]
+        tid_tag = self.registry.get_trace(group, req.name).trace_id_tag
+        kind, tids, _lo, _hi, _residual = classify_plan(req, tid_tag)
+        off = max(req.offset or 0, 0)
+        limit = req.limit or _DEFAULT_LIMITS[kind]
+        guard = _QueryGuard(self.query_budget_s)
+        assignment = self._shard_assignment(group, req.stages, guard=guard)
+        if kind == "by_id":
+            shard_num = self.registry.get_group(group).resource_opts.shard_num
+            owned = {trace_shard_id(tid, shard_num) for tid in tids}
+            assignment = {
+                node: kept
+                for node, shards in assignment.items()
+                if (kept := [s for s in shards if s in owned])
+            }
+        # one batch per scatter leg: the ordered merge dedups replica /
+        # failover double-reports by trace id, first batch wins
+        batches: list[list[dict]] = []
+        node_req = dataclasses.replace(req, offset=0, limit=off + limit)
+        req_json = serde.query_request_to_json(node_req)
+
+        def env_of(shards):
+            return self._stamp_tenant(
+                {"request": req_json, "shards": shards}, group
+            )
+
+        def on_reply(node, shards, r, sp):
+            sp.tag("rows", len(r["data_points"]))
+            # decode back to the native engine contract here: the merge
+            # keys compare raw span bytes, not base64 text
+            batch = []
+            for dp in r["data_points"]:
+                dp = dict(dp)
+                dp["span"] = base64.b64decode(dp.get("span", ""))
+                dp["tags"] = serde.tags_from_json(dp["tags"])
+                batch.append(dp)
+            batches.append(batch)
+
+        if assignment:
+            self._scatter(
+                Topic.TRACE_QUERY_EXEC.value,
+                assignment, env_of, guard, tracer, on_reply,
+                failover=self._failover_ok(group, req.stages),
+            )
+        res = QueryResult()
+        with t.span("merge") as ms:
+            if kind == "ordered":
+                res.data_points = _merge_ordered_trace_rows(
+                    batches, asc=(req.order_by_dir != "desc"),
+                    offset=off, limit=limit,
+                )
+            else:
+                rows = [dp for batch in batches for dp in batch]
+                rows.sort(key=_row_order)
+                res.data_points = rows[off : off + limit]
+            ms.tag("rows", len(res.data_points))
+        self._finish_degraded(res, guard, tracer, "trace")
+        if own_tracer and req.trace:
+            res.trace = dict(res.trace or {})
+            res.trace["span_tree"] = tracer.finish()
+        return res
+
+
+def _merge_ordered_trace_rows(
+    batches: list[list[dict]], *, asc: bool, offset: int, limit: int
+) -> list[dict]:
+    """sidx-ordered partial merge: group each leg's span rows per trace
+    (every row carries its trace's sidx key), order traces globally by
+    (key, id) with the walk's direction and tie-break, dedup replica
+    overlap by trace id (first leg wins), then page on TRACES — the same
+    limit/offset unit as the standalone sidx walk."""
+    groups: dict[str, tuple[int, list[dict]]] = {}
+    for batch in batches:
+        batch_tids: set[str] = set()
+        for dp in batch:
+            tid = dp.get("trace_id", "")
+            if tid in groups and tid not in batch_tids:
+                continue  # replica double-report: an earlier leg won
+            batch_tids.add(tid)
+            ent = groups.get(tid)
+            if ent is None:
+                ent = (int(dp.get("key", 0)), [])
+                groups[tid] = ent
+            ent[1].append(dp)
+    traces = sorted(
+        groups.items(),
+        key=lambda kv: ((kv[1][0] if asc else -kv[1][0]), kv[0]),
+    )
+    out: list[dict] = []
+    for _tid, (_k, spans) in traces[offset : offset + limit]:
+        out.extend(spans)
+    return out
+
 
 class ChunkedSyncClient:
     """Ship a sealed part to a data node (pub/chunked_sync.go analog):

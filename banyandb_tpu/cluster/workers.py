@@ -783,6 +783,9 @@ class WorkerPool:
     def query_trace_ordered(self, *a, **kw):
         return self.liaison.query_trace_ordered(*a, **kw)
 
+    def query_trace(self, req, tracer=None):
+        return self.liaison.query_trace(req, tracer=tracer)
+
     def topn(self, env: dict) -> dict:
         """Scatter the node-local TopN ranking to every worker and
         re-rank the union — entities are shard-routed, so per-worker
@@ -1193,6 +1196,9 @@ class PoolTraceAdapter:
         return self._pool.query_trace_ordered(
             group, name, order_tag, time_range, **kw
         )
+
+    def query(self, req, *, shard_ids=None, tracer=None):
+        return self._pool.query_trace(req, tracer=tracer)
 
     def write(self, group: str, name: str, spans, *, ordered_tags=()) -> int:
         import base64

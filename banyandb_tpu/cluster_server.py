@@ -124,6 +124,12 @@ class _LiaisonTraceAdapter:
         self._l = liaison
         self._reg = registry
 
+    def get_trace(self, group: str, name: str):
+        return self._reg.get_trace(group, name)
+
+    def query(self, req, *, shard_ids=None, tracer=None):
+        return self._l.query_trace(req, tracer=tracer)
+
     def query_by_trace_id(self, group: str, name: str, trace_id: str):
         return self._l.query_trace_by_id(group, name, trace_id)
 
@@ -221,6 +227,14 @@ class LiaisonServer:
         self.bus = LocalBus()
         self._register()
         self.grpc = GrpcBusServer(self.bus, port=port)
+        # engine-shaped trace facade: QL execution, the proto wire and
+        # the self-trace sink all share it
+        self._trace_adapter = _LiaisonTraceAdapter(self.liaison, self.registry)
+        from banyandb_tpu.obs.selftrace import SelfTraceSink
+
+        self.self_trace = SelfTraceSink(
+            self._trace_adapter, self.registry, node="liaison"
+        )
         self.wire = None
         self.http = None
         if wire_port is not None or http_port is not None:
@@ -230,7 +244,7 @@ class LiaisonServer:
                 self.registry,
                 _LiaisonMeasureAdapter(self.liaison),
                 _LiaisonStreamAdapter(self.liaison, self.registry),
-                trace_engine=_LiaisonTraceAdapter(self.liaison, self.registry),
+                trace_engine=self._trace_adapter,
                 node_info={"name": "liaison", "roles": ("liaison",)},
                 cluster_view_fn=self._cluster_view,
             )
@@ -538,10 +552,16 @@ class LiaisonServer:
                 res = self.liaison.query_measure(req, tracer=tracer)
             elif catalog == "stream":
                 res = self.liaison.query_stream(req, tracer=tracer)
+            elif catalog == "trace":
+                from banyandb_tpu.query import ql_exec
+
+                res = ql_exec.execute_trace_ql(
+                    self._trace_adapter, req, tracer=tracer
+                )
             else:
                 raise ValueError(
-                    f"liaison QL serves measure/stream catalogs; {catalog} "
-                    "queries use the dedicated topics"
+                    f"liaison QL serves measure/stream/trace catalogs; "
+                    f"{catalog} queries use the dedicated topics"
                 )
             ms = (_time.perf_counter() - t0) * 1000
         tree = tracer.finish()
@@ -556,6 +576,16 @@ class LiaisonServer:
                 return logical.analyze_measure_distributed(
                     m, req, sorted(self.liaison.alive)
                 ).explain()
+            if catalog == "trace":
+                from banyandb_tpu.models.trace import classify_plan
+
+                t = self.registry.get_trace(req.groups[0], req.name)
+                kind = classify_plan(req, t.trace_id_tag)[0]
+                return (
+                    f"trace plan={kind} "
+                    f"order_by={req.order_by_tag or '-'} "
+                    f"limit={req.limit} offset={req.offset}"
+                )
             s = self.registry.get_stream(req.groups[0], req.name)
             return logical.analyze_stream(s, req).explain()
 
@@ -573,6 +603,17 @@ class LiaisonServer:
             plan=(res.trace or {}).get("plan"),
             plan_fn=render_plan,
             tenant=adm.tenant,
+        )
+        # dogfood loop: slow/sampled span trees become trace rows in
+        # _monitoring.self_query via the cluster's own trace write path
+        self.self_trace.offer(
+            engine=catalog,
+            group=req.groups[0] if req.groups else "",
+            name=req.name,
+            duration_ms=ms,
+            tree=tree,
+            tenant=adm.tenant,
+            ql=env["ql"],
         )
         attach_tree(res, req, tree)
         return {"result": result_to_json(res)}
@@ -626,9 +667,11 @@ class LiaisonServer:
                 target=self._repair_loop, name="bydb-repair", daemon=True
             )
             self._repair_thread.start()
+        self.self_trace.start()
         return self
 
     def stop(self) -> None:
+        self.self_trace.stop()
         self._stop.set()
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10)

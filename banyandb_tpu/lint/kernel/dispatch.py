@@ -468,12 +468,21 @@ def _ql_scenarios():
     from banyandb_tpu.query import ql_exec
 
     def run_trace():
-        eng = SimpleNamespace(
-            get_trace=lambda g, n: SimpleNamespace(trace_id_tag="trace_id"),
-            query_by_trace_id=lambda g, n, t: [
-                {"tags": {"svc": "a", "trace_id": t}}
-            ],
-        )
+        from banyandb_tpu.api.model import QueryResult
+
+        def q(req, tracer=None):
+            res = QueryResult()
+            res.data_points = [
+                {
+                    "trace_id": "t-1",
+                    "timestamp": T0,
+                    "tags": {"svc": "a", "trace_id": "t-1"},
+                    "span": b"",
+                }
+            ]
+            return res
+
+        eng = SimpleNamespace(query=q)
         req = QueryRequest(
             ("g",),
             "t",

@@ -103,6 +103,7 @@ EXPECTED_MATRIX: dict[str, tuple[str, ...]] = {
         "sync-part",
         "topn",
         "trace-query-by-id",
+        "trace-query-exec",
         "trace-query-ordered",
         "trace-write",
     ),
@@ -127,6 +128,7 @@ EXPECTED_MATRIX: dict[str, tuple[str, ...]] = {
         "sync-part",
         "topn",
         "trace-query-by-id",
+        "trace-query-exec",
         "trace-query-ordered",
         "trace-write",
         "worker-ctl",
@@ -248,6 +250,7 @@ ENVELOPE_GROUPS: dict[str, dict] = {
             "banyandb_tpu.cluster.data_node:DataNode._on_measure_query_partial",
             "banyandb_tpu.cluster.data_node:DataNode._on_measure_query_raw",
             "banyandb_tpu.cluster.data_node:DataNode._on_stream_query",
+            "banyandb_tpu.cluster.data_node:DataNode._on_trace_query_exec",
             "banyandb_tpu.cluster.data_node:DataNode._on_trace_query_ordered",
         ),
         "accepted_write_only": {},
@@ -415,6 +418,8 @@ OBS_CONTRACT: dict[str, frozenset | None] = {
     "rebalance_shards_to_move": frozenset(),
     "repair_parts_shipped": frozenset(),
     "rss_bytes": frozenset(),
+    "selftrace_dropped": frozenset(),
+    "selftrace_spans": frozenset(),
     "stale_epoch_rejected": frozenset({"site"}),
     "streamagg_invalidated": frozenset(),
     "streamagg_late_dropped": frozenset(),

@@ -37,6 +37,56 @@ _H_QUERY_TRACE = obs_metrics.global_meter().histogram(
     "query_ms", {"engine": "trace"}
 )
 
+# per-plan default row/trace limits when the request carries none
+# (by_id/scan count span rows; ordered counts traces, the sidx key unit)
+_DEFAULT_LIMITS = {"by_id": 100, "ordered": 20, "scan": 100}
+
+
+def classify_plan(req: QueryRequest, tid_tag: str) -> tuple:
+    """Lower a trace QueryRequest onto one of the three read plans.
+
+    -> (kind, tids, lo, hi, residual) where kind is ``by_id`` (trace-id
+    eq/IN criteria: bloom-gated span-store lookups), ``ordered``
+    (order_by_tag set: sidx TYPE_TREE walk with key bounds lo/hi), or
+    ``scan`` (criteria-only: zone-map-planned part scan).  AND criteria
+    only — OR trees raise rather than silently flatten.  Multiple
+    trace-id conditions INTERSECT (AND semantics); an empty intersection
+    is an empty by_id plan, not an error.  The liaison shares this
+    lowering so node and gather halves can never disagree on the plan.
+    """
+    from banyandb_tpu.query.measure_exec import _lower_criteria
+
+    leaves, expr = _lower_criteria(req.criteria)
+    if expr:
+        raise ValueError("OR criteria not supported for trace queries")
+    id_sets: list[set[str]] = []
+    residual = []
+    for c in leaves:
+        if c.name == tid_tag and c.op == "eq":
+            id_sets.append({str(c.value)})
+        elif c.name == tid_tag and c.op == "in":
+            id_sets.append({str(v) for v in c.value})
+        else:
+            residual.append(c)
+    if id_sets:
+        return "by_id", sorted(set.intersection(*id_sets)), None, None, residual
+    if req.order_by_tag:
+        lo = hi = None
+        rest = []
+        for c in residual:
+            if c.name == req.order_by_tag and c.op in ("gt", "ge", "lt", "le"):
+                # duplicate bounds INTERSECT (AND semantics)
+                if c.op in ("gt", "ge"):
+                    b = int(c.value) + (1 if c.op == "gt" else 0)
+                    lo = b if lo is None else max(lo, b)
+                else:
+                    b = int(c.value) - (1 if c.op == "lt" else 0)
+                    hi = b if hi is None else min(hi, b)
+            else:
+                rest.append(c)
+        return "ordered", None, lo, hi, rest
+    return "scan", None, None, None, residual
+
 
 # Trace schema objects live in the registry (persisted + SCHEMA_SYNC'd
 # like measures); re-exported here for engine-local convenience.
@@ -282,6 +332,7 @@ class TraceEngine:
         shard_idx = trace_shard_id(trace_id, shard_num)
         tid = trace_id.encode()
         out: list[dict] = []
+        self.last_bloom_blocks_skipped = 0
         for seg in db.segments:
             shard = seg.shards[shard_idx]
             # live memtable + in-flight flush snapshot (flush encodes
@@ -294,6 +345,12 @@ class TraceEngine:
                 if bloom_path.exists():
                     bloom = Bloom.from_bytes(bloom_path.read_bytes())
                     if tid not in bloom:
+                        n = len(part.blocks)
+                        self.last_bloom_blocks_skipped += n
+                        obs_metrics.global_meter().counter_add(
+                            "blocks_skipped", float(n),
+                            labels={"reason": "bloom"},
+                        )
                         continue
                 sources.append(
                     part.read(
@@ -325,11 +382,19 @@ class TraceEngine:
         hi: Optional[int] = None,
         asc: bool = False,
         limit: int = 20,
+        offset: int = 0,
         verify_live: bool = True,
         with_keys: bool = False,
+        accept=None,
+        shard_pred=None,
     ) -> list:
         """Trace ids ordered by an indexed numeric tag (sidx TYPE_TREE
         retrieval: e.g. slowest traces in a window).
+
+        limit AND offset both count accepted traces and are consumed
+        inside the walk — offset skips the first `offset` survivors
+        without ever fetching their spans into the result, so page N
+        costs one walk, not N fetches.
 
         with_keys=True returns [(key, trace_id)] instead of bare ids —
         the distributed path needs the ordering keys to k-way merge
@@ -338,13 +403,18 @@ class TraceEngine:
         verify_live drops ids whose spans were since removed by the
         sampler pipeline (the ordered index is ingest-time and is not
         rewritten by merge gating); cost is one span lookup per
-        candidate, bounded by `limit`.
+        candidate, bounded by `limit + offset`.  `accept` generalizes it:
+        a callable(trace_id) -> bool deciding survival (residual criteria
+        checks ride the same span fetch).  `shard_pred(trace_id)` drops
+        candidates routed to shards this node does not own — the sidx is
+        per-segment, not per-shard.
         """
         t_q0 = time.perf_counter()
         try:
             return self._query_ordered(
                 group, name, order_tag, time_range, lo=lo, hi=hi, asc=asc,
-                limit=limit, verify_live=verify_live, with_keys=with_keys,
+                limit=limit, offset=offset, verify_live=verify_live,
+                with_keys=with_keys, accept=accept, shard_pred=shard_pred,
             )
         finally:
             _H_QUERY_TRACE.observe((time.perf_counter() - t_q0) * 1000)
@@ -360,22 +430,25 @@ class TraceEngine:
         hi: Optional[int] = None,
         asc: bool = False,
         limit: int = 20,
+        offset: int = 0,
         verify_live: bool = True,
         with_keys: bool = False,
+        accept=None,
+        shard_pred=None,
     ) -> list:
         import heapq
 
         db = self._tsdb(group)
         # One key-ordered stream per overlapping segment, heap-merged so
         # the global order holds across segment boundaries.  Per-segment
-        # fetch starts at 4x limit (headroom for duplicates / dead
-        # candidates) and grows adaptively: if fewer than `limit` live
-        # ids survive while some segment's stream was truncated at its
-        # cap, the fetch quadruples and the scan repeats — heavy
+        # fetch starts at 4x (limit+offset) (headroom for duplicates /
+        # dead candidates) and grows adaptively: if fewer than `limit`
+        # live ids survive while some segment's stream was truncated at
+        # its cap, the fetch quadruples and the scan repeats — heavy
         # tail-sampling kill rates never starve the result below what
         # actually exists.  sidx block pruning keeps reads key-relevant.
         segs = db.select_segments(time_range.begin_millis, time_range.end_millis)
-        fetch = max(limit, 1) * 4
+        fetch = max(limit + max(offset, 0), 1) * 4
         while True:
             self.last_sidx_blocks_read = 0
             streams = []
@@ -386,26 +459,212 @@ class TraceEngine:
                 truncated = truncated or len(chunk) >= fetch
                 streams.append(iter(chunk))
                 self.last_sidx_blocks_read += st.last_blocks_read
+            # tid tie-break keeps equal keys deterministic across
+            # repeated walks, topologies and replica merges
             merged = heapq.merge(
-                *streams, key=lambda kp: kp[0] if asc else -kp[0]
+                *streams,
+                key=lambda kp: (
+                    kp[0] if asc else -kp[0],
+                    sidx_decode_ref(kp[1])[0],
+                ),
             )
-            seen: list[str] = []
+            seen: set[str] = set()
+            out: list[str] = []
             keyed: list[tuple[int, str]] = []
+            skip = 0
             for _k, payload in merged:
                 tid, ts = sidx_decode_ref(payload)
                 if not (time_range.begin_millis <= ts < time_range.end_millis):
                     continue
+                if shard_pred is not None and not shard_pred(tid):
+                    continue
                 if tid in seen:
                     continue
-                if verify_live and not self.query_by_trace_id(group, name, tid):
+                if accept is not None:
+                    if not accept(tid):
+                        continue
+                elif verify_live and not self.query_by_trace_id(
+                    group, name, tid
+                ):
                     continue
-                seen.append(tid)
+                seen.add(tid)
+                if skip < offset:
+                    skip += 1
+                    continue
+                out.append(tid)
                 keyed.append((int(_k), tid))
-                if len(seen) >= limit:
-                    return keyed if with_keys else seen
+                if len(out) >= limit:
+                    return keyed if with_keys else out
             if not truncated:
-                return keyed if with_keys else seen
+                return keyed if with_keys else out
             fetch *= 4
+
+    # -- unified span-level query surface ----------------------------------
+    def query(self, req: QueryRequest, *, shard_ids=None, tracer=None) -> QueryResult:
+        """Full trace read surface: general AND tag criteria (eq/ne/in/
+        not_in, numeric ranges), tag projection, sidx order-by asc/desc
+        with limit+offset consumed inside the walk.  Plans split three
+        ways (classify_plan): trace-id criteria go through the bloom-
+        gated span store, order_by_tag through the sidx tree, and
+        criteria-only scans prune blocks on per-part zone maps before
+        any read.  `shard_ids` restricts to owned shards (distributed
+        data nodes); rows are {trace_id, timestamp, tags, span[, key]}.
+        """
+        t_q0 = time.perf_counter()
+        try:
+            return self._query(req, shard_ids=shard_ids, tracer=tracer)
+        finally:
+            _H_QUERY_TRACE.observe((time.perf_counter() - t_q0) * 1000)
+
+    def _query(self, req: QueryRequest, *, shard_ids=None, tracer=None) -> QueryResult:
+        from banyandb_tpu.obs.tracer import NOOP_TRACER
+        from banyandb_tpu.query.ql_exec import span_matches
+
+        tr = tracer if tracer is not None else NOOP_TRACER
+        group = req.groups[0]
+        t = self.get_trace(group, req.name)
+        tid_tag = t.trace_id_tag
+        kind, tids, lo, hi, residual = classify_plan(req, tid_tag)
+        off = max(req.offset or 0, 0)
+        limit = req.limit or _DEFAULT_LIMITS[kind]
+        proj = tuple(req.tag_projection or ())
+        rng = req.time_range
+        shard_num = self.registry.get_group(group).resource_opts.shard_num
+        owned = set(shard_ids) if shard_ids is not None else None
+
+        def in_range(ts: int) -> bool:
+            return rng.begin_millis <= ts < rng.end_millis
+
+        def shape(tid: str, span: dict, key=None) -> dict:
+            tags = span["tags"]
+            if proj:
+                tags = {k: v for k, v in tags.items() if k in proj}
+            row = {
+                "trace_id": tid,
+                "timestamp": span["timestamp"],
+                "tags": tags,
+                "span": span.get("span", b""),
+            }
+            if key is not None:
+                row["key"] = int(key)
+            return row
+
+        res = QueryResult()
+        if kind == "by_id":
+            rows: list[dict] = []
+            skipped = 0
+            with tr.span("bloom") as bs:
+                for tid in tids:
+                    if owned is not None and (
+                        trace_shard_id(tid, shard_num) not in owned
+                    ):
+                        continue
+                    for s in self._query_by_trace_id(group, req.name, tid):
+                        if not in_range(s["timestamp"]):
+                            continue
+                        if residual and not span_matches(s, residual):
+                            continue
+                        rows.append(shape(tid, s))
+                    skipped += self.last_bloom_blocks_skipped
+                bs.tag("traces", len(tids))
+                bs.tag("blocks_skipped", skipped)
+            with tr.span("merge") as ms:
+                rows.sort(key=_row_order)
+                rows = rows[off : off + limit]
+                ms.tag("rows", len(rows))
+            res.data_points = rows
+            return res
+
+        if kind == "ordered":
+            spans_cache: dict[str, list[dict]] = {}
+
+            def accept(tid: str) -> bool:
+                spans = [
+                    s
+                    for s in self._query_by_trace_id(group, req.name, tid)
+                    if in_range(s["timestamp"])
+                    and (not residual or span_matches(s, residual))
+                ]
+                if spans:
+                    spans_cache[tid] = spans
+                return bool(spans)
+
+            shard_pred = None
+            if owned is not None:
+                shard_pred = (
+                    lambda tid: trace_shard_id(tid, shard_num) in owned
+                )
+            with tr.span("sidx") as ss:
+                keyed = self._query_ordered(
+                    group, req.name, req.order_by_tag, rng,
+                    lo=lo, hi=hi, asc=(req.order_by_dir != "desc"),
+                    limit=limit, offset=off, with_keys=True,
+                    accept=accept, shard_pred=shard_pred,
+                )
+                ss.tag("traces", len(keyed))
+                ss.tag("blocks_read", self.last_sidx_blocks_read)
+            rows = []
+            with tr.span("part_gather") as ps:
+                for k, tid in keyed:
+                    for s in spans_cache[tid]:
+                        rows.append(shape(tid, s, key=k))
+                ps.tag("rows", len(rows))
+            res.data_points = rows
+            return res
+
+        # criteria-only scan: zone-map planning before any block read
+        rows = []
+        blocks_read = 0
+        zone_conds, range_conds = _scan_pruners(t, residual)
+        from banyandb_tpu.storage.encoded import zone_skip_enabled
+
+        use_zones = zone_skip_enabled()
+        db = self._tsdb(group)
+        with tr.span("part_gather") as ps:
+            for seg in db.select_segments(rng.begin_millis, rng.end_millis):
+                for shard_idx, shard in enumerate(seg.shards):
+                    if owned is not None and shard_idx not in owned:
+                        continue
+                    sources = list(shard.hot_columns(req.name))
+                    for part in shard.parts:
+                        if part.meta.get("trace") != req.name:
+                            continue
+                        preds = None
+                        if use_zones:
+                            preds = _part_scan_preds(
+                                part, zone_conds, range_conds
+                            )
+                        bids = part.select_blocks(
+                            rng.begin_millis, rng.end_millis,
+                            zone_preds=preds,
+                        )
+                        if not len(bids):
+                            continue
+                        blocks_read += len(bids)
+                        sources.append(
+                            part.read(
+                                bids,
+                                tags=part.meta["tags"],
+                                want_payload=True,
+                            )
+                        )
+                    for src in sources:
+                        for i in range(len(src.ts)):
+                            if not in_range(int(src.ts[i])):
+                                continue
+                            s = self._row_to_span(t, src, i)
+                            if residual and not span_matches(s, residual):
+                                continue
+                            tid = str(s["tags"].get(tid_tag, ""))
+                            rows.append(shape(tid, s))
+            ps.tag("rows", len(rows))
+            ps.tag("blocks_read", blocks_read)
+        with tr.span("merge") as ms:
+            rows.sort(key=_row_order)
+            rows = rows[off : off + limit]
+            ms.tag("rows", len(rows))
+        res.data_points = rows
+        return res
 
     def _row_to_span(self, t: Trace, src: ColumnData, i: int) -> dict:
         from banyandb_tpu.query import filter as qfilter
@@ -419,3 +678,89 @@ class TraceEngine:
             "tags": tags,
             "span": src.payloads[i] if src.payloads else b"",
         }
+
+
+def _row_order(row: dict) -> tuple:
+    """Deterministic scan/by-id row order: (ts, trace_id, payload) — the
+    liaison merge re-sorts with the same key so topologies agree byte-
+    for-byte even on equal timestamps."""
+    return (row["timestamp"], row["trace_id"], row["span"])
+
+
+def _scan_pruners(t: Trace, residual: list) -> tuple[list, dict]:
+    """Split residual AND leaves into zone-map prunable shapes:
+    (eq/IN byte-value conds, {int_tag: [range conds]}).  Anything not
+    prunable stays residual-only — pruning is best-effort, filtering is
+    authoritative."""
+    zone_conds: list[tuple[str, list[bytes]]] = []
+    range_conds: dict[str, list] = {}
+    for c in residual:
+        try:
+            tag_type = t.tag(c.name).type
+        except KeyError:
+            continue
+        if c.op == "eq":
+            try:
+                zone_conds.append((c.name, [measure_exec._tag_value_bytes(c.value)]))
+            except TypeError:
+                pass
+        elif c.op == "in":
+            try:
+                zone_conds.append(
+                    (c.name, [measure_exec._tag_value_bytes(v) for v in c.value])
+                )
+            except TypeError:
+                pass
+        elif c.op in ("gt", "ge", "lt", "le") and tag_type is TagType.INT:
+            try:
+                float(c.value)
+            except (TypeError, ValueError):
+                continue
+            range_conds.setdefault(c.name, []).append(c)
+    return zone_conds, range_conds
+
+
+def _range_ok(v: float, conds: list) -> bool:
+    for c in conds:
+        b = float(c.value)
+        if c.op == "gt" and not v > b:
+            return False
+        if c.op == "ge" and not v >= b:
+            return False
+        if c.op == "lt" and not v < b:
+            return False
+        if c.op == "le" and not v <= b:
+            return False
+    return True
+
+
+def _part_scan_preds(part, zone_conds, range_conds) -> Optional[list]:
+    """Allowed-code zone predicates for one part: eq/IN conds via the
+    shared planner lowering, plus INT range conds decoded against the
+    part's tag dictionary (absent raw = unset = 0, matching
+    decode_tag_value).  A tag whose dictionary has no surviving code
+    collapses to the none-match sentinel — the whole part prunes without
+    reading a block."""
+    from banyandb_tpu.query.planner import part_zone_preds
+
+    preds = list(part_zone_preds(part, zone_conds)) if zone_conds else []
+    part_tags = part.meta.get("tags", ())
+    for name, conds in range_conds.items():
+        if name not in part_tags:
+            # no column: every row decodes to 0; prune only if 0 fails
+            if not _range_ok(0.0, conds):
+                preds.append(("*", np.zeros(0, dtype=np.int64)))
+            continue
+        codes = [
+            i
+            for i, raw in enumerate(part.dict_for(name))
+            if _range_ok(
+                float(int.from_bytes(raw, "little", signed=True)) if raw else 0.0,
+                conds,
+            )
+        ]
+        if not codes:
+            preds.append(("*", np.zeros(0, dtype=np.int64)))
+        else:
+            preds.append((f"tag_{name}", np.asarray(sorted(codes), dtype=np.int64)))
+    return preds or None

@@ -11,7 +11,7 @@ id/tag filter splitting).
 
 from __future__ import annotations
 
-from banyandb_tpu.api.model import QueryRequest, QueryResult, TimeRange
+from banyandb_tpu.api.model import QueryRequest, QueryResult
 
 
 def and_leaves(req: QueryRequest):
@@ -26,7 +26,7 @@ def and_leaves(req: QueryRequest):
     return leaves
 
 
-def _span_matches(span: dict, conds) -> bool:
+def span_matches(span: dict, conds) -> bool:
     for c in conds:
         v = span.get("tags", {}).get(c.name)
         if c.op == "eq":
@@ -61,76 +61,18 @@ def _span_matches(span: dict, conds) -> bool:
     return True
 
 
-def execute_trace_ql(trace_engine, req: QueryRequest) -> QueryResult:
-    """Trace QL execution: trace-id equality (the schema's trace_id_tag,
-    not a hardcoded name) fetches spans; otherwise an ORDER BY <numeric
-    tag> query rides the ordered (sidx) index with range bounds from
-    conditions on that tag.  Residual tag conditions post-filter spans
-    (never silently ignored); a SELECT projection narrows span tags."""
-    res = QueryResult()
-    leaves = and_leaves(req)
-    group = req.groups[0]
-    tid_tag = trace_engine.get_trace(group, req.name).trace_id_tag or "trace_id"
-    proj = set(req.tag_projection or ())
-
-    def shape(span: dict, tid: str) -> dict:
-        tags = span.get("tags", {})
-        if proj:
-            tags = {k: v for k, v in tags.items() if k in proj}
-        out = {"trace_id": tid, "tags": tags}
-        if "span" in span:
-            out["span"] = span["span"]
-        return out
-
-    tid_conds = [c for c in leaves if c.name == tid_tag and c.op == "eq"]
-    if tid_conds:
-        tid = str(tid_conds[0].value)
-        residual = [c for c in leaves if c is not tid_conds[0]]
-        spans = trace_engine.query_by_trace_id(group, req.name, tid)
-        res.data_points = [
-            shape(s, tid) for s in spans if _span_matches(s, residual)
-        ][: req.limit or 100]
-        return res
-    if req.order_by_tag:
-        lo = hi = None
-        residual = []
-        for c in leaves:
-            if c.name == req.order_by_tag and c.op in ("gt", "ge", "lt", "le"):
-                # duplicate bounds INTERSECT (AND semantics)
-                if c.op in ("gt", "ge"):
-                    b = int(c.value) + (1 if c.op == "gt" else 0)
-                    lo = b if lo is None else max(lo, b)
-                else:
-                    b = int(c.value) - (1 if c.op == "lt" else 0)
-                    hi = b if hi is None else min(hi, b)
-            else:
-                residual.append(c)
-        tr = TimeRange(req.time_range.begin_millis, req.time_range.end_millis)
-        ids = trace_engine.query_ordered(
-            group,
-            req.name,
-            req.order_by_tag,
-            tr,
-            lo=lo,
-            hi=hi,
-            asc=(req.order_by_dir == "asc"),
-            # over-fetch when residual filters will drop candidates
-            limit=(req.limit or 20) * (4 if residual else 1),
-        )
-        if residual:
-            kept = []
-            for tid in ids:
-                spans = trace_engine.query_by_trace_id(group, req.name, tid)
-                if any(_span_matches(s, residual) for s in spans):
-                    kept.append(tid)
-                if len(kept) >= (req.limit or 20):
-                    break
-            ids = kept
-        res.data_points = [{"trace_id": t} for t in ids[: req.limit or 20]]
-        return res
-    raise ValueError(
-        f"trace QL needs WHERE {tid_tag} = '...' or ORDER BY <numeric tag>"
-    )
+def execute_trace_ql(trace_engine, req: QueryRequest, tracer=None) -> QueryResult:
+    """Trace QL execution over the unified engine surface
+    (TraceEngine.query and its cluster facades): general AND criteria
+    (eq/ne/in/not_in, numeric ranges), SELECT projection, ORDER BY
+    <numeric tag> asc/desc with LIMIT+OFFSET pushed into the sidx walk.
+    OR trees and unknown ops are rejected up front so every engine —
+    standalone, worker pool, liaison — refuses them identically instead
+    of half-scattering."""
+    for c in and_leaves(req):
+        if c.op not in ("eq", "ne", "in", "not_in", "gt", "ge", "lt", "le"):
+            raise ValueError(f"trace QL op {c.op!r} not supported")
+    return trace_engine.query(req, tracer=tracer)
 
 
 def execute_property_ql(property_engine, req: QueryRequest) -> QueryResult:

@@ -181,6 +181,17 @@ class StandaloneServer:
             self.meter,
             self._pool_measure if self.pool is not None else self.measure,
         )
+        # dogfood loop (docs/observability.md "Self-trace"): slow/sampled
+        # query span trees persist as trace rows in _monitoring.self_query
+        # through the DB's own trace write path (pool-routed like the
+        # self-measures when workers own the data plane)
+        from banyandb_tpu.obs.selftrace import SelfTraceSink
+
+        self.self_trace = SelfTraceSink(
+            self._pool_trace if self.pool is not None else self.trace,
+            self.registry,
+            node="standalone",
+        )
         # multi-tenant QoS (docs/robustness.md "Multi-tenant QoS"):
         # tenant = group namespace; ingest token buckets + weighted
         # query admission shed with the retryable ServerBusy wire kind,
@@ -519,6 +530,17 @@ class StandaloneServer:
             if engine == "stream":
                 s = self.registry.get_stream(group, req.name)
                 return logical.analyze_stream(s, req).explain()
+            if engine == "trace":
+                from banyandb_tpu.models import trace as trace_model
+
+                t = self.registry.get_trace(group, req.name)
+                kind, _, _, _, _ = trace_model.classify_plan(
+                    req, t.trace_id_tag
+                )
+                return (
+                    f"trace plan={kind} order_by={req.order_by_tag or '-'}"
+                    f" limit={req.limit} offset={req.offset}"
+                )
             return None
 
         record_slow_query(
@@ -528,6 +550,10 @@ class StandaloneServer:
             plan=(res.trace or {}).get("plan") if res is not None else None,
             plan_fn=render_plan,
             tenant=tenant,
+        )
+        self.self_trace.offer(
+            engine=engine, group=group, name=req.name,
+            duration_ms=ms, tree=tree, tenant=tenant, ql=ql,
         )
 
     def _slowlog(self, env):
@@ -846,7 +872,7 @@ class StandaloneServer:
                     res = self.stream.query(req, tracer=tracer)
             elif catalog == "trace":
                 with tracer.span("execute"):
-                    res = self._ql_trace(req)
+                    res = self._ql_trace(req, tracer=tracer)
             elif catalog == "property":
                 with tracer.span("execute"):
                     res = self._ql_property(req)
@@ -869,11 +895,11 @@ class StandaloneServer:
         # (cache-miss) scans vs materialized-window reads with this
         return {"result": result_to_json(res), "served": _served_class(tree)}
 
-    def _ql_trace(self, req: QueryRequest) -> QueryResult:
+    def _ql_trace(self, req: QueryRequest, tracer=None) -> QueryResult:
         from banyandb_tpu.query import ql_exec
 
         engine = self._pool_trace if self.pool is not None else self.trace
-        return ql_exec.execute_trace_ql(engine, req)
+        return ql_exec.execute_trace_ql(engine, req, tracer=tracer)
 
     def _ql_property(self, req: QueryRequest) -> QueryResult:
         from banyandb_tpu.query import ql_exec
@@ -945,6 +971,7 @@ class StandaloneServer:
             flushed += self.trace.flush()
         self.property.persist()
         self.self_metrics.flush()  # self-measures land in _monitoring
+        self.self_trace.flush()  # queued self-query span trees likewise
         return {"flushed": flushed, "root": str(self.root)}
 
     # -- lifecycle ----------------------------------------------------------
@@ -983,6 +1010,7 @@ class StandaloneServer:
         # periodic _monitoring population (the native-meter provider
         # cadence); thread owned here, joined in stop()
         self.self_metrics.start()
+        self.self_trace.start()
         if self.wire is not None:
             self.wire.start()
         if self.http is not None:
@@ -1012,6 +1040,7 @@ class StandaloneServer:
         self.autoreg.stop()
         self.measure.stop_lifecycle()
         self.self_metrics.stop()
+        self.self_trace.stop()
         self.watchdog.stop()
         self.grpc.stop()
         # ALL ingress surfaces close before the pool: a write landing

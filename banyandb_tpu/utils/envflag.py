@@ -87,6 +87,10 @@ FLAGS: dict[str, str] = {
     "BYDB_SANITIZE": "bool: runtime sanitizers (bdsan)",
     "BYDB_SCAN_CHUNK": "int: measure scan chunk rows",
     "BYDB_SELF_MEASURE_INTERVAL_S": "float: self-observability interval",
+    "BYDB_SELF_TRACE": "bool: mirror query span trees into _monitoring.self_query",
+    "BYDB_SELF_TRACE_INTERVAL_S": "float: self-trace flush cadence",
+    "BYDB_SELF_TRACE_MS": "float: self-trace sampling threshold (0 = all)",
+    "BYDB_SELF_TRACE_QUEUE": "int: self-trace queue cap (full = shed)",
     "BYDB_SERVING_CACHE_BYTES": "int: serving-cache byte budget",
     "BYDB_SERVING_CACHE_CAP": "int: serving-cache entry cap",
     "BYDB_SLOWLOG_CAPACITY": "int: slow-query recorder ring size",

@@ -89,6 +89,14 @@ echo "== obs smoke =="
 # bucketed stage histograms on /metrics (docs/observability.md)
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py || fail=1
 
+echo "== trace smoke =="
+# trace query surface + dogfood loop: bloom/zone block pruning with
+# BYDB_ZONE_SKIP=0 byte parity, distributed trace=true query parity +
+# merged scatter/merge span tree, BYDB_SELF_TRACE round-trip — the
+# in-band span tree read back from _monitoring.self_query via bydbql
+# (docs/observability.md "Self-trace")
+env JAX_PLATFORMS=cpu python scripts/trace_smoke.py || fail=1
+
 echo "== workers smoke =="
 # multi-process data plane: BYDB_WORKERS=2 vs 0 scatter BYTE parity,
 # per-worker span graft + labeled /metrics, worker SIGKILL -> restart +

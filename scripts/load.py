@@ -1164,6 +1164,67 @@ def run_scaling(
     return out
 
 
+def run_selftrace(
+    *,
+    seconds: float = 30.0,
+    writers: int = 2,
+    queriers: int = 4,
+    batch: int = 500,
+    seed: int = 0,
+    write_rate: int = 0,
+    query_interval_ms: int = 0,
+) -> dict:
+    """Self-trace overhead A/B (docs/observability.md "Self-trace"):
+    the SAME workload with the dogfood sink OFF then ON at its
+    worst-case setting (BYDB_SELF_TRACE_MS=0 — EVERY query's span tree
+    queued and mirrored through the server's own TraceEngine), reporting
+    ``selftrace_overhead_x`` = on_p50 / off_p50.  The sink's contract is
+    shed-never-block, so the ratio is the whole claim: the gate reads
+    <= 1.05.  The ON phase also witnesses the sink actually fired
+    (``selftrace_spans`` delta) — a gate over a sink that never ran
+    would pass vacuously."""
+    import os as _os
+
+    from banyandb_tpu.obs import metrics as obs_metrics
+
+    def spans_total() -> float:
+        snap = obs_metrics.global_meter().snapshot()
+        return snap["counters"].get(("selftrace_spans", ()), 0.0)
+
+    phases = {}
+    deltas = {}
+    for label in ("off", "on"):
+        if label == "on":
+            _os.environ["BYDB_SELF_TRACE"] = "1"
+            _os.environ["BYDB_SELF_TRACE_MS"] = "0"
+        s0 = spans_total()
+        try:
+            phases[label] = run_load(
+                seconds=seconds, writers=writers, queriers=queriers,
+                batch=batch, seed=seed, write_rate=write_rate,
+                query_interval_ms=query_interval_ms,
+            )
+        finally:
+            _os.environ.pop("BYDB_SELF_TRACE", None)
+            _os.environ.pop("BYDB_SELF_TRACE_MS", None)
+        deltas[label] = spans_total() - s0
+    off_p50 = phases["off"]["latency_ms"]["p50"]
+    on_p50 = phases["on"]["latency_ms"]["p50"]
+    return {
+        "phase": "selftrace",
+        "phases": phases,
+        "selftrace_spans_off": deltas["off"],
+        "selftrace_spans_on": deltas["on"],
+        "off_p50_ms": off_p50,
+        "on_p50_ms": on_p50,
+        "selftrace_overhead_x": (
+            round(on_p50 / off_p50, 2) if off_p50 > 0 else None
+        ),
+        "write_errors": sum(p["write_errors"] for p in phases.values()),
+        "query_errors": sum(p["query_errors"] for p in phases.values()),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("bydb load (throughput/SLO harness)")
     ap.add_argument("--seconds", type=float, default=60.0)
@@ -1258,6 +1319,18 @@ def main(argv=None) -> int:
         "(vacuous-pass rule)",
     )
     ap.add_argument(
+        "--selftrace", action="store_true",
+        help="self-trace overhead A/B: the same workload with the "
+        "dogfood sink off then on at BYDB_SELF_TRACE_MS=0 (every query "
+        "mirrored) — persists selftrace_overhead_x = on_p50/off_p50",
+    )
+    ap.add_argument(
+        "--max-selftrace-x", type=float, default=1.05,
+        help="SLO ceiling on selftrace_overhead_x under --selftrace "
+        "(docs/observability.md reads <= 1.05); an unmeasurable ratio "
+        "or a sink that never fired fails the gate (vacuous-pass rule)",
+    )
+    ap.add_argument(
         "--scaling", action="store_true",
         help="run the 1->4 worker scaling phase instead of one load run "
         "(persists per-phase stats + scaling ratios; requires a host "
@@ -1336,6 +1409,37 @@ def main(argv=None) -> int:
                 slo_fail.append("compliant_p50_unmeasurable")
             elif stats["compliant_p50_x"] > args.max_compliant_p50_x:
                 slo_fail.append("compliant_p50")
+        stats["slo_fail"] = slo_fail
+        print(json.dumps(stats))
+        if args.out:
+            from pathlib import Path
+
+            Path(args.out).write_text(json.dumps(stats, indent=1) + "\n")
+        return 1 if slo_fail else 0
+    if args.selftrace:
+        stats = run_selftrace(
+            seconds=args.seconds, writers=args.writers,
+            queriers=args.queriers, batch=args.batch, seed=args.seed,
+            write_rate=args.write_rate * max(args.write_rate_x, 1),
+            query_interval_ms=args.query_interval_ms,
+        )
+        slo_fail = []
+        if stats["write_errors"] or stats["query_errors"]:
+            slo_fail.append("errors")
+        if args.max_selftrace_x:
+            if stats["selftrace_spans_on"] <= 0:
+                # the sink never mirrored a span: the ON phase measured
+                # the OFF path twice and the ratio proves nothing
+                slo_fail.append("selftrace_sink_never_fired")
+            elif stats["selftrace_spans_off"] > 0:
+                # the OFF baseline was contaminated by a live sink
+                slo_fail.append("selftrace_baseline_contaminated")
+            elif stats["selftrace_overhead_x"] is None:
+                # off_p50 of 0.0 means no queries completed — an
+                # unmeasured SLO is a failed SLO (vacuous-pass rule)
+                slo_fail.append("selftrace_unmeasurable")
+            elif stats["selftrace_overhead_x"] > args.max_selftrace_x:
+                slo_fail.append("selftrace_overhead")
         stats["slo_fail"] = slo_fail
         print(json.dumps(stats))
         if args.out:

@@ -1,0 +1,282 @@
+"""Fast CPU-only trace-surface smoke (scripts/check.sh, both modes + CI).
+
+Proves, in seconds, the trace query surface + self-trace dogfood loop
+end-to-end (docs/observability.md "Self-trace"):
+
+1. criteria-only trace queries prune whole blocks BEFORE any read:
+   a trace-id lookup skips parts via the bloom sidecar and an int-tag
+   criteria scan skips parts via zone maps — both witnessed by
+   `blocks_skipped_total{reason=bloom|zone}` deltas — and flipping
+   `BYDB_ZONE_SKIP=0` returns byte-identical rows (pruning is an
+   optimization, never a filter);
+2. the same surface runs distributed: a trace=true 2-node trace query
+   returns rows byte-identical to standalone plus ONE merged span tree
+   with per-node scatter legs and the liaison merge span;
+3. the dogfood loop closes: with `BYDB_SELF_TRACE=1` a traced query's
+   span tree is mirrored through the server's own TraceEngine into
+   `_monitoring.self_query`, and a bydbql ORDER BY duration_us DESC
+   read-back recovers exactly the in-band tree's stages and durations.
+
+Exit 0 on success; any assertion prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# runnable as `python scripts/trace_smoke.py` from the repo root or CI
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+T0 = 1_700_000_000_000
+DAY = 86_400_000
+
+TRACE_SCHEMA = {
+    "group": "sm",
+    "name": "spans",
+    "tags": [
+        {"name": "trace_id", "type": "string"},
+        {"name": "svc", "type": "string"},
+        {"name": "duration", "type": "int"},
+    ],
+    "trace_id_tag": "trace_id",
+}
+
+
+def _schema_obj():
+    from banyandb_tpu.api import TagSpec, TagType
+    from banyandb_tpu.api.schema import Trace
+
+    return Trace(
+        group="sm",
+        name="spans",
+        tags=(
+            TagSpec("trace_id", TagType.STRING),
+            TagSpec("svc", TagType.STRING),
+            TagSpec("duration", TagType.INT),
+        ),
+        trace_id_tag="trace_id",
+    )
+
+
+def _batches():
+    """Three write batches -> three parts per shard: two day-0 batches
+    (durations < 2000) and one two days later (durations >= 5000, so
+    day-0 zone maps exclude the scan below entirely)."""
+    def day0(lo, hi):
+        return [
+            (
+                T0 + t * 10 + s,
+                {"trace_id": f"t{t}", "svc": f"s{t % 3}", "duration": t * 100 + s},
+                f"sp-t{t}-{s}".encode(),
+            )
+            for t in range(lo, hi)
+            for s in range(2)
+        ]
+
+    seg2 = [
+        (
+            T0 + 2 * DAY + u * 10 + s,
+            {"trace_id": f"u{u}", "svc": f"s{u % 3}", "duration": 5000 + u * 100 + s},
+            f"sp-u{u}-{s}".encode(),
+        )
+        for u in range(4)
+        for s in range(2)
+    ]
+    return day0(0, 10), day0(10, 20), seg2
+
+
+def _skipped(reason: str) -> float:
+    from banyandb_tpu.obs import metrics as obs_metrics
+
+    snap = obs_metrics.global_meter().snapshot()
+    return snap["counters"].get(("blocks_skipped", (("reason", reason),)), 0.0)
+
+
+def _run_ql(engine, ql: str):
+    from banyandb_tpu import bydbql
+    from banyandb_tpu.query import ql_exec
+
+    _, req = bydbql.parse_with_catalog(ql)
+    return ql_exec.execute_trace_ql(engine, req)
+
+
+def main() -> int:
+    from pathlib import Path
+
+    from banyandb_tpu import bydbql
+    from banyandb_tpu.api import Catalog, Group, ResourceOpts, SchemaRegistry
+    from banyandb_tpu.cli import trace_search_ql
+    from banyandb_tpu.models.trace import SpanValue, TraceEngine
+
+    root = Path(tempfile.mkdtemp(prefix="bydb-trace-smoke-"))
+
+    # -- 1: block pruning witnessed by counters, A/B parity ----------------
+    reg = SchemaRegistry(root / "sa")
+    reg.create_group(Group("sm", Catalog.STREAM, ResourceOpts(shard_num=1)))
+    eng = TraceEngine(reg, root / "sa" / "data")
+    eng.create_trace(_schema_obj())
+    for batch in _batches():
+        eng.write(
+            "sm",
+            "spans",
+            [SpanValue(ts, tags, p) for ts, tags, p in batch],
+            ordered_tags=("duration",),
+        )
+        eng.flush()  # one part per batch (+ trace-id bloom sidecars)
+
+    b0 = _skipped("bloom")
+    res = _run_ql(eng, trace_search_ql("sm", "spans", where=["trace_id = 'u2'"]))
+    bloom_delta = _skipped("bloom") - b0
+    assert [r["trace_id"] for r in res.data_points] == ["u2", "u2"], res.data_points
+    assert bloom_delta > 0, "trace-id lookup read parts the bloom should skip"
+
+    zone_ql = trace_search_ql("sm", "spans", where=["duration >= 5000"], limit=100)
+    z0 = _skipped("zone")
+    res_zone = _run_ql(eng, zone_ql)
+    zone_delta = _skipped("zone") - z0
+    assert len(res_zone.data_points) == 8, len(res_zone.data_points)
+    assert zone_delta > 0, "criteria scan read day-0 parts the zone maps exclude"
+
+    os.environ["BYDB_ZONE_SKIP"] = "0"
+    try:
+        res_noskip = _run_ql(eng, zone_ql)
+    finally:
+        os.environ.pop("BYDB_ZONE_SKIP", None)
+    assert res_noskip.data_points == res_zone.data_points, (
+        "zone pruning changed results — it must only skip provably empty blocks"
+    )
+    print(
+        f"# pruning: bloom Δ{bloom_delta:g}, zone Δ{zone_delta:g} blocks "
+        "skipped; BYDB_ZONE_SKIP=0 byte-identical"
+    )
+
+    # -- 2: distributed trace=true query: parity + merged span tree --------
+    import base64
+    import dataclasses
+
+    from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo
+    from banyandb_tpu.cluster.rpc import LocalTransport
+    from banyandb_tpu.obs.tracer import iter_spans
+
+    transport = LocalTransport()
+    nodes = []
+    for i in range(2):
+        nreg = SchemaRegistry(root / f"n{i}")
+        nreg.create_group(Group("sm", Catalog.STREAM, ResourceOpts(shard_num=4)))
+        dn = DataNode(f"d{i}", nreg, root / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+    lreg = SchemaRegistry(root / "l")
+    lreg.create_group(Group("sm", Catalog.STREAM, ResourceOpts(shard_num=4)))
+    lreg.create_trace(_schema_obj())
+    liaison = Liaison(lreg, transport, nodes)
+    for batch in _batches():
+        liaison.write_trace(
+            "sm",
+            "spans",
+            TRACE_SCHEMA,
+            [
+                {"ts": ts, "tags": tags, "span": base64.b64encode(p).decode()}
+                for ts, tags, p in batch
+            ],
+            ordered_tags=("duration",),
+        )
+
+    ordered_ql = trace_search_ql(
+        "sm", "spans", order_by="duration", desc=True, limit=6
+    )
+    _, req = bydbql.parse_with_catalog(ordered_ql)
+    res_standalone = _run_ql(eng, ordered_ql)
+    res_cluster = liaison.query_trace(dataclasses.replace(req, trace=True))
+    assert res_cluster.data_points == res_standalone.data_points, (
+        "distributed trace rows diverge from standalone"
+    )
+    tree = (res_cluster.trace or {}).get("span_tree")
+    assert tree, "trace=true must attach a merged span_tree"
+    names = [str(s.get("name", "")) for s in iter_spans(tree)]
+    scatter_legs = [n for n in names if n.startswith("scatter:")]
+    assert len(scatter_legs) >= 2, f"expected 2 scatter legs, got {names}"
+    assert "merge" in names, f"liaison merge span missing: {names}"
+    print(
+        f"# distributed: {len(res_cluster.data_points)} rows byte-identical, "
+        f"tree legs {scatter_legs} + merge"
+    )
+
+    # -- 3: the dogfood loop: self-trace -> bydbql read-back ---------------
+    os.environ["BYDB_SELF_TRACE"] = "1"
+    os.environ["BYDB_SELF_TRACE_MS"] = "0"
+    try:
+        _dogfood_smoke()
+    finally:
+        os.environ.pop("BYDB_SELF_TRACE", None)
+        os.environ.pop("BYDB_SELF_TRACE_MS", None)
+    print("trace_smoke: OK")
+    return 0
+
+
+def _dogfood_smoke() -> None:
+    from banyandb_tpu.api import Catalog, Group, ResourceOpts
+    from banyandb_tpu.cli import SELF_QUERY_QL, trace_search_ql
+    from banyandb_tpu.models.trace import SpanValue
+    from banyandb_tpu.obs.tracer import iter_spans
+    from banyandb_tpu.server import StandaloneServer
+
+    tmp = tempfile.mkdtemp(prefix="bydb-trace-dogfood-")
+    srv = StandaloneServer(tmp, port=0, slow_query_ms=0.0)
+    try:
+        srv.registry.create_group(
+            Group("sm", Catalog.TRACE, ResourceOpts(shard_num=1))
+        )
+        srv.registry.create_trace(_schema_obj())
+        srv.trace.write(
+            "sm",
+            "spans",
+            [
+                SpanValue(T0 + i, {"trace_id": f"t{i}", "svc": "s0",
+                                   "duration": i * 10}, b"x")
+                for i in range(8)
+            ],
+            ordered_tags=("duration",),
+        )
+        srv.trace.flush()
+        out = srv._ql(
+            {"ql": trace_search_ql(
+                "sm", "spans", order_by="duration", desc=True, limit=3
+            )}
+        )
+        assert out["result"]["data_points"], "traced query returned no rows"
+        entry = srv.slowlog.entries()[0]
+        expect = {
+            (sp.get("name", ""), int(float(sp.get("duration_ms", 0.0)) * 1000))
+            for sp in iter_spans(entry["span_tree"])
+        }
+        wrote = srv.self_trace.flush()
+        assert wrote == len(expect), f"mirrored {wrote} spans, tree has {len(expect)}"
+
+        back = srv._ql({"ql": SELF_QUERY_QL.format(limit=50)})
+        rows = back["result"]["data_points"]
+        got = {(r["tags"]["stage"], r["tags"]["duration_us"]) for r in rows}
+        assert got == expect, f"read-back {got} != in-band tree {expect}"
+        assert {r["tags"]["engine"] for r in rows} == {"trace"}
+        # the read-back itself must not re-enter the sink
+        assert srv.self_trace.flush() == 0, "self-trace recursion guard broken"
+        print(
+            f"# dogfood: {wrote} spans mirrored, bydbql read-back matches "
+            "the in-band tree exactly"
+        )
+    finally:
+        srv.stop()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except AssertionError as e:
+        print(f"trace_smoke: FAILED: {e}", file=sys.stderr)
+        raise SystemExit(1) from e

@@ -36,7 +36,13 @@ SPANS_PER_TRACE = 3
 
 _DIR = Path(__file__).parent / "cases"
 STREAM_CASES = json.loads((_DIR / "stream_cases.json").read_text())["cases"]
-TRACE_CASES = json.loads((_DIR / "trace_cases.json").read_text())["cases"]
+# "ql" cases run in tests/test_goldens_trace.py against a numpy oracle;
+# this suite keeps the direct-API (by_id / ordered) parity pins
+TRACE_CASES = [
+    c
+    for c in json.loads((_DIR / "trace_cases.json").read_text())["cases"]
+    if c["kind"] in ("by_id", "ordered")
+]
 
 TRACE_SCHEMA = {
     "group": "sw",

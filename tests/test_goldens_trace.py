@@ -1,0 +1,488 @@
+"""Golden corpus for the full trace query surface (tests/cases/trace_cases.json).
+
+Every "ql" case composes criteria x projection x order-by x limit/offset
+through the SAME BydbQL builder cli.py and the HTTP gateway use
+(cli.trace_search_ql), then runs against:
+
+  1. a standalone TraceEngine (multi-part: three flushed batches;
+     cross-segment: one batch two days later), checked against a
+     numpy oracle that re-derives the plan semantics from the raw rows;
+  2. a 2-node cluster through Liaison.query_trace — byte-identical
+     rows required (scatter by trace_shard_id, sidx-ordered partial
+     merge at the liaison).
+
+Plus the pinning satellites: bloom/zone block-skip counter deltas,
+zone-skip A/B parity, sidx pagination tiling (limit+offset consumed
+inside the walk — the ids[:limit] regression), and degraded-cluster
+markers on the trace path.
+"""
+
+import base64
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from banyandb_tpu import bydbql
+from banyandb_tpu.api import (
+    Catalog,
+    Group,
+    ResourceOpts,
+    SchemaRegistry,
+    TagSpec,
+    TagType,
+)
+from banyandb_tpu.api.schema import Trace
+from banyandb_tpu.cli import trace_search_ql
+from banyandb_tpu.cluster import DataNode, Liaison, NodeInfo
+from banyandb_tpu.cluster.rpc import LocalTransport
+from banyandb_tpu.models.trace import SpanValue, TraceEngine
+from banyandb_tpu.obs import metrics as obs_metrics
+from banyandb_tpu.query import ql_exec
+
+T0 = 1_700_000_000_000
+DAY = 86_400_000
+SPANS_PER_TRACE = 3
+
+_DIR = Path(__file__).parent / "cases"
+ALL_CASES = json.loads((_DIR / "trace_cases.json").read_text())["cases"]
+QL_CASES = [c for c in ALL_CASES if c["kind"] == "ql"]
+
+SCHEMA_TAGS = (
+    ("trace_id", "string"),
+    ("svc", "string"),
+    ("env", "string"),
+    ("duration", "int"),
+)
+TRACE_SCHEMA = {
+    "group": "gold",
+    "name": "spans",
+    "tags": [{"name": n, "type": t} for n, t in SCHEMA_TAGS],
+    "trace_id_tag": "trace_id",
+}
+
+
+def _batch_rows(lo, hi):
+    """Day-0 traces t<lo>..t<hi-1>: duration t*100 + s*7 (per-trace max
+    globally unique), svc cycles s0..s4, env alternates prod/dev."""
+    rows = []
+    for t in range(lo, hi):
+        for s in range(SPANS_PER_TRACE):
+            rows.append(
+                (
+                    T0 + t * 10 + s,
+                    {
+                        "trace_id": f"t{t}",
+                        "svc": f"s{t % 5}",
+                        "env": "prod" if t % 2 == 0 else "dev",
+                        "duration": t * 100 + s * 7,
+                    },
+                    f"sp-t{t}-{s}".encode(),
+                )
+            )
+    return rows
+
+
+def _seg2_rows():
+    """Cross-segment traces u0..u7, two days later, durations above
+    every day-0 span (5000+) so ordered plans interleave segments."""
+    rows = []
+    for u in range(8):
+        for s in range(SPANS_PER_TRACE):
+            rows.append(
+                (
+                    T0 + 2 * DAY + u * 10 + s,
+                    {
+                        "trace_id": f"u{u}",
+                        "svc": f"s{u % 5}",
+                        "env": "prod",
+                        "duration": 5000 + u * 100 + s * 7,
+                    },
+                    f"sp-u{u}-{s}".encode(),
+                )
+            )
+    return rows
+
+
+BATCHES = (_batch_rows(0, 20), _batch_rows(20, 40), _seg2_rows())
+ALL_ROWS = [r for b in BATCHES for r in b]
+
+
+def _make_trace_schema(group):
+    return Trace(
+        group=group,
+        name="spans",
+        tags=tuple(
+            TagSpec(n, TagType.INT if t == "int" else TagType.STRING)
+            for n, t in SCHEMA_TAGS
+        ),
+        trace_id_tag="trace_id",
+    )
+
+
+@pytest.fixture(scope="module")
+def standalone(tmp_path_factory):
+    root = tmp_path_factory.mktemp("gold_standalone")
+    reg = SchemaRegistry(root)
+    reg.create_group(Group("gold", Catalog.STREAM, ResourceOpts(shard_num=2)))
+    eng = TraceEngine(reg, root / "data")
+    eng.create_trace(_make_trace_schema("gold"))
+    for batch in BATCHES:  # one part (per shard) per batch: multi-part
+        eng.write(
+            "gold",
+            "spans",
+            [SpanValue(ts, tags, payload) for ts, tags, payload in batch],
+            ordered_tags=("duration",),
+        )
+        eng.flush()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    root = tmp_path_factory.mktemp("gold_cluster")
+    transport = LocalTransport()
+    nodes = []
+    for i in range(2):
+        reg = SchemaRegistry(root / f"n{i}")
+        reg.create_group(
+            Group("gold", Catalog.STREAM, ResourceOpts(shard_num=4))
+        )
+        dn = DataNode(f"d{i}", reg, root / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+    lreg = SchemaRegistry(root / "l")
+    lreg.create_group(Group("gold", Catalog.STREAM, ResourceOpts(shard_num=4)))
+    lreg.create_trace(_make_trace_schema("gold"))
+    liaison = Liaison(lreg, transport, nodes)
+    for batch in BATCHES:
+        liaison.write_trace(
+            "gold",
+            "spans",
+            TRACE_SCHEMA,
+            [
+                {
+                    "ts": ts,
+                    "tags": tags,
+                    "span": base64.b64encode(payload).decode(),
+                }
+                for ts, tags, payload in batch
+            ],
+            ordered_tags=("duration",),
+        )
+    return liaison
+
+
+# -- the QL builder shared with cli.py / the gateway ------------------------
+
+
+def _fmt(v):
+    return str(v) if isinstance(v, (int, float)) else "'" + str(v) + "'"
+
+
+def _cond_ql(c):
+    name, op, val = c
+    if op in ("in", "not_in"):
+        kw = "NOT IN" if op == "not_in" else "IN"
+        return f"{name} {kw} ({', '.join(_fmt(x) for x in val)})"
+    sym = {"eq": "=", "ne": "!=", "gt": ">", "ge": ">=", "lt": "<", "le": "<="}
+    return f"{name} {sym[op]} {_fmt(val)}"
+
+
+def case_ql(case) -> str:
+    time = case.get("time")
+    return trace_search_ql(
+        "gold",
+        "spans",
+        tags=", ".join(case.get("proj") or []) or "*",
+        where=[_cond_ql(c) for c in case.get("where", [])],
+        order_by=case.get("order_by") or "",
+        desc=case.get("desc", False),
+        limit=case["limit"],
+        offset=case.get("offset", 0),
+        from_ms=T0 + time[0] if time else None,
+        to_ms=T0 + time[1] if time else None,
+    )
+
+
+# -- numpy oracle: re-derive the three plans from the raw rows --------------
+
+
+def _cond_ok(tags, c):
+    name, op, val = c
+    v = tags.get(name)
+    if op == "eq":
+        return v == val
+    if op == "ne":
+        return v != val
+    if op == "in":
+        return v in val
+    if op == "not_in":
+        return v not in val
+    fv = float(v)
+    return {
+        "gt": fv > val,
+        "ge": fv >= val,
+        "lt": fv < val,
+        "le": fv <= val,
+    }[op]
+
+
+def _shape(tags, ts, payload, proj, key=None):
+    if proj:
+        tags = {k: v for k, v in tags.items() if k in proj}
+    row = {
+        "trace_id": None,  # filled by caller pre-projection
+        "timestamp": ts,
+        "tags": tags,
+        "span": payload,
+    }
+    if key is not None:
+        row["key"] = int(key)
+    return row
+
+
+def oracle(case) -> list[dict]:
+    conds = [tuple(c[:2]) + (c[2],) for c in case.get("where", [])]
+    proj = tuple(case.get("proj") or ())
+    order_by = case.get("order_by")
+    desc = case.get("desc", False)
+    limit = case["limit"]
+    off = case.get("offset", 0)
+    time = case.get("time")
+    begin = T0 + time[0] if time else 0
+    end = T0 + time[1] if time else 1 << 62
+
+    # classify exactly like models.trace.classify_plan
+    id_sets, residual = [], []
+    for c in conds:
+        name, op, val = c
+        if name == "trace_id" and op == "eq":
+            id_sets.append({val})
+        elif name == "trace_id" and op == "in":
+            id_sets.append(set(val))
+        else:
+            residual.append(c)
+    lo = hi = None
+    if not id_sets and order_by:
+        rest = []
+        for c in residual:
+            name, op, val = c
+            if name == order_by and op in ("gt", "ge", "lt", "le"):
+                if op in ("gt", "ge"):
+                    b = int(val) + (1 if op == "gt" else 0)
+                    lo = b if lo is None else max(lo, b)
+                else:
+                    b = int(val) - (1 if op == "lt" else 0)
+                    hi = b if hi is None else min(hi, b)
+            else:
+                rest.append(c)
+        residual = rest
+
+    in_rows = [
+        (ts, tags, payload)
+        for ts, tags, payload in ALL_ROWS
+        if begin <= ts < end
+    ]
+
+    def span_rows(tid, key=None):
+        out = []
+        for ts, tags, payload in sorted(in_rows):
+            if tags["trace_id"] != tid:
+                continue
+            if not all(_cond_ok(tags, c) for c in residual):
+                continue
+            row = _shape(tags, ts, payload, proj, key=key)
+            row["trace_id"] = tid
+            out.append(row)
+        return out
+
+    if id_sets:  # by_id plan: span rows, sorted, paged on ROWS
+        tids = sorted(set.intersection(*id_sets))
+        rows = [r for tid in tids for r in span_rows(tid)]
+        rows.sort(key=lambda r: (r["timestamp"], r["trace_id"], r["span"]))
+        return rows[off : off + limit]
+
+    if order_by:  # ordered plan: sidx walk, paged on TRACES
+        # every span contributes one key; lo/hi bound KEYS (not spans)
+        keys = np.array(
+            [int(tags[order_by]) for _, tags, _ in in_rows], dtype=np.int64
+        )
+        tids = np.array([tags["trace_id"] for _, tags, _ in in_rows])
+        sel = np.ones(len(keys), dtype=bool)
+        if lo is not None:
+            sel &= keys >= lo
+        if hi is not None:
+            sel &= keys <= hi
+        entries = sorted(
+            zip(keys[sel].tolist(), tids[sel].tolist()),
+            key=lambda e: (-e[0] if desc else e[0], e[1]),
+        )
+        rows, seen, accepted = [], set(), 0
+        for k, tid in entries:  # first-seen dedup inside the walk
+            if tid in seen:
+                continue
+            seen.add(tid)
+            spans = span_rows(tid, key=k)
+            if not spans:  # residual rejected the whole trace
+                continue
+            accepted += 1
+            if accepted <= off:
+                continue
+            rows.extend(spans)
+            if accepted - off >= limit:
+                break
+        return rows
+
+    # scan plan: per-span residual filter, sorted, paged on ROWS
+    all_tids = sorted({tags["trace_id"] for _, tags, _ in in_rows})
+    rows = [r for tid in all_tids for r in span_rows(tid)]
+    rows.sort(key=lambda r: (r["timestamp"], r["trace_id"], r["span"]))
+    return rows[off : off + limit]
+
+
+# -- the corpus, both topologies --------------------------------------------
+
+
+@pytest.mark.parametrize("case", QL_CASES, ids=[c["name"] for c in QL_CASES])
+def test_golden_standalone_vs_oracle(case, standalone):
+    _, req = bydbql.parse_with_catalog(case_ql(case))
+    res = ql_exec.execute_trace_ql(standalone, req)
+    expected = oracle(case)
+    assert res.data_points == expected, case["name"]
+    if case.get("empty"):
+        assert expected == [], f"{case['name']} marked empty but matched"
+    else:
+        assert expected, f"{case['name']} matched zero rows (not exercising)"
+
+
+@pytest.mark.parametrize("case", QL_CASES, ids=[c["name"] for c in QL_CASES])
+def test_golden_cluster_parity(case, standalone, cluster):
+    _, req = bydbql.parse_with_catalog(case_ql(case))
+    a = ql_exec.execute_trace_ql(standalone, req)
+    b = cluster.query_trace(req)
+    assert a.data_points == b.data_points, f"{case['name']} diverged"
+
+
+# -- block-skip witnesses ----------------------------------------------------
+
+
+def _skipped(reason: str) -> float:
+    snap = obs_metrics.global_meter().snapshot()
+    return snap["counters"].get(
+        ("blocks_skipped", (("reason", reason),)), 0.0
+    )
+
+
+def test_zone_skip_prunes_blocks(standalone):
+    """duration >= 5000 only exists in the day-2 batch; the day-0 parts'
+    zone maps must prune their blocks before any read — same rows."""
+    case = next(c for c in QL_CASES if c["name"] == "scan_zone_skip")
+    _, req = bydbql.parse_with_catalog(case_ql(case))
+    z0 = _skipped("zone")
+    res = ql_exec.execute_trace_ql(standalone, req)
+    assert _skipped("zone") > z0, "no zone-map block skips witnessed"
+    assert res.data_points == oracle(case)
+
+
+def test_zone_skip_ab_parity(standalone, monkeypatch):
+    """BYDB_ZONE_SKIP=0 must return byte-identical rows (pruning is an
+    optimization, never a filter)."""
+    case = next(c for c in QL_CASES if c["name"] == "scan_zone_skip")
+    _, req = bydbql.parse_with_catalog(case_ql(case))
+    on = ql_exec.execute_trace_ql(standalone, req)
+    monkeypatch.setenv("BYDB_ZONE_SKIP", "0")
+    off = ql_exec.execute_trace_ql(standalone, req)
+    assert on.data_points == off.data_points
+
+
+def test_bloom_skip_on_trace_id_lookup(standalone):
+    """u3 lives only in the day-2 part: every other part on its shard
+    must be skipped via the trace-id bloom sidecar, counted with
+    reason=bloom."""
+    case = next(c for c in QL_CASES if c["name"] == "ql_by_id_eq_seg2")
+    _, req = bydbql.parse_with_catalog(case_ql(case))
+    b0 = _skipped("bloom")
+    res = ql_exec.execute_trace_ql(standalone, req)
+    assert _skipped("bloom") > b0, "no bloom block skips witnessed"
+    assert [r["trace_id"] for r in res.data_points] == ["u3"] * 3
+
+
+# -- pagination tiling (the ids[:limit] regression) --------------------------
+
+
+def _page(engine, *, order_by, desc, limit, offset):
+    ql = trace_search_ql(
+        "gold", "spans", order_by=order_by, desc=desc,
+        limit=limit, offset=offset,
+    )
+    _, req = bydbql.parse_with_catalog(ql)
+    return ql_exec.execute_trace_ql(engine, req).data_points
+
+
+@pytest.mark.parametrize("desc", [True, False], ids=["desc", "asc"])
+def test_ordered_pagination_tiles_exactly(standalone, desc):
+    """Pages concatenate to the one-shot list: no duplicates, no gaps —
+    offset is consumed inside the sidx walk, not after the fetch."""
+    full = _page(standalone, order_by="duration", desc=desc, limit=60, offset=0)
+    assert len({r["trace_id"] for r in full}) == 48  # every trace
+    tiled = []
+    for off in range(0, 60, 7):
+        tiled.extend(
+            _page(standalone, order_by="duration", desc=desc, limit=7, offset=off)
+        )
+    assert tiled == full
+
+
+def test_scan_pagination_tiles_exactly(standalone):
+    def page(limit, offset):
+        ql = trace_search_ql("gold", "spans", limit=limit, offset=offset)
+        _, req = bydbql.parse_with_catalog(ql)
+        return ql_exec.execute_trace_ql(standalone, req).data_points
+
+    full = page(200, 0)
+    assert len(full) == len(ALL_ROWS)
+    tiled = []
+    for off in range(0, 200, 13):
+        tiled.extend(page(13, off))
+    assert tiled == full
+
+
+# -- degraded cluster --------------------------------------------------------
+
+
+def test_trace_query_degraded_on_node_loss(tmp_path):
+    """Unreplicated node loss: the trace scatter must answer from the
+    surviving node with explicit degraded markers, not throw."""
+    transport = LocalTransport()
+    nodes = []
+    for i in range(2):
+        reg = SchemaRegistry(tmp_path / f"n{i}")
+        reg.create_group(
+            Group("gold", Catalog.STREAM, ResourceOpts(shard_num=4))
+        )
+        dn = DataNode(f"d{i}", reg, tmp_path / f"n{i}" / "data")
+        nodes.append(NodeInfo(dn.name, transport.register(dn.name, dn.bus)))
+    lreg = SchemaRegistry(tmp_path / "l")
+    lreg.create_group(Group("gold", Catalog.STREAM, ResourceOpts(shard_num=4)))
+    lreg.create_trace(_make_trace_schema("gold"))
+    liaison = Liaison(lreg, transport, nodes)
+    liaison.write_trace(
+        "gold", "spans", TRACE_SCHEMA,
+        [
+            {"ts": ts, "tags": tags, "span": base64.b64encode(p).decode()}
+            for ts, tags, p in BATCHES[0]
+        ],
+        ordered_tags=("duration",),
+    )
+    ql = trace_search_ql("gold", "spans", limit=200)
+    _, req = bydbql.parse_with_catalog(ql)
+    healthy = liaison.query_trace(req)
+    assert not healthy.degraded and len(healthy.data_points) == 60
+
+    transport.unregister("d1")
+    res = liaison.query_trace(req)
+    assert res.degraded and res.unavailable_nodes == ["d1"]
+    # surviving rows are a strict, consistent subset
+    assert 0 < len(res.data_points) < 60
+    assert all(r in healthy.data_points for r in res.data_points)

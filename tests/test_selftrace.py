@@ -1,0 +1,168 @@
+"""Dogfood loop: query span trees mirrored into `_monitoring.self_query`
+through the DB's own TraceEngine, read back with the full trace query
+surface (ORDER BY duration_us DESC over the sidx)."""
+
+import pytest
+
+from banyandb_tpu.api import Catalog, Group, ResourceOpts, TagSpec, TagType
+from banyandb_tpu.api.schema import Trace
+from banyandb_tpu.models.trace import SpanValue
+from banyandb_tpu.obs import metrics as obs_metrics
+from banyandb_tpu.obs.selftrace import SelfTraceSink
+from banyandb_tpu.obs.tracer import iter_spans
+
+T0 = 1_700_000_000_000
+
+
+def _seed_trace(srv):
+    srv.registry.create_group(Group("tg", Catalog.TRACE, ResourceOpts(shard_num=1)))
+    srv.registry.create_trace(
+        Trace(
+            group="tg",
+            name="sw",
+            tags=(
+                TagSpec("trace_id", TagType.STRING),
+                TagSpec("duration", TagType.INT),
+            ),
+            trace_id_tag="trace_id",
+        )
+    )
+    srv.trace.write(
+        "tg",
+        "sw",
+        [
+            SpanValue(T0 + i, {"trace_id": f"t{i}", "duration": 10 * i}, b"x")
+            for i in range(10)
+        ],
+        ordered_tags=("duration",),
+    )
+    srv.trace.flush()
+
+
+@pytest.fixture()
+def selftrace_server(tmp_path, monkeypatch):
+    monkeypatch.setenv("BYDB_SELF_TRACE", "1")
+    monkeypatch.setenv("BYDB_SELF_TRACE_MS", "0")
+    from banyandb_tpu.server import StandaloneServer
+
+    # slow_query_ms=0: every query is recorded, so every query is offered
+    srv = StandaloneServer(tmp_path / "srv", port=0, slow_query_ms=0.0)
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_selftrace_round_trip(selftrace_server):
+    """A traced trace-engine query lands in _monitoring.self_query and
+    is answerable by bydbql from the database itself — the dogfood pin:
+    stage names and durations match the in-band span tree exactly."""
+    srv = selftrace_server
+    assert srv.self_trace.enabled
+    _seed_trace(srv)
+    out = srv._ql(
+        {"ql": "SELECT * FROM TRACE sw IN tg ORDER BY duration DESC LIMIT 3"}
+    )
+    assert out["result"]["data_points"]
+
+    entry = srv.slowlog.entries()[0]  # the in-band tree of that query
+    assert entry["engine"] == "trace"
+    tree = entry["span_tree"]
+    expect = {
+        (sp.get("name", ""), int(float(sp.get("duration_ms", 0.0)) * 1000))
+        for sp in iter_spans(tree)
+    }
+    assert expect, "traced query produced an empty span tree"
+
+    wrote = srv.self_trace.flush()
+    assert wrote == len(expect)
+
+    back = srv._ql(
+        {
+            "ql": (
+                "SELECT * FROM TRACE self_query IN _monitoring "
+                "ORDER BY duration_us DESC LIMIT 50"
+            )
+        }
+    )
+    rows = back["result"]["data_points"]
+    got = {(r["tags"]["stage"], r["tags"]["duration_us"]) for r in rows}
+    assert got == expect
+    assert {r["tags"]["engine"] for r in rows} == {"trace"}
+    assert {r["tags"]["name"] for r in rows} == {"sw"}
+    assert {r["tags"]["node"] for r in rows} == {"standalone"}
+    assert len({r["trace_id"] for r in rows}) == 1  # one query id
+    # ordered surface actually ordered: duration_us keys descending
+    keys = [r["key"] for r in rows if "key" in r]
+    assert keys == sorted(keys, reverse=True)
+
+    # reading _monitoring itself must NOT re-enter the sink (recursion
+    # guard): a second flush writes nothing new from that read-back
+    assert srv.self_trace.flush() == 0
+
+
+def test_selftrace_flag_off_is_inert(tmp_path):
+    """Default env: sink disabled, no _monitoring trace schema appears,
+    offer/flush are no-ops — the flag-off path stays byte-identical."""
+    from banyandb_tpu.server import StandaloneServer
+
+    srv = StandaloneServer(tmp_path / "srv", port=0, slow_query_ms=0.0)
+    try:
+        assert not srv.self_trace.enabled
+        _seed_trace(srv)
+        out = srv._ql({"ql": "SELECT * FROM TRACE sw IN tg WHERE trace_id = 't5'"})
+        assert out["result"]["data_points"]
+        assert srv.self_trace.flush() == 0
+        with pytest.raises(KeyError):
+            srv.registry.get_trace("_monitoring", "self_query")
+    finally:
+        srv.stop()
+
+
+def _tree(ms=2.5):
+    return {
+        "name": "execute",
+        "duration_ms": ms,
+        "children": [{"name": "part_gather", "duration_ms": ms / 2}],
+    }
+
+
+def _dropped() -> float:
+    snap = obs_metrics.global_meter().snapshot()
+    return snap["counters"].get(("selftrace_dropped", ()), 0.0)
+
+
+def test_offer_sheds_on_full_queue(monkeypatch):
+    monkeypatch.setenv("BYDB_SELF_TRACE", "1")
+    monkeypatch.setenv("BYDB_SELF_TRACE_QUEUE", "2")
+    sink = SelfTraceSink(None, None)
+    kw = dict(engine="trace", group="g", name="n", duration_ms=1.0, tree=_tree())
+    d0 = _dropped()
+    assert sink.offer(**kw)
+    assert sink.offer(**kw)
+    assert not sink.offer(**kw)  # full: shed, never block
+    assert _dropped() == d0 + 1
+
+
+def test_offer_respects_sampling_threshold(monkeypatch):
+    monkeypatch.setenv("BYDB_SELF_TRACE", "1")
+    monkeypatch.setenv("BYDB_SELF_TRACE_MS", "100")
+    sink = SelfTraceSink(None, None)
+    assert not sink.offer(
+        engine="trace", group="g", name="n", duration_ms=99.0, tree=_tree()
+    )
+    assert sink.offer(
+        engine="trace", group="g", name="n", duration_ms=100.0, tree=_tree()
+    )
+
+
+def test_offer_never_records_monitoring_group(monkeypatch):
+    monkeypatch.setenv("BYDB_SELF_TRACE", "1")
+    sink = SelfTraceSink(None, None)
+    assert not sink.offer(
+        engine="trace",
+        group="_monitoring",
+        name="self_query",
+        duration_ms=1.0,
+        tree=_tree(),
+    )
